@@ -1,0 +1,23 @@
+"""distributed_tf_serving_tpu — a TPU-native distributed CTR serving framework.
+
+A brand-new JAX/XLA/pjit/Pallas implementation of the capabilities of
+neuzxy/Distributed-TF-Serving: a wire-compatible TensorFlow-Serving
+`PredictionService` whose backend is an in-tree JAX runtime executing CTR
+models (DCN/DCN-v2, Wide&Deep, DeepFM, two-tower, DLRM) on TPU, with
+candidate-dimension sharding over the ICI mesh replacing the reference's
+per-host gRPC fan-out, and a padded-bucket jit batching engine replacing
+TF-Serving's server-side dynamic batching.
+
+Layout:
+  proto/     wire-compatible protobuf bindings + hand-written gRPC glue
+  codec      TensorProto <-> numpy/jax array conversion
+  models/    pure-JAX CTR model zoo + servable registry
+  ops/       hot-path ops (Pallas kernels, embedding lookups)
+  parallel/  mesh construction, shardings, collectives
+  serving/   batching engine + gRPC PredictionService frontend
+  client/    asyncio fan-out client + closed-loop bench harness
+  train/     sharded training loop + checkpointing
+  utils/     config, metrics, tracing
+"""
+
+__version__ = "0.1.0"

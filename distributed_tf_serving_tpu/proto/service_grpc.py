@@ -1,0 +1,82 @@
+"""Hand-written gRPC wiring for tensorflow.serving.PredictionService.
+
+grpc_tools (the protoc gRPC plugin) is not available in this image, so the
+stub and servicer glue that `protoc --grpc_python_out` would emit is written
+by hand. Method paths match the reference service definition
+(prediction_service.proto:15-31): /tensorflow.serving.PredictionService/<M>.
+
+Works with both `grpc.Channel`/`grpc.Server` and their `grpc.aio` variants —
+the channel/server object itself decides sync vs async semantics.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import serving_apis_pb2 as apis
+
+SERVICE_NAME = "tensorflow.serving.PredictionService"
+
+# method name -> (request class, response class); order matches the reference
+# service definition.
+_METHODS = {
+    "Classify": (apis.ClassificationRequest, apis.ClassificationResponse),
+    "Regress": (apis.RegressionRequest, apis.RegressionResponse),
+    "Predict": (apis.PredictRequest, apis.PredictResponse),
+    "MultiInference": (apis.MultiInferenceRequest, apis.MultiInferenceResponse),
+    "GetModelMetadata": (apis.GetModelMetadataRequest, apis.GetModelMetadataResponse),
+}
+
+
+class PredictionServiceStub:
+    """Client stub: one unary-unary callable per RPC.
+
+    Each attribute (e.g. ``stub.Predict``) is a grpc multicallable supporting
+    ``stub.Predict(request, timeout=...)`` and ``.future(...)`` on sync
+    channels, or awaitables on ``grpc.aio`` channels.
+    """
+
+    def __init__(self, channel: grpc.Channel):
+        for name, (req_cls, resp_cls) in _METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{SERVICE_NAME}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+class PredictionServiceServicer:
+    """Service base class; override the RPCs the server implements."""
+
+    def Classify(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Classify not implemented")
+
+    def Regress(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Regress not implemented")
+
+    def Predict(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Predict not implemented")
+
+    def MultiInference(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "MultiInference not implemented")
+
+    def GetModelMetadata(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetModelMetadata not implemented")
+
+
+def add_PredictionServiceServicer_to_server(servicer, server) -> None:
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+        for name, (req_cls, resp_cls) in _METHODS.items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )

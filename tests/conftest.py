@@ -1,0 +1,17 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the SURVEY.md §4 strategy:
+`xla_force_host_platform_device_count` lets pjit shardings, collective merge
+order, and per-shard numerics be validated on one host without a TPU slice).
+Environment must be set before the first `import jax` anywhere in the test
+process, which is why it lives at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

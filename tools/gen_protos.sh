@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Regenerate Python protobuf bindings for the wire-compatible serving protos.
+# grpc_tools is not available in this image, so only message bindings are
+# generated here; the gRPC service stub/servicer wiring is hand-written in
+# distributed_tf_serving_tpu/proto/service_grpc.py.
+set -euo pipefail
+cd "$(dirname "$0")/../distributed_tf_serving_tpu/proto"
+
+protoc -I. \
+  --python_out=. \
+  tf_framework.proto tf_graph.proto tf_example.proto tf_meta_graph.proto \
+  serving_apis.proto
+
+# protoc emits absolute imports between generated modules; rewrite them to
+# package-relative so the bindings live inside distributed_tf_serving_tpu.proto.
+sed -i -E 's/^import (tf_[a-z_]+_pb2|serving_apis_pb2)/from . import \1/' ./*_pb2.py
+
+echo "generated: $(ls ./*_pb2.py)"
