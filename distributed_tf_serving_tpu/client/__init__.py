@@ -1,0 +1,31 @@
+"""Client layer: candidate sharding, async fan-out Predict, bench harness."""
+
+from .bench import BenchReport, make_payload, run_closed_loop
+from .client import (
+    PredictClientError,
+    ShardedPredictClient,
+    build_predict_request,
+    predict_sync,
+)
+from .partition import (
+    merge_host_order,
+    partition_bounds,
+    partition_flat,
+    partition_list,
+    shard_candidates,
+)
+
+__all__ = [
+    "ShardedPredictClient",
+    "PredictClientError",
+    "build_predict_request",
+    "predict_sync",
+    "partition_bounds",
+    "partition_list",
+    "partition_flat",
+    "shard_candidates",
+    "merge_host_order",
+    "BenchReport",
+    "make_payload",
+    "run_closed_loop",
+]

@@ -1,0 +1,101 @@
+"""Closed-loop benchmark harness — the reference's L6 layer, with percentiles.
+
+Reproduces DCNClient.main's methodology (DCNClient.java:205-241): the payload
+is built ONCE and re-sent for every request (DCNClient.java:208-210), N
+concurrent workers each issue M sequential logical requests
+(concurrentNum=6 x requestNum=1000 upstream), every request is wall-clock
+timed end to end including the merge+sort, and an aggregate is reported.
+The reference prints only the mean (DCNClient.java:234-236); BASELINE.md's
+target metric set needs p50/p99 and QPS, so the raw sample list is kept and
+summarized here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+
+import numpy as np
+
+from .client import ShardedPredictClient
+
+
+@dataclasses.dataclass
+class BenchReport:
+    latencies_ms: np.ndarray
+    wall_s: float
+    concurrency: int
+    requests_per_worker: int
+    candidates: int
+
+    @property
+    def requests(self) -> int:
+        return self.latencies_ms.size
+
+    def percentile(self, q: float) -> float:
+        return float(np.percentile(self.latencies_ms, q))
+
+    @property
+    def qps(self) -> float:
+        return self.requests / self.wall_s
+
+    @property
+    def candidates_per_s(self) -> float:
+        return self.requests * self.candidates / self.wall_s
+
+    def summary(self) -> dict:
+        return {
+            "requests": self.requests,
+            "concurrency": self.concurrency,
+            "candidates_per_request": self.candidates,
+            "mean_ms": float(self.latencies_ms.mean()),
+            "p50_ms": self.percentile(50),
+            "p90_ms": self.percentile(90),
+            "p99_ms": self.percentile(99),
+            "qps": self.qps,
+            "candidates_per_s": self.candidates_per_s,
+            "wall_s": self.wall_s,
+        }
+
+
+def make_payload(candidates: int = 1500, num_fields: int = 43, seed: int = 7):
+    """The reference workload point: [candidateNum, FIELD_NUM] int64 ids +
+    float weights (DCNClient.java:25,29,57-74)."""
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, 1 << 40, size=(candidates, num_fields)).astype(np.int64),
+        "feat_wts": rng.rand(candidates, num_fields).astype(np.float32),
+    }
+
+
+async def run_closed_loop(
+    client: ShardedPredictClient,
+    payload: dict[str, np.ndarray],
+    concurrency: int = 6,
+    requests_per_worker: int = 1000,
+    sort_scores: bool = True,
+    warmup_requests: int = 3,
+) -> BenchReport:
+    for _ in range(warmup_requests):
+        await client.predict(payload, sort_scores=sort_scores)
+
+    latencies: list[float] = []
+
+    async def worker():
+        for _ in range(requests_per_worker):
+            t0 = time.perf_counter()
+            scores = await client.predict(payload, sort_scores=sort_scores)
+            latencies.append((time.perf_counter() - t0) * 1e3)
+            assert scores.shape[0] == payload["feat_ids"].shape[0]
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker() for _ in range(concurrency)))
+    wall = time.perf_counter() - t0
+    return BenchReport(
+        latencies_ms=np.asarray(latencies),
+        wall_s=wall,
+        concurrency=concurrency,
+        requests_per_worker=requests_per_worker,
+        candidates=payload["feat_ids"].shape[0],
+    )

@@ -1,0 +1,75 @@
+"""Candidate partitioning — the reference's client-side data parallelism.
+
+`partition_list` reproduces the contiguous split of DCNClient.partitionList
+(DCNClient.java:46-55): the first `parts-1` shards get floor(N/parts)
+elements each and the last takes the remainder. The reference applies this
+to *flattened* candidate x field arrays, which silently mis-aligns shard
+boundaries whenever N*FIELD_NUM doesn't divide evenly (the latent bug at
+DCNClient.java:97 — per-shard row count is recomputed as len/FIELD_NUM,
+truncating). Here sharding happens on candidate *rows*, which is always
+aligned; `partition_flat` exists for wire-parity testing and refuses the
+misaligned case instead of truncating.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import numpy as np
+
+
+def partition_bounds(n: int, parts: int) -> list[tuple[int, int]]:
+    """Contiguous [start, end) bounds: floor(n/parts) each, remainder to last."""
+    if parts <= 0:
+        raise ValueError(f"parts must be positive, got {parts}")
+    if n < parts:
+        raise ValueError(f"cannot split {n} items into {parts} non-empty shards")
+    base = n // parts
+    bounds = [(i * base, (i + 1) * base) for i in range(parts - 1)]
+    bounds.append(((parts - 1) * base, n))
+    return bounds
+
+
+def partition_list(seq: Sequence, parts: int) -> list[Sequence]:
+    """Reference semantics (DCNClient.java:46-55) over any sequence."""
+    return [seq[lo:hi] for lo, hi in partition_bounds(len(seq), parts)]
+
+
+def shard_candidates(
+    arrays: dict[str, np.ndarray], parts: int
+) -> list[dict[str, np.ndarray]]:
+    """Split candidate-major arrays into per-backend shards (row-aligned)."""
+    n = next(iter(arrays.values())).shape[0]
+    for key, arr in arrays.items():
+        if arr.shape[0] != n:
+            raise ValueError(
+                f"inconsistent candidate counts: {key!r} has {arr.shape[0]}, expected {n}"
+            )
+    return [
+        {k: v[lo:hi] for k, v in arrays.items()} for lo, hi in partition_bounds(n, parts)
+    ]
+
+
+def partition_flat(flat: Sequence, parts: int, num_fields: int) -> list[Sequence]:
+    """The reference's flat-array split, with its misalignment made an error.
+
+    The reference splits candidateNum*FIELD_NUM flat values and later infers
+    each shard's row count as len/FIELD_NUM (DCNClient.java:57-74,97),
+    silently dropping elements when shard boundaries fall mid-row. That case
+    is rejected here.
+    """
+    shards = partition_list(flat, parts)
+    for i, s in enumerate(shards):
+        if len(s) % num_fields != 0:
+            raise ValueError(
+                f"shard {i} has {len(s)} elements, not a multiple of num_fields="
+                f"{num_fields}: flat split would truncate mid-candidate "
+                "(the DCNClient.java:97 misalignment)"
+            )
+    return shards
+
+
+def merge_host_order(parts: list[np.ndarray]) -> np.ndarray:
+    """Concatenate per-shard results in shard (host) order — the merge
+    semantics of DCNClient.java:161-164."""
+    return np.concatenate(list(parts), axis=0)

@@ -1,0 +1,41 @@
+"""Pure-JAX CTR model zoo + servable registry.
+
+Model families cover every BASELINE.json config: dcn / dcn_v2 (the
+reference's served model, DCNClient.java:33), wide_deep, deepfm, two_tower,
+dlrm. All share the reference serving contract feat_ids/feat_wts [n, F] ->
+prediction_node [n].
+"""
+
+from .base import Batch, Model, ModelConfig, Params, build_model, model_kinds
+from .registry import (
+    DEFAULT_SIGNATURE,
+    ModelNotFoundError,
+    Servable,
+    ServableRegistry,
+    Signature,
+    SignatureNotFoundError,
+    TensorSpec,
+    VersionNotFoundError,
+    ctr_signatures,
+)
+
+# Import model modules for their registration side effects.
+from . import dcn, deepfm, dlrm, two_tower, wide_deep  # noqa: E402,F401
+
+__all__ = [
+    "Batch",
+    "Model",
+    "ModelConfig",
+    "Params",
+    "build_model",
+    "model_kinds",
+    "Servable",
+    "ServableRegistry",
+    "Signature",
+    "TensorSpec",
+    "ctr_signatures",
+    "DEFAULT_SIGNATURE",
+    "ModelNotFoundError",
+    "VersionNotFoundError",
+    "SignatureNotFoundError",
+]

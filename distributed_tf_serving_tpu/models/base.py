@@ -1,0 +1,155 @@
+"""Model runtime core: functional CTR models + builder registry.
+
+The reference delegates model execution to an external SavedModel inside
+tensorflow_model_server (SURVEY.md §0); here models are in-tree pure-JAX
+functions. Every model follows the serving contract the reference's client
+expects (DCNClient.java:33-35,98-108,162):
+
+  inputs : feat_ids  int64  [n, num_fields]   hashed categorical ids
+           feat_wts  float  [n, num_fields]   per-feature weights
+  output : prediction_node  float [n]         CTR score in [0, 1]
+
+Models are (init, apply) pairs over pytrees — no framework classes — so they
+compose directly with jit/pjit/shard_map/grad. TPU-first numerics: parameters
+live in float32, matmul compute runs in a configurable dtype (bfloat16 by
+default for MXU throughput) with float32 accumulation via
+preferred_element_type; `compute_dtype="float32"` is the AUC-parity mode
+(BASELINE.md: parity to 1e-6 vs the f32 GPU baseline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = Any  # pytree of jax.Arrays
+Batch = dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Knob set shared by the CTR model zoo.
+
+    Matches the reference workload point where applicable: num_fields=43
+    (FIELD_NUM, DCNClient.java:25).
+    """
+
+    name: str = "DCN"
+    num_fields: int = 43
+    vocab_size: int = 1 << 20
+    embed_dim: int = 16
+    mlp_dims: tuple[int, ...] = (256, 128, 64)
+    # DCN / DCN-v2
+    num_cross_layers: int = 3
+    cross_full_matrix: bool = False  # False => DCN-v1 rank-1 cross, True => DCN-v2
+    # two-tower
+    num_user_fields: int = 8
+    # DLRM
+    num_dense_features: int = 13
+    bottom_mlp_dims: tuple[int, ...] = (64, 32, 16)
+    # numerics
+    compute_dtype: str = "bfloat16"  # "float32" for AUC-parity mode
+    param_dtype: str = "float32"
+
+    @property
+    def cdtype(self) -> jnp.dtype:
+        return jnp.dtype(self.compute_dtype)
+
+    @property
+    def pdtype(self) -> jnp.dtype:
+        return jnp.dtype(self.param_dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """A functional model: params = init(rng); outputs = apply(params, batch).
+
+    wts_in_compute_dtype: True when the model consumes feat_wts exclusively
+    after casting to compute_dtype (via embeddings.field_embed) — the
+    precondition for the batcher's lossless bf16 weight-transfer compression.
+    Models with a float32 sparse-linear term over the raw weights
+    (wide_deep, deepfm) must leave it False.
+    """
+
+    config: ModelConfig
+    init: Callable[[jax.Array], Params]
+    apply: Callable[[Params, Batch], dict[str, jax.Array]]
+    wts_in_compute_dtype: bool = True
+
+
+# ---------------------------------------------------------------------------
+# Shared building blocks
+# ---------------------------------------------------------------------------
+
+
+def dense_init(rng: jax.Array, in_dim: int, out_dim: int, dtype) -> dict[str, jax.Array]:
+    """He-style init for a dense layer."""
+    wkey, _ = jax.random.split(rng)
+    scale = jnp.sqrt(2.0 / in_dim).astype(dtype)
+    return {
+        "w": jax.random.normal(wkey, (in_dim, out_dim), dtype) * scale,
+        "b": jnp.zeros((out_dim,), dtype),
+    }
+
+
+def dense_apply(p: dict[str, jax.Array], x: jax.Array, compute_dtype) -> jax.Array:
+    """x @ w + b in compute_dtype with f32 accumulation on the MXU."""
+    y = jax.lax.dot_general(
+        x.astype(compute_dtype),
+        p["w"].astype(compute_dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return y + p["b"].astype(jnp.float32)
+
+
+def mlp_init(rng: jax.Array, in_dim: int, dims: tuple[int, ...], dtype) -> list:
+    layers = []
+    for out_dim in dims:
+        rng, sub = jax.random.split(rng)
+        layers.append(dense_init(sub, in_dim, out_dim, dtype))
+        in_dim = out_dim
+    return layers
+
+
+def mlp_apply(layers: list, x: jax.Array, compute_dtype, final_relu: bool = True) -> jax.Array:
+    for i, p in enumerate(layers):
+        x = dense_apply(p, x, compute_dtype)
+        if final_relu or i + 1 < len(layers):
+            x = jax.nn.relu(x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Builder registry
+# ---------------------------------------------------------------------------
+
+_BUILDERS: dict[str, Callable[[ModelConfig], Model]] = {}
+
+
+def register_model(kind: str):
+    def deco(fn: Callable[[ModelConfig], Model]):
+        _BUILDERS[kind] = fn
+        return fn
+
+    return deco
+
+
+def build_model(kind: str, config: ModelConfig | None = None, **overrides) -> Model:
+    """Instantiate a model family by kind: dcn, dcn_v2, wide_deep, deepfm,
+    two_tower, dlrm."""
+    if kind not in _BUILDERS:
+        raise KeyError(f"unknown model kind {kind!r}; have {sorted(_BUILDERS)}")
+    if config is None:
+        config = ModelConfig(**overrides)
+    elif overrides:
+        config = dataclasses.replace(config, **overrides)
+    return _BUILDERS[kind](config)
+
+
+def model_kinds() -> list[str]:
+    return sorted(_BUILDERS)

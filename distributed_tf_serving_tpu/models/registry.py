@@ -1,0 +1,178 @@
+"""Servable registry: model name -> versions -> signatures.
+
+Replicates the model-resolution semantics the reference reaches through
+ModelSpec (model.proto:9-19): requests name a model, optionally pin a version
+via the Int64Value wrapper (absent => latest loaded version,
+model.proto:12-14), and select a signature by name (default
+"serving_default", matching DCNClient.java:34). GetModelMetadata serves the
+stored SignatureDefs (get_model_metadata.proto:15-30).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..proto import tf_framework_pb2 as fw
+from ..proto import tf_meta_graph_pb2 as mg
+from .base import Batch, Model, Params
+
+# TF-Serving method names carried in SignatureDef.method_name.
+PREDICT_METHOD = "tensorflow/serving/predict"
+CLASSIFY_METHOD = "tensorflow/serving/classify"
+REGRESS_METHOD = "tensorflow/serving/regress"
+
+DEFAULT_SIGNATURE = "serving_default"
+
+
+class ModelNotFoundError(KeyError):
+    pass
+
+
+class VersionNotFoundError(KeyError):
+    pass
+
+
+class SignatureNotFoundError(KeyError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    name: str  # logical tensor alias (the request/response map key)
+    dtype: int  # fw.DataType value
+    shape: tuple[int | None, ...]  # None = unknown/batch dim
+
+    def to_tensor_info(self) -> mg.TensorInfo:
+        info = mg.TensorInfo(name=f"{self.name}:0", dtype=self.dtype)
+        for s in self.shape:
+            info.tensor_shape.dim.add(size=-1 if s is None else s)
+        return info
+
+
+@dataclasses.dataclass(frozen=True)
+class Signature:
+    """One servable signature: typed I/O contract + method name."""
+
+    inputs: tuple[TensorSpec, ...]
+    outputs: tuple[TensorSpec, ...]
+    method_name: str = PREDICT_METHOD
+
+    def to_signature_def(self) -> mg.SignatureDef:
+        sd = mg.SignatureDef(method_name=self.method_name)
+        for spec in self.inputs:
+            sd.inputs[spec.name].CopyFrom(spec.to_tensor_info())
+        for spec in self.outputs:
+            sd.outputs[spec.name].CopyFrom(spec.to_tensor_info())
+        return sd
+
+
+def ctr_signatures(num_fields: int, with_dense: int | None = None) -> dict[str, Signature]:
+    """The standard CTR signature set matching the reference contract
+    (feat_ids int64 [n,F] + feat_wts float [n,F] -> prediction_node [n])."""
+    inputs = [
+        TensorSpec("feat_ids", fw.DataType.DT_INT64, (None, num_fields)),
+        TensorSpec("feat_wts", fw.DataType.DT_FLOAT, (None, num_fields)),
+    ]
+    if with_dense:
+        inputs.append(TensorSpec("dense_features", fw.DataType.DT_FLOAT, (None, with_dense)))
+    predict = Signature(
+        inputs=tuple(inputs),
+        outputs=(
+            TensorSpec("prediction_node", fw.DataType.DT_FLOAT, (None,)),
+            TensorSpec("logits", fw.DataType.DT_FLOAT, (None,)),
+        ),
+        method_name=PREDICT_METHOD,
+    )
+    classify = dataclasses.replace(
+        predict,
+        outputs=(
+            TensorSpec("scores", fw.DataType.DT_FLOAT, (None, 2)),
+            TensorSpec("classes", fw.DataType.DT_STRING, (None, 2)),
+        ),
+        method_name=CLASSIFY_METHOD,
+    )
+    regress = dataclasses.replace(
+        predict,
+        outputs=(TensorSpec("outputs", fw.DataType.DT_FLOAT, (None,)),),
+        method_name=REGRESS_METHOD,
+    )
+    return {DEFAULT_SIGNATURE: predict, "classify": classify, "regress": regress}
+
+
+@dataclasses.dataclass(eq=False)  # identity hash: used as a weak cache key
+class Servable:
+    """A loaded (model, params) pair plus its signature map."""
+
+    name: str
+    version: int
+    model: Model
+    params: Params
+    signatures: dict[str, Signature]
+
+    def signature(self, name: str) -> Signature:
+        key = name or DEFAULT_SIGNATURE
+        if key not in self.signatures:
+            raise SignatureNotFoundError(
+                f"signature {key!r} not found in servable {self.name} v{self.version}; "
+                f"have {sorted(self.signatures)}"
+            )
+        return self.signatures[key]
+
+    def __call__(self, batch: Batch) -> dict[str, jnp.ndarray]:
+        return self.model.apply(self.params, batch)
+
+    def signature_def_map(self) -> dict[str, mg.SignatureDef]:
+        return {k: v.to_signature_def() for k, v in self.signatures.items()}
+
+
+class ServableRegistry:
+    """Thread-safe name -> {version -> Servable} store.
+
+    Mutation happens on the control plane (load/unload); the serving data
+    plane only reads, so a plain lock around dict ops suffices.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._servables: dict[str, dict[int, Servable]] = {}
+
+    def load(self, servable: Servable) -> None:
+        with self._lock:
+            self._servables.setdefault(servable.name, {})[servable.version] = servable
+
+    def unload(self, name: str, version: int | None = None) -> None:
+        with self._lock:
+            if name not in self._servables:
+                raise ModelNotFoundError(name)
+            if version is None:
+                del self._servables[name]
+            else:
+                versions = self._servables[name]
+                if version not in versions:
+                    raise VersionNotFoundError(f"{name} v{version}")
+                del versions[version]
+                if not versions:
+                    del self._servables[name]
+
+    def resolve(self, name: str, version: int | None = None) -> Servable:
+        """ModelSpec resolution: absent version wrapper => latest
+        (model.proto:12-14)."""
+        with self._lock:
+            versions = self._servables.get(name)
+            if not versions:
+                raise ModelNotFoundError(f"model {name!r} not loaded")
+            if version is None:
+                return versions[max(versions)]
+            if version not in versions:
+                raise VersionNotFoundError(
+                    f"model {name!r} has no version {version}; have {sorted(versions)}"
+                )
+            return versions[version]
+
+    def models(self) -> dict[str, list[int]]:
+        with self._lock:
+            return {k: sorted(v) for k, v in self._servables.items()}

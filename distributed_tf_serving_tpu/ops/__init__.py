@@ -1,0 +1,5 @@
+"""Hot-path ops: transfer compression, Pallas kernels (cross-layer, lookup)."""
+
+from .transfer import pack_host, transfer_spec, unpack_device
+
+__all__ = ["pack_host", "transfer_spec", "unpack_device"]

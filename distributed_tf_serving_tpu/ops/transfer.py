@@ -1,0 +1,72 @@
+"""Host->device transfer compression for the serving hot path.
+
+HBM/PCIe (and on this rig, relay-tunnel) bandwidth is the serving
+bottleneck once compute is batched: the wire pays bytes-per-candidate, so
+the batcher shrinks what crosses the host<->device boundary and undoes it
+on-device inside the jitted executable (free: fuses into the embedding
+lookup's index arithmetic).
+
+Two lossless-under-the-model transforms:
+- feat_ids: folded ids are < vocab_size; when vocab_size <= 2^24 the int32
+  rows travel as 3 little-endian bytes each (u24), -25% id bytes. Unpack is
+  three shifts+ors on device.
+- feat_wts: when the model's compute dtype is bfloat16 AND the model
+  consumes weights only through that cast (Model.wts_in_compute_dtype — true
+  for dcn/dcn_v2/two_tower/dlrm via field_embed, false for wide_deep/deepfm
+  whose sparse-linear term is f32), the f32 weights are pre-cast on host and
+  travel as bf16 (-50% weight bytes) with bit-identical scores.
+
+Together: 344 -> 215 bytes/candidate at 43 fields for the reference
+workload (DCNClient.java:98-108 shapes).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from ..models.base import Model
+
+U24_MAX = 1 << 24
+
+
+def transfer_spec(model: Model) -> dict[str, str]:
+    """Per-input packing spec for a model; keys absent = pass-through."""
+    config = model.config
+    spec: dict[str, str] = {}
+    if config.vocab_size <= U24_MAX:
+        spec["feat_ids"] = "u24"
+    if config.compute_dtype == "bfloat16" and model.wts_in_compute_dtype:
+        spec["feat_wts"] = "bf16"
+    return spec
+
+
+def pack_host(arrays: dict[str, np.ndarray], spec: dict[str, str]) -> dict[str, np.ndarray]:
+    """Apply the spec on host numpy arrays (post-fold, post-pad)."""
+    out = {}
+    for key, arr in arrays.items():
+        how = spec.get(key)
+        if how == "u24":
+            if arr.dtype != np.int32:
+                raise ValueError(f"u24 packing expects folded int32 ids, got {arr.dtype}")
+            b = np.ascontiguousarray(arr).view(np.uint8).reshape(*arr.shape, 4)
+            out[key] = np.ascontiguousarray(b[..., :3])  # little-endian low 3 bytes
+        elif how == "bf16":
+            out[key] = arr.astype(ml_dtypes.bfloat16)
+        else:
+            out[key] = arr
+    return out
+
+
+def unpack_device(packed: dict[str, jnp.ndarray], spec: dict[str, str]) -> dict[str, jnp.ndarray]:
+    """Inverse of pack_host, traced inside the jitted executable."""
+    out = {}
+    for key, arr in packed.items():
+        how = spec.get(key)
+        if how == "u24":
+            b = arr.astype(jnp.int32)
+            out[key] = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
+        else:
+            out[key] = arr  # bf16 weights feed the model directly
+    return out

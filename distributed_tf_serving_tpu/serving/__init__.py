@@ -1,0 +1,22 @@
+"""Serving stack: dynamic batcher + PredictionService semantics + gRPC frontend."""
+
+from .batcher import BatcherStats, BatchTooLargeError, DynamicBatcher, bucket_for
+from .example_codec import ExampleDecodeError, decode_input, make_example
+from .server import GrpcPredictionService, create_server, load_demo_servable, serve
+from .service import PredictionServiceImpl, ServiceError
+
+__all__ = [
+    "DynamicBatcher",
+    "BatcherStats",
+    "BatchTooLargeError",
+    "bucket_for",
+    "decode_input",
+    "make_example",
+    "ExampleDecodeError",
+    "PredictionServiceImpl",
+    "ServiceError",
+    "GrpcPredictionService",
+    "create_server",
+    "load_demo_servable",
+    "serve",
+]

@@ -1,0 +1,325 @@
+"""Dynamic batching engine — the in-tree replacement for TF-Serving's
+server-side batching (the reference claims it as a core capability,
+README.md:5,9, but delegates it to the external tensorflow_model_server).
+
+TPU-first design:
+
+- **Padded candidate buckets.** XLA compiles one executable per input shape,
+  so arbitrary candidate counts would cause a compile storm. Incoming work is
+  padded up to a fixed bucket ladder (powers of two by default); jax.jit's
+  own trace cache then keys on the bucket shape, giving exactly one compiled
+  executable per (servable, bucket).
+- **Request coalescing.** Concurrent small requests targeting the same
+  (servable, signature) are concatenated along the candidate axis into one
+  device call, then split back — amortizing dispatch overhead exactly like
+  TF-Serving's BatchingSession. A request never waits more than
+  `max_wait_us`; the first item in a batch pays at most that.
+- **Host-side id folding.** Wire ids are int64 (DCNClient.java:98-102) but
+  jax runs x64-disabled; ids are folded into the vocab with int64 numpy on
+  the host (exact `mod`, not truncation) before device transfer, which also
+  shrinks the transfer 2x.
+
+The core is a dedicated batching thread with a thread-safe queue, so it
+serves both the sync grpc server (handler threads block on a Future) and the
+asyncio server (await wrap_future). Device work is serialized in the batcher
+thread — one stream of dispatches, no device-side contention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+import weakref
+from collections.abc import Callable
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import jax
+import numpy as np
+
+from ..models.base import Model
+from ..models.registry import Servable
+from ..ops.transfer import pack_host, transfer_spec, unpack_device
+
+DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+class BatchTooLargeError(ValueError):
+    pass
+
+
+def bucket_for(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise BatchTooLargeError(f"candidate count {n} exceeds largest bucket {buckets[-1]}")
+
+
+def fold_ids_host(ids: np.ndarray, vocab_size: int) -> np.ndarray:
+    """Exact int64 modulo fold on the host; models re-fold idempotently."""
+    return np.remainder(ids, np.int64(vocab_size)).astype(np.int32)
+
+
+def prepare_inputs(model: Model, arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Host-side normalization before padding/transfer."""
+    out = {}
+    for key, arr in arrays.items():
+        if key == "feat_ids":
+            out[key] = fold_ids_host(arr, model.config.vocab_size)
+        elif arr.dtype == np.float64:
+            out[key] = arr.astype(np.float32)
+        else:
+            out[key] = arr
+    return out
+
+
+@dataclasses.dataclass
+class _WorkItem:
+    servable: Servable
+    arrays: dict[str, np.ndarray]  # host arrays, candidate-major
+    n: int
+    future: Future  # resolves to dict[str, np.ndarray]
+    enqueue_t: float
+    output_keys: tuple[str, ...] | None  # None = all model outputs
+
+
+@dataclasses.dataclass
+class BatcherStats:
+    """Occupancy/queueing gauges (SURVEY.md §5 metrics obligations)."""
+
+    batches: int = 0
+    requests: int = 0
+    candidates: int = 0
+    padded_candidates: int = 0
+    max_queue_depth: int = 0
+
+    @property
+    def mean_occupancy(self) -> float:
+        return self.candidates / self.padded_candidates if self.padded_candidates else 0.0
+
+    @property
+    def mean_requests_per_batch(self) -> float:
+        return self.requests / self.batches if self.batches else 0.0
+
+
+class DynamicBatcher:
+    """Queue + batching thread + per-bucket jit cache.
+
+    run_fn(servable, batch) -> outputs is injected so the parallel layer can
+    swap in a sharded executor (pjit over a mesh) without touching batching
+    logic; the default executes servable.model.apply under jax.jit.
+    """
+
+    def __init__(
+        self,
+        buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+        max_wait_us: int = 200,
+        max_batch_candidates: int | None = None,
+        run_fn: Callable | None = None,
+        completion_workers: int = 4,
+        compress_transfer: bool = True,
+    ):
+        self.compress_transfer = compress_transfer
+        self.buckets = tuple(sorted(buckets))
+        self.max_wait_s = max_wait_us / 1e6
+        # Clamped: coalescing past the largest bucket would build a batch no
+        # bucket can hold and fail the whole group at dispatch time.
+        self.max_batch_candidates = min(
+            max_batch_candidates or self.buckets[-1], self.buckets[-1]
+        )
+        self._queue: queue.SimpleQueue[_WorkItem | None] = queue.SimpleQueue()
+        # Weak keys: unloaded servables must not pin their compiled
+        # executables, and a recycled object address must not serve a stale
+        # one (Servable uses eq=False, so it is hashable and weakref-able).
+        self._jitted: weakref.WeakKeyDictionary[Servable, tuple[Callable, dict]] = (
+            weakref.WeakKeyDictionary()
+        )
+        self._run_fn = run_fn
+        self.stats = BatcherStats()
+        self._depth = 0
+        self._depth_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, name="batcher", daemon=True)
+        self._started = False
+        self._stopping = False
+        # Device->host readback happens off the batching thread so batch k+1's
+        # transfer+compute dispatch overlaps batch k's result fetch — this is
+        # what pipelines over host<->device link latency (jax dispatch is
+        # async; only the fetch blocks). Several workers = several batches'
+        # readbacks in flight.
+        self._completers = ThreadPoolExecutor(
+            max_workers=completion_workers, thread_name_prefix="batch-complete"
+        )
+
+    # ------------------------------------------------------------------ API
+
+    def start(self) -> "DynamicBatcher":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._started:
+            self._stopping = True
+            self._queue.put(None)
+            self._thread.join(timeout=5)
+            self._completers.shutdown(wait=True)
+            self._started = False
+
+    def submit(
+        self,
+        servable: Servable,
+        arrays: dict[str, np.ndarray],
+        output_keys: tuple[str, ...] | None = None,
+    ) -> Future:
+        """Enqueue one request's arrays; returns a Future of output arrays
+        (sliced back to the request's own candidate count). output_keys limits
+        which model outputs are fetched back to the host."""
+        if self._stopping:
+            raise RuntimeError("batcher is stopped")
+        ns = {k: v.shape[0] for k, v in arrays.items()}
+        n = next(iter(ns.values()))
+        if any(v != n for v in ns.values()):
+            raise ValueError(f"inconsistent candidate counts across inputs: {ns}")
+        bucket_for(n, self.buckets)  # validate size up front, raises if too big
+        fut: Future = Future()
+        item = _WorkItem(
+            servable=servable,
+            arrays=prepare_inputs(servable.model, arrays),
+            n=n,
+            future=fut,
+            enqueue_t=time.perf_counter(),
+            output_keys=output_keys,
+        )
+        with self._depth_lock:
+            self._depth += 1
+            self.stats.max_queue_depth = max(self.stats.max_queue_depth, self._depth)
+        self._queue.put(item)
+        return fut
+
+    def warmup(self, servable: Servable, buckets: tuple[int, ...] | None = None) -> None:
+        """Precompile the bucket ladder for a servable (compile storms belong
+        at load time, not first-request time)."""
+        cfg = servable.model.config
+        for b in buckets or self.buckets:
+            arrays = {
+                "feat_ids": np.zeros((b, cfg.num_fields), np.int32),
+                "feat_wts": np.zeros((b, cfg.num_fields), np.float32),
+            }
+            self._execute(servable, arrays)
+
+    # ------------------------------------------------------------- internals
+
+    def _jit_for(self, servable: Servable) -> tuple[Callable, dict[str, str]]:
+        entry = self._jitted.get(servable)
+        if entry is None:
+            spec = transfer_spec(servable.model) if self.compress_transfer else {}
+            apply = servable.model.apply
+            if spec:
+                # Transfer decompression is traced into the executable, so it
+                # fuses with the embedding lookup's index arithmetic.
+                fn = jax.jit(lambda params, packed: apply(params, unpack_device(packed, spec)))
+            else:
+                fn = jax.jit(apply)
+            entry = (fn, spec)
+            self._jitted[servable] = entry
+        return entry
+
+    def _execute(self, servable: Servable, arrays: dict[str, np.ndarray]):
+        if self._run_fn is not None:
+            return self._run_fn(servable, arrays)
+        fn, spec = self._jit_for(servable)
+        return fn(servable.params, pack_host(arrays, spec) if spec else arrays)
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            group = [item]
+            total = item.n
+            deadline = item.enqueue_t + self.max_wait_s
+            # Coalesce same-servable work until the deadline or size cap.
+            while total < self.max_batch_candidates:
+                timeout = deadline - time.perf_counter()
+                try:
+                    nxt = self._queue.get(timeout=max(timeout, 0.0)) if timeout > 0 else self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    # Mid-coalesce shutdown: re-enqueue the sentinel BEHIND
+                    # any requeued items so they still get dispatched before
+                    # the loop exits (a requeued item stuck behind the
+                    # sentinel would otherwise hang its waiter forever).
+                    self._queue.put(None)
+                    break
+                if (
+                    nxt.servable is item.servable
+                    and nxt.arrays.keys() == item.arrays.keys()
+                    and total + nxt.n <= self.max_batch_candidates
+                ):
+                    group.append(nxt)
+                    total += nxt.n
+                else:
+                    # Different target or overflow: run what we have, requeue.
+                    self._queue.put(nxt)
+                    break
+            self._dispatch(group, total)
+
+    def _dispatch(self, group: list[_WorkItem], total: int) -> None:
+        with self._depth_lock:
+            self._depth -= len(group)
+        try:
+            bucket = bucket_for(total, self.buckets)
+            first = group[0]
+            keys = list(first.arrays.keys())
+            batched = {}
+            for k in keys:
+                parts = [it.arrays[k] for it in group]
+                concat = np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+                pad = bucket - total
+                if pad:
+                    concat = np.concatenate(
+                        [concat, np.zeros((pad,) + concat.shape[1:], concat.dtype)], axis=0
+                    )
+                batched[k] = concat
+            outputs = self._execute(first.servable, batched)  # async dispatch
+
+            # Union of the group's wanted outputs; None on any item = all.
+            wanted: set[str] | None = set()
+            for it in group:
+                if it.output_keys is None:
+                    wanted = None
+                    break
+                wanted.update(it.output_keys)
+            fetch = {
+                k: v for k, v in outputs.items() if wanted is None or k in wanted
+            }
+
+            self.stats.batches += 1
+            self.stats.requests += len(group)
+            self.stats.candidates += total
+            self.stats.padded_candidates += bucket
+
+            # Readback + distribution off-thread: the batching thread moves on
+            # to the next batch immediately, pipelining device work.
+            self._completers.submit(self._complete, group, fetch)
+        except Exception as exc:  # propagate to every waiter, keep serving
+            for it in group:
+                if not it.future.done():
+                    it.future.set_exception(exc)
+
+    @staticmethod
+    def _complete(group: list[_WorkItem], outputs) -> None:
+        try:
+            host = {k: np.asarray(v) for k, v in outputs.items()}
+            off = 0
+            for it in group:
+                sliced = {k: v[off : off + it.n] for k, v in host.items()}
+                off += it.n
+                it.future.set_result(sliced)
+        except Exception as exc:
+            for it in group:
+                if not it.future.done():
+                    it.future.set_exception(exc)

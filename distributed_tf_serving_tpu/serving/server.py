@@ -1,0 +1,139 @@
+"""gRPC frontend: the TPU-VM shim exposing PredictionService on the DCN edge.
+
+The reference's serving endpoint was `tensorflow_model_server` on port 9999
+(DCNClient.java:28); this is its in-tree replacement. A thin adapter maps
+ServiceError codes onto grpc status codes and delegates everything else to
+PredictionServiceImpl. Handler threads block on batcher futures, so the
+thread pool size bounds in-flight RPCs while the batcher thread serializes
+device work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from concurrent import futures
+
+import grpc
+import jax
+
+from ..models import ModelConfig, Servable, ServableRegistry, build_model, ctr_signatures
+from ..proto import add_PredictionServiceServicer_to_server
+from .batcher import DynamicBatcher
+from .service import PredictionServiceImpl, ServiceError
+
+log = logging.getLogger("dts_tpu.server")
+
+
+def _status(code_name: str) -> grpc.StatusCode:
+    return getattr(grpc.StatusCode, code_name, grpc.StatusCode.UNKNOWN)
+
+
+class GrpcPredictionService:
+    """grpc servicer adapter; safe against handler-thread exceptions."""
+
+    def __init__(self, impl: PredictionServiceImpl):
+        self.impl = impl
+
+    def _call(self, fn, request, context):
+        try:
+            return fn(request)
+        except ServiceError as e:
+            context.abort(_status(e.code), str(e))
+        except Exception as e:  # internal bug: surface as INTERNAL, keep serving
+            log.exception("internal error serving %s", fn.__name__)
+            context.abort(grpc.StatusCode.INTERNAL, f"internal error: {e}")
+
+    def Predict(self, request, context):
+        return self._call(self.impl.predict, request, context)
+
+    def Classify(self, request, context):
+        return self._call(self.impl.classify, request, context)
+
+    def Regress(self, request, context):
+        return self._call(self.impl.regress, request, context)
+
+    def MultiInference(self, request, context):
+        return self._call(self.impl.multi_inference, request, context)
+
+    def GetModelMetadata(self, request, context):
+        return self._call(self.impl.get_model_metadata, request, context)
+
+
+def create_server(
+    impl: PredictionServiceImpl,
+    address: str = "127.0.0.1:0",
+    max_workers: int = 16,
+) -> tuple[grpc.Server, int]:
+    """Build (not start) a server; returns (server, bound_port)."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="rpc"),
+        options=[
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+        ],
+    )
+    add_PredictionServiceServicer_to_server(GrpcPredictionService(impl), server)
+    port = server.add_insecure_port(address)
+    if port == 0:
+        raise RuntimeError(f"could not bind {address}")
+    return server, port
+
+
+def load_demo_servable(
+    registry: ServableRegistry,
+    kind: str = "dcn_v2",
+    name: str = "DCN",
+    version: int = 1,
+    seed: int = 0,
+    **config_overrides,
+) -> Servable:
+    """Build + register a randomly-initialized servable (demo/bench path;
+    production params come from train/checkpoint.py)."""
+    config = ModelConfig(name=name, **config_overrides)
+    model = build_model(kind, config)
+    params = jax.jit(model.init)(jax.random.PRNGKey(seed))
+    jax.block_until_ready(params)
+    dense = config.num_dense_features if kind == "dlrm" else None
+    servable = Servable(
+        name=name,
+        version=version,
+        model=model,
+        params=params,
+        signatures=ctr_signatures(config.num_fields, with_dense=dense),
+    )
+    registry.load(servable)
+    return servable
+
+
+def serve(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="TPU-native PredictionService")
+    parser.add_argument("--port", type=int, default=9999)  # reference default, DCNClient.java:28
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--model-kind", default="dcn_v2")
+    parser.add_argument("--model-name", default="DCN")
+    parser.add_argument("--num-fields", type=int, default=43)
+    parser.add_argument("--max-workers", type=int, default=16)
+    parser.add_argument("--max-wait-us", type=int, default=200)
+    parser.add_argument("--warmup", action="store_true", help="precompile bucket ladder")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    registry = ServableRegistry()
+    batcher = DynamicBatcher(max_wait_us=args.max_wait_us).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    servable = load_demo_servable(
+        registry, kind=args.model_kind, name=args.model_name, num_fields=args.num_fields
+    )
+    if args.warmup:
+        log.info("warming bucket ladder %s", batcher.buckets)
+        batcher.warmup(servable)
+    server, port = create_server(impl, f"{args.host}:{args.port}", args.max_workers)
+    server.start()
+    log.info("PredictionService on %s:%d (model=%s kind=%s, devices=%s)",
+             args.host, port, args.model_name, args.model_kind, jax.devices())
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    serve()

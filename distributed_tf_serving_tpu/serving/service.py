@@ -1,0 +1,272 @@
+"""PredictionService semantics, transport-free.
+
+Implements the server-side contract the reference reaches only through the
+external tensorflow_model_server (SURVEY.md §3.5): ModelSpec resolution with
+latest-version default (model.proto:12-14), signature lookup, input
+validation against the signature, output_filter selection
+(predict.proto:23-30), and the Classify/Regress/MultiInference Example path.
+The gRPC layer (server.py) is a thin adapter over this class, so the same
+logic is testable without sockets and reusable from an in-process client.
+
+Error taxonomy (per-RPC status codes — the failure-detection obligation from
+SURVEY.md §5): unknown model/version -> NOT_FOUND; malformed tensors,
+signature mismatches, bad Examples -> INVALID_ARGUMENT; oversized batches ->
+RESOURCE_EXHAUSTED (wired to codes in server.py via ServiceError.code).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import codec
+from ..models.registry import (
+    ModelNotFoundError,
+    Servable,
+    ServableRegistry,
+    Signature,
+    SignatureNotFoundError,
+    VersionNotFoundError,
+)
+from ..proto import serving_apis_pb2 as apis
+from ..proto import tf_framework_pb2 as fw
+from .batcher import BatchTooLargeError, DynamicBatcher
+from .example_codec import ExampleDecodeError, decode_input
+
+SIGNATURE_DEF_FIELD = "signature_def"
+
+
+class ServiceError(Exception):
+    """Carries a grpc-compatible status code name ('NOT_FOUND', ...)."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _wrap_lookup(fn):
+    try:
+        return fn()
+    except (ModelNotFoundError, VersionNotFoundError, SignatureNotFoundError) as e:
+        raise ServiceError("NOT_FOUND", str(e)) from e
+
+
+class PredictionServiceImpl:
+    """Registry + batcher -> the five PredictionService RPCs."""
+
+    def __init__(self, registry: ServableRegistry, batcher: DynamicBatcher):
+        self.registry = registry
+        self.batcher = batcher
+
+    # ------------------------------------------------------------ resolution
+
+    def _resolve(self, model_spec: apis.ModelSpec) -> tuple[Servable, Signature]:
+        if not model_spec.name:
+            raise ServiceError("INVALID_ARGUMENT", "model_spec.name is required")
+        version = model_spec.version.value if model_spec.HasField("version") else None
+        servable = _wrap_lookup(lambda: self.registry.resolve(model_spec.name, version))
+        signature = _wrap_lookup(lambda: servable.signature(model_spec.signature_name))
+        return servable, signature
+
+    def _echo_spec(self, servable: Servable, signature_name: str) -> apis.ModelSpec:
+        spec = apis.ModelSpec(name=servable.name, signature_name=signature_name)
+        spec.version.value = servable.version
+        return spec
+
+    # --------------------------------------------------------------- Predict
+
+    def _decode_and_validate(
+        self, servable: Servable, signature: Signature, inputs
+    ) -> dict[str, np.ndarray]:
+        arrays: dict[str, np.ndarray] = {}
+        specs = {s.name: s for s in signature.inputs}
+        for key in inputs:
+            if key not in specs:
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    f"unexpected input {key!r}; signature expects {sorted(specs)}",
+                )
+        n = None
+        for name, spec in specs.items():
+            if name not in inputs:
+                if name == "dense_features":
+                    continue  # optional (DLRM serves the 2-input contract too)
+                raise ServiceError("INVALID_ARGUMENT", f"missing required input {name!r}")
+            try:
+                arr = codec.to_ndarray(inputs[name])
+            except codec.CodecError as e:
+                raise ServiceError("INVALID_ARGUMENT", f"input {name!r}: {e}") from e
+            if arr.dtype != codec.dtype_to_numpy(spec.dtype):
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    f"input {name!r}: dtype {arr.dtype} != signature "
+                    f"{fw.DataType.Name(spec.dtype)}",
+                )
+            if arr.ndim != len(spec.shape) or any(
+                s is not None and s != d for s, d in zip(spec.shape, arr.shape)
+            ):
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    f"input {name!r}: shape {arr.shape} incompatible with signature "
+                    f"{spec.shape}",
+                )
+            if n is None:
+                n = arr.shape[0]
+            elif arr.shape[0] != n:
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    f"inconsistent candidate counts: {name!r} has {arr.shape[0]}, "
+                    f"expected {n}",
+                )
+            arrays[name] = arr
+        if n == 0:
+            raise ServiceError("INVALID_ARGUMENT", "empty candidate batch")
+        return arrays
+
+    def _run(
+        self,
+        servable: Servable,
+        arrays: dict[str, np.ndarray],
+        output_keys: tuple[str, ...] | None = None,
+    ) -> dict[str, np.ndarray]:
+        try:
+            # Bounded wait: a wedged batcher must not permanently consume an
+            # RPC handler thread (first compile of a large bucket through a
+            # remote-compile path can legitimately take tens of seconds).
+            return self.batcher.submit(servable, arrays, output_keys=output_keys).result(
+                timeout=120.0
+            )
+        except BatchTooLargeError as e:
+            raise ServiceError("RESOURCE_EXHAUSTED", str(e)) from e
+        except TimeoutError as e:
+            raise ServiceError("DEADLINE_EXCEEDED", "batch execution timed out") from e
+        except RuntimeError as e:
+            raise ServiceError("UNAVAILABLE", str(e)) from e
+
+    def predict(self, request: apis.PredictRequest) -> apis.PredictResponse:
+        servable, signature = self._resolve(request.model_spec)
+        if signature.method_name != "tensorflow/serving/predict":
+            raise ServiceError(
+                "INVALID_ARGUMENT",
+                f"signature {request.model_spec.signature_name!r} has method "
+                f"{signature.method_name!r}; use the matching RPC instead of Predict",
+            )
+        arrays = self._decode_and_validate(servable, signature, request.inputs)
+
+        sig_outputs = [s.name for s in signature.outputs]
+        if request.output_filter:
+            missing = [k for k in request.output_filter if k not in sig_outputs]
+            if missing:
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    f"output_filter names unknown tensors {missing}; have {sig_outputs}",
+                )
+            out_names = list(request.output_filter)
+        else:
+            out_names = sig_outputs
+        outputs = self._run(servable, arrays, output_keys=tuple(out_names))
+        produced = [k for k in out_names if k in outputs]
+        if len(produced) != len(out_names):
+            # Signature promised tensors the model never produced — a servable
+            # configuration bug, not a client error.
+            raise ServiceError(
+                "INTERNAL",
+                f"model produced {sorted(outputs)} but signature declares "
+                f"{out_names}",
+            )
+
+        resp = apis.PredictResponse()
+        resp.model_spec.CopyFrom(
+            self._echo_spec(servable, request.model_spec.signature_name or "serving_default")
+        )
+        for name in out_names:
+            resp.outputs[name].CopyFrom(codec.from_ndarray(outputs[name]))
+        return resp
+
+    # ----------------------------------------------------- Classify / Regress
+
+    def _run_examples(self, request):
+        servable, _ = self._resolve(request.model_spec)
+        try:
+            arrays = decode_input(request.input, servable.model.config.num_fields)
+        except ExampleDecodeError as e:
+            raise ServiceError("INVALID_ARGUMENT", str(e)) from e
+        outputs = self._run(servable, arrays, output_keys=("prediction_node",))
+        return servable, outputs
+
+    def classify(self, request: apis.ClassificationRequest) -> apis.ClassificationResponse:
+        servable, outputs = self._run_examples(request)
+        scores = outputs["prediction_node"]
+        resp = apis.ClassificationResponse()
+        resp.model_spec.CopyFrom(
+            self._echo_spec(servable, request.model_spec.signature_name or "classify")
+        )
+        for p in scores:
+            cls = resp.result.classifications.add()
+            cls.classes.add(label="0", score=float(1.0 - p))
+            cls.classes.add(label="1", score=float(p))
+        return resp
+
+    def regress(self, request: apis.RegressionRequest) -> apis.RegressionResponse:
+        servable, outputs = self._run_examples(request)
+        resp = apis.RegressionResponse()
+        resp.model_spec.CopyFrom(
+            self._echo_spec(servable, request.model_spec.signature_name or "regress")
+        )
+        for p in outputs["prediction_node"]:
+            resp.result.regressions.add(value=float(p))
+        return resp
+
+    # --------------------------------------------------------- MultiInference
+
+    def multi_inference(self, request: apis.MultiInferenceRequest) -> apis.MultiInferenceResponse:
+        if not request.tasks:
+            raise ServiceError("INVALID_ARGUMENT", "MultiInferenceRequest has no tasks")
+        resp = apis.MultiInferenceResponse()
+        for task in request.tasks:
+            method = task.method_name
+            if method == "tensorflow/serving/classify":
+                sub = apis.ClassificationRequest(model_spec=task.model_spec, input=request.input)
+                out = self.classify(sub)
+                r = resp.results.add()
+                r.model_spec.CopyFrom(out.model_spec)
+                r.classification_result.CopyFrom(out.result)
+            elif method == "tensorflow/serving/regress":
+                sub = apis.RegressionRequest(model_spec=task.model_spec, input=request.input)
+                out = self.regress(sub)
+                r = resp.results.add()
+                r.model_spec.CopyFrom(out.model_spec)
+                r.regression_result.CopyFrom(out.result)
+            else:
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    f"unsupported MultiInference method {method!r} "
+                    "(expected tensorflow/serving/classify or .../regress)",
+                )
+        return resp
+
+    # ------------------------------------------------------- GetModelMetadata
+
+    def get_model_metadata(
+        self, request: apis.GetModelMetadataRequest
+    ) -> apis.GetModelMetadataResponse:
+        fields = list(request.metadata_field) or [SIGNATURE_DEF_FIELD]
+        unknown = [f for f in fields if f != SIGNATURE_DEF_FIELD]
+        if unknown:
+            raise ServiceError(
+                "INVALID_ARGUMENT", f"unsupported metadata_field values {unknown}"
+            )
+        if not request.model_spec.name:
+            raise ServiceError("INVALID_ARGUMENT", "model_spec.name is required")
+        version = (
+            request.model_spec.version.value if request.model_spec.HasField("version") else None
+        )
+        servable = _wrap_lookup(lambda: self.registry.resolve(request.model_spec.name, version))
+
+        resp = apis.GetModelMetadataResponse()
+        resp.model_spec.CopyFrom(self._echo_spec(servable, ""))
+        resp.model_spec.ClearField("signature_name")
+        sig_map = apis.SignatureDefMap()
+        for name, sd in servable.signature_def_map().items():
+            sig_map.signature_def[name].CopyFrom(sd)
+        resp.metadata[SIGNATURE_DEF_FIELD].Pack(sig_map)
+        return resp

@@ -3,15 +3,26 @@
 Tests run on a virtual 8-device CPU mesh (the SURVEY.md §4 strategy:
 `xla_force_host_platform_device_count` lets pjit shardings, collective merge
 order, and per-shard numerics be validated on one host without a TPU slice).
-Environment must be set before the first `import jax` anywhere in the test
-process, which is why it lives at conftest import time.
+
+This image registers an `axon` TPU backend from sitecustomize.py and pins
+JAX_PLATFORMS=axon in the environment, so the env var alone is not enough:
+jax.config.update must also force the cpu platform before any backend is
+initialized. Import order (env first, then jax) still matters for XLA_FLAGS.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.devices()}"

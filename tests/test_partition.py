@@ -1,0 +1,69 @@
+"""Sharder property tests (SURVEY.md §4): concat-of-shards == original,
+reference split-point parity, and rejection of the flat-split misalignment."""
+
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu.client import (
+    merge_host_order,
+    partition_bounds,
+    partition_flat,
+    partition_list,
+    shard_candidates,
+)
+
+
+def test_reference_workload_split():
+    """1500 candidates x 43 fields over 3 hosts -> 500 candidates each
+    (DCNClient.java:25,29,38: the even case the reference runs)."""
+    flat = list(range(1500 * 43))
+    shards = partition_flat(flat, 3, 43)
+    assert [len(s) // 43 for s in shards] == [500, 500, 500]
+
+
+def test_remainder_goes_to_last():
+    shards = partition_list(list(range(10)), 3)
+    assert [len(s) for s in shards] == [3, 3, 4]
+    assert shards[2] == [6, 7, 8, 9]
+
+
+@pytest.mark.parametrize("n,parts", [(10, 3), (1500, 3), (7, 7), (100, 1), (11, 4)])
+def test_concat_of_shards_is_original(n, parts):
+    seq = list(range(n))
+    assert sum(partition_list(seq, parts), []) == seq
+    bounds = partition_bounds(n, parts)
+    assert bounds[0][0] == 0 and bounds[-1][1] == n
+    assert all(a[1] == b[0] for a, b in zip(bounds, bounds[1:]))
+
+
+def test_flat_misalignment_rejected():
+    """10 candidates x 43 fields over 3 hosts: shard size 143 is not a
+    multiple of 43 -> the reference would silently truncate mid-candidate
+    (DCNClient.java:97); we refuse."""
+    flat = list(range(10 * 43))
+    with pytest.raises(ValueError, match="truncate mid-candidate"):
+        partition_flat(flat, 3, 43)
+
+
+def test_row_sharding_always_aligned():
+    """Row-wise sharding handles the case flat splitting cannot."""
+    arrays = {
+        "feat_ids": np.arange(10 * 43).reshape(10, 43),
+        "feat_wts": np.ones((10, 43), np.float32),
+    }
+    shards = shard_candidates(arrays, 3)
+    assert [s["feat_ids"].shape for s in shards] == [(3, 43), (3, 43), (4, 43)]
+    merged = merge_host_order([s["feat_ids"] for s in shards])
+    np.testing.assert_array_equal(merged, arrays["feat_ids"])
+
+
+def test_inconsistent_rows_rejected():
+    with pytest.raises(ValueError, match="inconsistent"):
+        shard_candidates(
+            {"a": np.zeros((10, 2)), "b": np.zeros((9, 2))}, 2
+        )
+
+
+def test_more_parts_than_items_rejected():
+    with pytest.raises(ValueError, match="non-empty"):
+        partition_list([1, 2], 3)

@@ -1,0 +1,385 @@
+"""Serving-stack integration tests (SURVEY.md §4 integration strategy):
+in-process gRPC servers + stub models, golden-score checks vs eager JAX,
+model/version/signature resolution, error codes, the Example RPC path, and
+the fan-out client against a 3-backend set — the role the reference validated
+only manually against lab hosts (DCNClient.java:38)."""
+
+import asyncio
+
+import grpc
+import jax
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu import codec
+from distributed_tf_serving_tpu.client import (
+    ShardedPredictClient,
+    build_predict_request,
+    make_payload,
+    predict_sync,
+    run_closed_loop,
+)
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.proto import PredictionServiceStub
+from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+from distributed_tf_serving_tpu.serving import (
+    DynamicBatcher,
+    PredictionServiceImpl,
+    ServiceError,
+    create_server,
+    make_example,
+)
+from distributed_tf_serving_tpu.serving.batcher import fold_ids_host
+
+CFG = ModelConfig(
+    num_fields=8, vocab_size=1009, embed_dim=4, mlp_dims=(16,), num_cross_layers=1,
+    compute_dtype="float32",
+)
+
+
+def _servable(version=1, seed=0):
+    model = build_model("dcn_v2", CFG)
+    return Servable(
+        name="DCN", version=version, model=model,
+        params=model.init(jax.random.PRNGKey(seed)),
+        signatures=ctr_signatures(CFG.num_fields),
+    )
+
+
+@pytest.fixture(scope="module")
+def stack():
+    registry = ServableRegistry()
+    registry.load(_servable(version=1, seed=0))
+    registry.load(_servable(version=3, seed=1))
+    batcher = DynamicBatcher(buckets=(32, 128), max_wait_us=0).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    server, port = create_server(impl, "127.0.0.1:0")
+    server.start()
+    yield registry, impl, port
+    server.stop(0)
+    batcher.stop()
+
+
+def _arrays(n=10, seed=3):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, 1 << 40, size=(n, CFG.num_fields)).astype(np.int64),
+        "feat_wts": rng.rand(n, CFG.num_fields).astype(np.float32),
+    }
+
+
+def _golden(servable, arrays):
+    batch = {
+        "feat_ids": fold_ids_host(arrays["feat_ids"], CFG.vocab_size),
+        "feat_wts": arrays["feat_wts"],
+    }
+    return np.asarray(servable.model.apply(servable.params, batch)["prediction_node"])
+
+
+# ------------------------------------------------------------------ Predict
+
+
+def test_predict_golden_scores(stack):
+    registry, impl, port = stack
+    arrays = _arrays()
+    resp = impl.predict(build_predict_request(arrays, "DCN"))
+    got = codec.to_ndarray(resp.outputs["prediction_node"])
+    np.testing.assert_allclose(got, _golden(registry.resolve("DCN"), arrays), rtol=1e-6)
+    assert resp.model_spec.name == "DCN"
+    assert resp.model_spec.version.value == 3  # latest
+
+
+def test_predict_version_pinning(stack):
+    registry, impl, _ = stack
+    arrays = _arrays()
+    r1 = impl.predict(build_predict_request(arrays, "DCN", version=1))
+    r3 = impl.predict(build_predict_request(arrays, "DCN", version=3))
+    assert r1.model_spec.version.value == 1
+    a1 = codec.to_ndarray(r1.outputs["prediction_node"])
+    a3 = codec.to_ndarray(r3.outputs["prediction_node"])
+    assert not np.allclose(a1, a3)  # different param seeds
+    np.testing.assert_allclose(a1, _golden(registry.resolve("DCN", 1), arrays), rtol=1e-6)
+
+
+def test_predict_output_filter(stack):
+    _, impl, _ = stack
+    resp = impl.predict(build_predict_request(_arrays(), "DCN", output_filter=("logits",)))
+    assert set(resp.outputs) == {"logits"}
+
+
+def test_predict_repeated_field_encoding(stack):
+    """The grpc-java encoding path (int64_val/float_val, DCNClient.java:98-108)
+    must produce identical scores to tensor_content."""
+    _, impl, _ = stack
+    arrays = _arrays()
+    a = impl.predict(build_predict_request(arrays, "DCN", use_tensor_content=True))
+    b = impl.predict(build_predict_request(arrays, "DCN", use_tensor_content=False))
+    np.testing.assert_array_equal(
+        codec.to_ndarray(a.outputs["prediction_node"]),
+        codec.to_ndarray(b.outputs["prediction_node"]),
+    )
+
+
+@pytest.mark.parametrize(
+    "mutate,code",
+    [
+        (lambda r: r.model_spec.ClearField("name"), "INVALID_ARGUMENT"),
+        (lambda r: setattr(r.model_spec, "name", "nope"), "NOT_FOUND"),
+        (lambda r: setattr(r.model_spec.version, "value", 99), "NOT_FOUND"),
+        (lambda r: setattr(r.model_spec, "signature_name", "nope"), "NOT_FOUND"),
+        (lambda r: r.inputs["feat_ids"].int64_val.append(0), "INVALID_ARGUMENT"),
+        (lambda r: r.inputs.pop("feat_wts"), "INVALID_ARGUMENT"),
+        (lambda r: r.output_filter.append("nope"), "INVALID_ARGUMENT"),
+    ],
+    ids=["no-name", "unknown-model", "unknown-version", "unknown-signature",
+         "corrupt-tensor", "missing-input", "bad-filter"],
+)
+def test_predict_errors(stack, mutate, code):
+    _, impl, _ = stack
+    req = build_predict_request(_arrays(), "DCN", use_tensor_content=False)
+    mutate(req)
+    with pytest.raises(ServiceError) as ei:
+        impl.predict(req)
+    assert ei.value.code == code
+
+
+def test_predict_on_classify_signature_rejected(stack):
+    """The classify/regress signatures declare outputs the raw model doesn't
+    produce; Predict against them must be a clean client error, not an empty
+    response."""
+    _, impl, _ = stack
+    req = build_predict_request(_arrays(), "DCN", signature_name="classify")
+    with pytest.raises(ServiceError) as ei:
+        impl.predict(req)
+    assert ei.value.code == "INVALID_ARGUMENT"
+    assert "Predict" in str(ei.value)
+
+
+def test_wrong_dtype_rejected(stack):
+    _, impl, _ = stack
+    arrays = _arrays()
+    arrays["feat_wts"] = arrays["feat_wts"].astype(np.float64)
+    req = build_predict_request(arrays, "DCN")
+    with pytest.raises(ServiceError, match="dtype"):
+        impl.predict(req)
+
+
+def test_wrong_field_count_rejected(stack):
+    _, impl, _ = stack
+    rng = np.random.RandomState(0)
+    arrays = {
+        "feat_ids": rng.randint(0, 100, size=(4, 5)).astype(np.int64),
+        "feat_wts": rng.rand(4, 5).astype(np.float32),
+    }
+    with pytest.raises(ServiceError, match="shape"):
+        impl.predict(build_predict_request(arrays, "DCN"))
+
+
+# ----------------------------------------------------- Example path RPCs
+
+
+def _example_input(n=6, seed=5):
+    arrays = _arrays(n, seed)
+    inp = apis.Input()
+    for i in range(n):
+        inp.example_list.examples.append(
+            make_example(arrays["feat_ids"][i], arrays["feat_wts"][i])
+        )
+    return arrays, inp
+
+
+def test_classify(stack):
+    registry, impl, _ = stack
+    arrays, inp = _example_input()
+    req = apis.ClassificationRequest(input=inp)
+    req.model_spec.name = "DCN"
+    resp = impl.classify(req)
+    want = _golden(registry.resolve("DCN"), arrays)
+    assert len(resp.result.classifications) == 6
+    for cls, p in zip(resp.result.classifications, want):
+        assert cls.classes[1].label == "1"
+        assert cls.classes[1].score == pytest.approx(p, rel=1e-5)
+        assert cls.classes[0].score + cls.classes[1].score == pytest.approx(1.0, abs=1e-5)
+
+
+def test_regress(stack):
+    registry, impl, _ = stack
+    arrays, inp = _example_input()
+    req = apis.RegressionRequest(input=inp)
+    req.model_spec.name = "DCN"
+    resp = impl.regress(req)
+    want = _golden(registry.resolve("DCN"), arrays)
+    got = np.array([r.value for r in resp.result.regressions])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_multi_inference(stack):
+    _, impl, _ = stack
+    _, inp = _example_input()
+    req = apis.MultiInferenceRequest(input=inp)
+    t1 = req.tasks.add(method_name="tensorflow/serving/classify")
+    t1.model_spec.name = "DCN"
+    t2 = req.tasks.add(method_name="tensorflow/serving/regress")
+    t2.model_spec.name = "DCN"
+    resp = impl.multi_inference(req)
+    assert len(resp.results) == 2
+    assert resp.results[0].WhichOneof("result") == "classification_result"
+    assert resp.results[1].WhichOneof("result") == "regression_result"
+
+
+def test_example_with_context(stack):
+    """Context features fill gaps (two-tower pattern): examples carry only
+    ids, context carries the weights."""
+    registry, impl, _ = stack
+    arrays = _arrays(3, seed=9)
+    shared_wts = arrays["feat_wts"][0]
+    inp = apis.Input()
+    for i in range(3):
+        inp.example_list_with_context.examples.append(make_example(arrays["feat_ids"][i]))
+    inp.example_list_with_context.context.CopyFrom(make_example([], shared_wts))
+    inp.example_list_with_context.context.features.feature["feat_ids"].Clear()
+    req = apis.RegressionRequest(input=inp)
+    req.model_spec.name = "DCN"
+    resp = impl.regress(req)
+    want_arrays = {
+        "feat_ids": arrays["feat_ids"],
+        "feat_wts": np.broadcast_to(shared_wts, arrays["feat_ids"].shape).copy(),
+    }
+    want = _golden(registry.resolve("DCN"), want_arrays)
+    got = np.array([r.value for r in resp.result.regressions])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_bad_example_rejected(stack):
+    _, impl, _ = stack
+    inp = apis.Input()
+    inp.example_list.examples.append(make_example([1, 2]))  # wrong field count
+    req = apis.ClassificationRequest(input=inp)
+    req.model_spec.name = "DCN"
+    with pytest.raises(ServiceError) as ei:
+        impl.classify(req)
+    assert ei.value.code == "INVALID_ARGUMENT"
+
+
+# ------------------------------------------------------- GetModelMetadata
+
+
+def test_get_model_metadata(stack):
+    _, impl, _ = stack
+    req = apis.GetModelMetadataRequest()
+    req.model_spec.name = "DCN"
+    req.metadata_field.append("signature_def")
+    resp = impl.get_model_metadata(req)
+    assert resp.model_spec.version.value == 3
+    sig_map = apis.SignatureDefMap()
+    assert resp.metadata["signature_def"].Unpack(sig_map)
+    sd = sig_map.signature_def["serving_default"]
+    assert sd.method_name == "tensorflow/serving/predict"
+    assert sd.inputs["feat_ids"].dtype == 9  # DT_INT64
+    assert [d.size for d in sd.inputs["feat_ids"].tensor_shape.dim] == [-1, 8]
+    assert "prediction_node" in sd.outputs
+
+
+# ------------------------------------------------------------ gRPC socket
+
+
+def test_grpc_socket_roundtrip_and_status_codes(stack):
+    _, _, port = stack
+    out = predict_sync(f"127.0.0.1:{port}", _arrays(), "DCN")
+    assert out["prediction_node"].shape == (10,)
+
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        stub = PredictionServiceStub(ch)
+        req = build_predict_request(_arrays(), "unknown-model")
+        with pytest.raises(grpc.RpcError) as ei:
+            stub.Predict(req, timeout=10)
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+        bad = build_predict_request(_arrays(), "DCN", use_tensor_content=False)
+        bad.inputs["feat_ids"].int64_val.append(0)
+        with pytest.raises(grpc.RpcError) as ei:
+            stub.Predict(bad, timeout=10)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+# ------------------------------------------------- fan-out client (3 hosts)
+
+
+@pytest.fixture(scope="module")
+def three_backends():
+    """Three independent in-process servers sharing one param seed — the
+    fake-backend stand-in for the reference's three lab hosts."""
+    servers, hosts = [], []
+    batchers = []
+    for _ in range(3):
+        registry = ServableRegistry()
+        registry.load(_servable(version=1, seed=0))
+        batcher = DynamicBatcher(buckets=(32, 128), max_wait_us=0).start()
+        impl = PredictionServiceImpl(registry, batcher)
+        server, port = create_server(impl, "127.0.0.1:0")
+        server.start()
+        servers.append(server)
+        batchers.append(batcher)
+        hosts.append(f"127.0.0.1:{port}")
+    yield hosts
+    for s in servers:
+        s.stop(0)
+    for b in batchers:
+        b.stop()
+
+
+def test_fanout_merge_order_and_sort(three_backends):
+    """Host-order merge must equal the unsharded scores (DCNClient.java:161-164
+    semantics); sort_scores reproduces the ranking step (DCNClient.java:195)."""
+    servable = _servable(version=1, seed=0)
+    arrays = _arrays(n=10, seed=11)
+    want = _golden(servable, arrays)
+
+    async def go():
+        async with ShardedPredictClient(three_backends, "DCN") as client:
+            merged = await client.predict(arrays)
+            ranked = await client.predict(arrays, sort_scores=True)
+            return merged, ranked
+
+    merged, ranked = asyncio.run(go())
+    np.testing.assert_allclose(merged, want, rtol=1e-6)
+    # rtol (not bitwise): row position inside the padded bucket shifts SIMD
+    # lane grouping on CPU, perturbing the last ulp.
+    np.testing.assert_allclose(ranked, np.sort(want), rtol=1e-6)
+
+
+def test_closed_loop_bench_smoke(three_backends):
+    payload = make_payload(candidates=30, num_fields=CFG.num_fields)
+
+    async def go():
+        async with ShardedPredictClient(three_backends, "DCN") as client:
+            return await run_closed_loop(
+                client, payload, concurrency=2, requests_per_worker=5, warmup_requests=1
+            )
+
+    report = asyncio.run(go())
+    s = report.summary()
+    assert s["requests"] == 10
+    assert s["candidates_per_request"] == 30
+    assert s["p99_ms"] >= s["p50_ms"] > 0
+    assert s["qps"] > 0
+
+
+def test_fanout_failure_is_typed(three_backends):
+    from distributed_tf_serving_tpu.client import PredictClientError
+
+    hosts = list(three_backends[:2]) + ["127.0.0.1:1"]  # dead backend
+
+    async def go():
+        async with ShardedPredictClient(hosts, "DCN", timeout_s=2.0) as client:
+            await client.predict(_arrays(n=9))
+
+    with pytest.raises(PredictClientError) as ei:
+        asyncio.run(go())
+    assert ei.value.host == "127.0.0.1:1"

@@ -1,0 +1,82 @@
+"""Transfer-compression tests: u24 id packing is exact, bf16 weight packing
+is bit-identical to the model's own bf16 cast, and the batcher produces the
+same scores with compression on and off."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu.models import ModelConfig, Servable, build_model, ctr_signatures
+from distributed_tf_serving_tpu.ops.transfer import pack_host, transfer_spec, unpack_device
+from distributed_tf_serving_tpu.serving import DynamicBatcher
+
+
+def test_u24_roundtrip_exact():
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 1 << 24, size=(7, 43)).astype(np.int32)
+    spec = {"feat_ids": "u24"}
+    packed = pack_host({"feat_ids": ids}, spec)
+    assert packed["feat_ids"].shape == (7, 43, 3)
+    assert packed["feat_ids"].nbytes == ids.nbytes * 3 // 4
+    out = np.asarray(unpack_device({"feat_ids": packed["feat_ids"]}, spec)["feat_ids"])
+    np.testing.assert_array_equal(out, ids)
+
+
+def test_u24_boundary_values():
+    ids = np.array([[0, 1, (1 << 24) - 1, 12345678]], np.int32)
+    spec = {"feat_ids": "u24"}
+    out = np.asarray(unpack_device(pack_host({"feat_ids": ids}, spec), spec)["feat_ids"])
+    np.testing.assert_array_equal(out, ids)
+
+
+def test_spec_follows_model():
+    assert transfer_spec(
+        build_model("dcn_v2", ModelConfig(vocab_size=1 << 20, compute_dtype="bfloat16"))
+    ) == {"feat_ids": "u24", "feat_wts": "bf16"}
+    # Big vocab: ids can't shrink; f32 parity mode: weights can't shrink.
+    assert (
+        transfer_spec(
+            build_model("dcn_v2", ModelConfig(vocab_size=1 << 25, compute_dtype="float32"))
+        )
+        == {}
+    )
+
+
+def test_spec_respects_f32_weight_consumers():
+    """wide_deep/deepfm consume raw f32 weights in their sparse-linear term;
+    bf16 weight compression would change their scores and must not engage."""
+    cfg = ModelConfig(vocab_size=1 << 20, compute_dtype="bfloat16")
+    for kind in ("wide_deep", "deepfm"):
+        assert transfer_spec(build_model(kind, cfg)) == {"feat_ids": "u24"}, kind
+    for kind in ("dcn", "dcn_v2", "two_tower", "dlrm"):
+        assert transfer_spec(build_model(kind, cfg))["feat_wts"] == "bf16", kind
+
+
+@pytest.mark.parametrize("kind", ["dcn_v2", "wide_deep"])
+@pytest.mark.parametrize("compute_dtype", ["bfloat16", "float32"])
+def test_batcher_scores_identical_with_compression(compute_dtype, kind):
+    cfg = ModelConfig(
+        num_fields=8, vocab_size=1 << 16, embed_dim=4, mlp_dims=(16,),
+        num_cross_layers=1, compute_dtype=compute_dtype,
+    )
+    model = build_model(kind, cfg)
+    sv = Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(cfg.num_fields),
+    )
+    rng = np.random.RandomState(1)
+    arrays = {
+        "feat_ids": rng.randint(0, 1 << 40, size=(11, 8)).astype(np.int64),
+        "feat_wts": rng.rand(11, 8).astype(np.float32),
+    }
+    results = {}
+    for compress in (True, False):
+        b = DynamicBatcher(buckets=(32,), max_wait_us=0, compress_transfer=compress).start()
+        try:
+            results[compress] = b.submit(sv, dict(arrays)).result(timeout=30)["prediction_node"]
+        finally:
+            b.stop()
+    # bf16 path: the model casts weights to bf16 anyway, so pre-casting on
+    # host is bit-identical; f32 path: spec only packs ids, which is exact.
+    np.testing.assert_array_equal(results[True], results[False])
