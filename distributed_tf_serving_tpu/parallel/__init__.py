@@ -1,0 +1,28 @@
+"""Parallel layer: device mesh, shardings, sharded executor, EP lookups."""
+
+from .embedding_sharding import sharded_field_embed
+from .executor import ShardedExecutor, shard_map_score
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    candidate_sharding,
+    make_mesh,
+    replicated,
+    vocab_sharding,
+)
+from .sharding import batch_shardings, param_shardings, place_params
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "candidate_sharding",
+    "replicated",
+    "vocab_sharding",
+    "param_shardings",
+    "batch_shardings",
+    "place_params",
+    "ShardedExecutor",
+    "shard_map_score",
+    "sharded_field_embed",
+]

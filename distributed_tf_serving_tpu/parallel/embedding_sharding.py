@@ -1,0 +1,67 @@
+"""Vocab-sharded embedding lookups — the EP analog (SURVEY.md §2.4).
+
+Two equivalent paths are provided:
+
+1. The *annotation* path (executor.py): shard the table NamedSharding
+   P("model", None), leave the model's jnp.take as-is, and let XLA's SPMD
+   partitioner derive the masked-gather + psum. Idiomatic, zero model
+   changes — this is what serving uses.
+
+2. The *explicit* path here: shard_map over the mesh where each chip holds
+   vocab/k contiguous rows, looks up only in-shard ids (clipped gather +
+   mask), and psums partial embeddings over the model axis. This is the
+   reference-visible semantics made manual — the scatter the Java client did
+   per host (DCNClient.java:146-159) happens on-mesh — and it pins down the
+   contract the annotation path must match (test_parallel.py asserts
+   equality), while being the hook point for a Pallas lookup kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+def sharded_field_embed(
+    table: jax.Array,
+    feat_ids: jax.Array,
+    feat_wts: jax.Array,
+    mesh: Mesh,
+    compute_dtype,
+) -> jax.Array:
+    """Weighted field lookup with the table sharded over the model axis and
+    candidates sharded over the data axis.
+
+    table     [V, D] (V divisible by mesh model-axis size)
+    feat_ids  [n, F] int32, already folded into [0, V)
+    feat_wts  [n, F] float
+    returns   [n, F, D] in compute_dtype, candidate-sharded
+    """
+    vocab = table.shape[0]
+    k = mesh.shape[MODEL_AXIS]
+    if vocab % k != 0:
+        raise ValueError(f"vocab {vocab} not divisible by model-axis size {k}")
+
+    def local(table_shard, ids_blk, wts_blk):
+        # table_shard: [V/k, D] — this chip's contiguous vocab rows.
+        vshard = table_shard.shape[0]
+        lo = jax.lax.axis_index(MODEL_AXIS) * vshard
+        local_ids = ids_blk - lo
+        in_shard = (local_ids >= 0) & (local_ids < vshard)
+        # Clipped gather stays in-bounds; the mask zeroes out-of-shard rows,
+        # so the psum over the model axis reassembles exact lookups.
+        emb = jnp.take(table_shard, jnp.clip(local_ids, 0, vshard - 1), axis=0)
+        emb = jnp.where(in_shard[..., None], emb, jnp.zeros((), emb.dtype))
+        emb = jax.lax.psum(emb, MODEL_AXIS)
+        return emb.astype(compute_dtype) * wts_blk[..., None].astype(compute_dtype)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=P(DATA_AXIS, None, None),
+    )(table, feat_ids, feat_wts)

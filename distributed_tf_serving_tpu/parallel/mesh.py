@@ -1,0 +1,63 @@
+"""Device mesh construction and sharding vocabulary.
+
+The reference's distribution fabric is a client-rooted scatter/gather over
+per-host gRPC channels (SURVEY.md §2.5, DCNClient.java:118-125,146-164). The
+TPU-native replacement is a jax.sharding.Mesh over the slice's chips with
+named axes; XLA inserts the ICI collectives implied by the sharding
+annotations.
+
+Axis conventions (the recsys analogs of tp/dp/ep from SURVEY.md §2.4):
+- "data":  candidate/batch dimension — the reference's candidate sharding
+           (its only real strategy) becomes a NamedSharding over this axis.
+- "model": embedding vocab rows — the EP analog: DLRM/two-tower tables are
+           sharded over this axis and looked up via masked local gathers +
+           psum (see embedding_sharding.py).
+
+A v5e-8 slice is the target point (BASELINE.md); tests exercise the same
+code on 8 virtual CPU devices.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    model_parallel: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ("data", "model") mesh over the first n devices.
+
+    model_parallel chips shard embedding vocab; the rest of the factorization
+    shards candidates. model_parallel=1 gives pure candidate sharding (the
+    reference-equivalent layout).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    if n % model_parallel != 0:
+        raise ValueError(f"n_devices={n} not divisible by model_parallel={model_parallel}")
+    grid = np.asarray(devs[:n]).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def candidate_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows (candidates) split over the data axis — the on-mesh equivalent of
+    partitionList's per-host contiguous shards (DCNClient.java:46-55)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def vocab_sharding(mesh: Mesh) -> NamedSharding:
+    """Embedding tables: vocab rows split over the model axis (EP analog)."""
+    return NamedSharding(mesh, P(MODEL_AXIS, None))

@@ -1,0 +1,17 @@
+"""Training: sharded optax loop, synthetic CTR data, servable checkpoints."""
+
+from .checkpoint import load_servable, save_servable
+from .data import SyntheticCTRConfig, SyntheticCTRStream, auc
+from .trainer import Trainer, TrainState, bce_with_logits, make_train_step
+
+__all__ = [
+    "Trainer",
+    "TrainState",
+    "make_train_step",
+    "bce_with_logits",
+    "SyntheticCTRStream",
+    "SyntheticCTRConfig",
+    "auc",
+    "save_servable",
+    "load_servable",
+]

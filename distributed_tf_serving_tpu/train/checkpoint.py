@@ -1,0 +1,72 @@
+"""Servable checkpointing: params + model metadata on disk.
+
+The reference's checkpoint story is the vendored SaverDef schema consumed by
+the external SavedModel loader (saver.proto:11-47, meta_graph.proto:75 —
+SURVEY.md §5); serving itself is stateless. Here the equivalent is direct:
+an Orbax param checkpoint next to a JSON manifest (model kind + ModelConfig
++ name/version), from which load_servable reconstructs a registry-ready
+Servable. Sharded param trees save/restore transparently (Orbax records
+layouts; restore_args can re-place onto a mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..models.base import ModelConfig, build_model
+from ..models.registry import Servable, ctr_signatures
+
+MANIFEST = "servable.json"
+PARAMS_DIR = "params"
+
+
+def save_servable(path, servable: Servable, kind: str) -> None:
+    """Write params + manifest. `kind` is the model-zoo family name."""
+    path = pathlib.Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    manifest = {
+        "name": servable.name,
+        "version": servable.version,
+        "kind": kind,
+        "config": dataclasses.asdict(servable.model.config),
+    }
+    (path / MANIFEST).write_text(json.dumps(manifest, indent=2))
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save((path / PARAMS_DIR).absolute(), servable.params, force=True)
+
+
+def load_servable(path, mesh=None) -> Servable:
+    """Reconstruct a Servable; with a mesh, params restore pre-placed
+    (vocab tables over the model axis) instead of replicated."""
+    path = pathlib.Path(path)
+    manifest = json.loads((path / MANIFEST).read_text())
+    config = ModelConfig(**{**manifest["config"], "mlp_dims": tuple(manifest["config"]["mlp_dims"]),
+                            "bottom_mlp_dims": tuple(manifest["config"]["bottom_mlp_dims"])})
+    model = build_model(manifest["kind"], config)
+
+    target = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    if mesh is not None:
+        from ..parallel.sharding import param_shardings
+
+        shardings = param_shardings(target, mesh)
+        target = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            target,
+            shardings,
+        )
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore((path / PARAMS_DIR).absolute(), target)
+
+    dense = config.num_dense_features if manifest["kind"] == "dlrm" else None
+    return Servable(
+        name=manifest["name"],
+        version=manifest["version"],
+        model=model,
+        params=params,
+        signatures=ctr_signatures(config.num_fields, with_dense=dense),
+    )

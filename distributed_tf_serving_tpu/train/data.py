@@ -1,0 +1,85 @@
+"""Synthetic CTR data — a deterministic Criteo-like stream.
+
+The reference repo has no training or data pipeline (models arrive as
+external SavedModels, SURVEY.md §0); the framework still needs labeled
+batches to train the in-tree model zoo and to run AUC-parity checks
+(BASELINE.md). Labels come from a fixed random "teacher": each (field, id)
+pair contributes a hash-derived weight, the row score is their
+feature-weighted sum, and the label is Bernoulli(sigmoid(score)) — so every
+model family has learnable signal and a known Bayes-optimal ranking to
+measure AUC against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticCTRConfig:
+    num_fields: int = 43  # FIELD_NUM, DCNClient.java:25
+    # Finite id catalog: ids must recur across batches or nothing
+    # generalizes (the teacher keys on raw ids; an id seen only once carries
+    # no transferable signal). Sized to fit common vocab settings so the
+    # model-side fold stays injective.
+    id_space: int = 1 << 18
+    # Scaled so the teacher logit std lands ~3.5 (Bayes AUC ~0.9): a test
+    # that "training learns" needs a ceiling well clear of coin-flip.
+    teacher_scale: float = 6.0
+    seed: int = 0
+
+
+class SyntheticCTRStream:
+    """Deterministic batch generator: batch(i) is reproducible for any i."""
+
+    def __init__(self, config: SyntheticCTRConfig = SyntheticCTRConfig()):
+        self.config = config
+        # Teacher weights live in a small hashed space so scores depend on
+        # ids through a fixed pseudo-random map.
+        rng = np.random.RandomState(config.seed)
+        self._teacher = rng.randn(1 << 16).astype(np.float32) * config.teacher_scale
+
+    def _teacher_score(self, ids: np.ndarray, wts: np.ndarray) -> np.ndarray:
+        # Fibonacci hash in uint64 (the multiplier exceeds int64 range).
+        h = (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(48)
+        w = self._teacher[(h & np.uint64(0xFFFF)).astype(np.int64)]
+        # sum/sqrt(F): logit variance independent of field count.
+        return (w * wts).sum(axis=1) / np.sqrt(wts.shape[1])
+
+    def batch(self, batch_size: int, index: int) -> dict[str, np.ndarray]:
+        cfg = self.config
+        rng = np.random.RandomState((cfg.seed * 1_000_003 + index) & 0x7FFFFFFF)
+        ids = rng.randint(0, cfg.id_space, size=(batch_size, cfg.num_fields)).astype(np.int64)
+        wts = rng.rand(batch_size, cfg.num_fields).astype(np.float32)
+        score = self._teacher_score(ids, wts)
+        labels = (rng.rand(batch_size) < _sigmoid(score)).astype(np.float32)
+        return {"feat_ids": ids, "feat_wts": wts, "labels": labels}
+
+
+def _sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Rank-based AUC (Mann-Whitney U), ties handled by average rank — the
+    parity metric from BASELINE.md."""
+    labels = np.asarray(labels).astype(np.float64)
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    sorted_scores = np.asarray(scores)[order]
+    # average ranks for ties
+    i = 0
+    n = len(sorted_scores)
+    while i < n:
+        j = i
+        while j + 1 < n and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i : j + 1]] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    pos = labels.sum()
+    neg = n - pos
+    if pos == 0 or neg == 0:
+        raise ValueError("AUC undefined: single-class labels")
+    return float((ranks[labels == 1].sum() - pos * (pos + 1) / 2) / (pos * neg))

@@ -1,0 +1,144 @@
+"""Sharded training loop for the CTR model zoo.
+
+The reference has no training (SURVEY.md §0: models are externally-exported
+SavedModels); the framework closes that gap so served models can be produced
+in-tree. TPU-first mechanics:
+
+- One jitted train step (BCE-with-logits via optax, adamw default), gradients
+  under the same bf16-compute/f32-accumulate numerics as serving.
+- Sharding by placement: params are laid out by parallel.sharding
+  (vocab-major tables split over the model axis, rest replicated) and
+  batches candidate-sharded over the data axis; the jitted step inherits
+  those layouts, so XLA emits the dp gradient psums and EP gather/scatter
+  collectives without explicit pmap/shard_map code.
+- donate_argnums on the state keeps HBM flat across steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from ..models.base import Model
+from ..parallel.sharding import batch_shardings, place_params
+from ..serving.batcher import fold_ids_host
+from .data import SyntheticCTRConfig, SyntheticCTRStream, auc
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray  # scalar int32
+
+
+def bce_with_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    # Numerically-stable sigmoid cross-entropy in f32.
+    logits = logits.astype(jnp.float32)
+    labels = labels.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def make_train_step(model: Model, optimizer: optax.GradientTransformation):
+    """Build the jitted (state, batch) -> (state, metrics) step."""
+
+    def loss_fn(params, batch):
+        out = model.apply(params, batch)
+        loss = bce_with_logits(out["logits"], batch["labels"])
+        return loss, out["logits"]
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "accuracy": jnp.mean(
+                (jax.nn.sigmoid(logits.astype(jnp.float32)) > 0.5)
+                == (batch["labels"] > 0.5)
+            ),
+        }
+        return TrainState(params=params, opt_state=opt_state, step=state.step + 1), metrics
+
+    return jax.jit(step, donate_argnums=0)
+
+
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["params", "opt_state", "step"], meta_fields=[]
+)
+
+
+class Trainer:
+    """Synthetic-data training orchestrator (also drives the parity harness)."""
+
+    def __init__(
+        self,
+        model: Model,
+        mesh: Mesh | None = None,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.mesh = mesh
+        self.optimizer = optax.adamw(learning_rate)
+        params = jax.jit(model.init)(jax.random.PRNGKey(seed))
+        if mesh is not None:
+            params = place_params(params, mesh)
+        opt_state = jax.jit(self.optimizer.init)(params)
+        self.state = TrainState(params=params, opt_state=opt_state, step=jnp.asarray(0))
+        self.step_fn = make_train_step(model, self.optimizer)
+        self._eval_apply = jax.jit(model.apply)  # compiled once, reused per eval
+        self.stream = SyntheticCTRStream(
+            SyntheticCTRConfig(
+                num_fields=model.config.num_fields,
+                # Keep the catalog within the vocab so folding is injective
+                # and every id's embedding can learn its teacher weight.
+                id_space=min(1 << 18, model.config.vocab_size),
+                seed=seed,
+            )
+        )
+
+    def _prepare(self, batch: dict[str, np.ndarray]) -> dict[str, jnp.ndarray]:
+        out = {
+            "feat_ids": fold_ids_host(batch["feat_ids"], self.model.config.vocab_size),
+            "feat_wts": batch["feat_wts"],
+            "labels": batch["labels"],
+        }
+        if self.mesh is not None:
+            out = jax.device_put(out, batch_shardings(out, self.mesh))
+        return out
+
+    def fit(self, steps: int, batch_size: int = 512, log_every: int = 0) -> dict:
+        metrics = {}
+        t0 = time.perf_counter()
+        for i in range(steps):
+            batch = self._prepare(self.stream.batch(batch_size, i))
+            self.state, metrics = self.step_fn(self.state, batch)
+            if log_every and (i + 1) % log_every == 0:
+                print(f"step {i + 1}: loss={float(metrics['loss']):.4f}")
+        jax.block_until_ready(self.state.params)
+        wall = time.perf_counter() - t0
+        return {
+            "steps": steps,
+            "wall_s": wall,
+            "examples_per_s": steps * batch_size / wall,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def eval_auc(self, batches: int = 8, batch_size: int = 1024, offset: int = 1_000_000) -> float:
+        scores, labels = [], []
+        apply = self._eval_apply
+        for i in range(batches):
+            raw = self.stream.batch(batch_size, offset + i)
+            batch = self._prepare(raw)
+            out = apply(self.state.params, {k: batch[k] for k in ("feat_ids", "feat_wts")})
+            scores.append(np.asarray(out["prediction_node"]))
+            labels.append(raw["labels"])
+        return auc(np.concatenate(labels), np.concatenate(scores))

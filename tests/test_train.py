@@ -1,0 +1,102 @@
+"""Training tests: loss decreases, AUC beats random, sharded step works on
+the 8-device mesh, checkpoints round-trip into servables."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu.models import ModelConfig, build_model
+from distributed_tf_serving_tpu.parallel import make_mesh
+from distributed_tf_serving_tpu.train import Trainer, auc, load_servable, save_servable
+from distributed_tf_serving_tpu.train.data import SyntheticCTRStream
+
+CFG = ModelConfig(
+    num_fields=8, vocab_size=4096, embed_dim=8, mlp_dims=(32, 16),
+    bottom_mlp_dims=(16, 8), num_cross_layers=2, compute_dtype="float32",
+)
+
+
+def test_auc_metric():
+    labels = np.array([0, 0, 1, 1])
+    assert auc(labels, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert auc(labels, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+    assert auc(labels, np.array([0.5, 0.5, 0.5, 0.5])) == 0.5
+
+
+def test_synthetic_stream_deterministic():
+    s1, s2 = SyntheticCTRStream(), SyntheticCTRStream()
+    b1, b2 = s1.batch(16, 3), s2.batch(16, 3)
+    np.testing.assert_array_equal(b1["feat_ids"], b2["feat_ids"])
+    np.testing.assert_array_equal(b1["labels"], b2["labels"])
+    assert 0.05 < b1["labels"].mean() < 0.95  # both classes present
+
+
+def test_training_learns():
+    trainer = Trainer(build_model("dcn_v2", CFG), seed=1, learning_rate=1e-2)
+    before = trainer.eval_auc(batches=2, batch_size=512)
+    first = trainer.fit(steps=80, batch_size=512)
+    after_auc = trainer.eval_auc(batches=2, batch_size=512)
+    # Synthetic task's Bayes AUC is ~0.93; 80 steps reaches ~0.7 — the test
+    # asserts real generalization, not the ceiling.
+    assert after_auc > max(before + 0.05, 0.62), (before, after_auc)
+    assert int(trainer.state.step) == 80
+    assert np.isfinite(first["loss"])
+
+
+@pytest.mark.parametrize("model_parallel", [1, 2])
+def test_sharded_training_matches_semantics(model_parallel):
+    """Same seed, same data: mesh-sharded training must track the
+    single-placement run (dp grad psum + EP collectives are exact)."""
+    t_plain = Trainer(build_model("dcn_v2", CFG), seed=2)
+    t_mesh = Trainer(
+        build_model("dcn_v2", CFG), mesh=make_mesh(8, model_parallel=model_parallel), seed=2
+    )
+    m_plain = t_plain.fit(steps=5, batch_size=128)
+    m_mesh = t_mesh.fit(steps=5, batch_size=128)
+    assert m_mesh["loss"] == pytest.approx(m_plain["loss"], rel=2e-4)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from distributed_tf_serving_tpu.models import Servable, ctr_signatures
+
+    model = build_model("dcn_v2", CFG)
+    sv = Servable(
+        name="DCN", version=7, model=model,
+        params=model.init(jax.random.PRNGKey(3)),
+        signatures=ctr_signatures(CFG.num_fields),
+    )
+    save_servable(tmp_path / "ckpt", sv, kind="dcn_v2")
+    loaded = load_servable(tmp_path / "ckpt")
+    assert loaded.name == "DCN" and loaded.version == 7
+    # Compare to the built model's config (build_model("dcn_v2") flips
+    # cross_full_matrix on), not the pre-build CFG.
+    assert loaded.model.config == sv.model.config
+    rng = np.random.RandomState(0)
+    batch = {
+        "feat_ids": rng.randint(0, CFG.vocab_size, size=(6, 8)).astype(np.int32),
+        "feat_wts": rng.rand(6, 8).astype(np.float32),
+    }
+    np.testing.assert_array_equal(
+        np.asarray(sv.model.apply(sv.params, batch)["prediction_node"]),
+        np.asarray(loaded.model.apply(loaded.params, batch)["prediction_node"]),
+    )
+
+
+def test_checkpoint_restores_onto_mesh(tmp_path):
+    from distributed_tf_serving_tpu.models import Servable, ctr_signatures
+    from distributed_tf_serving_tpu.parallel import MODEL_AXIS
+
+    model = build_model("dcn_v2", CFG)
+    sv = Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(4)),
+        signatures=ctr_signatures(CFG.num_fields),
+    )
+    save_servable(tmp_path / "ckpt", sv, kind="dcn_v2")
+    mesh = make_mesh(8, model_parallel=4)
+    loaded = load_servable(tmp_path / "ckpt", mesh=mesh)
+    emb = loaded.params["embedding"]
+    assert emb.sharding.spec == jax.sharding.PartitionSpec(MODEL_AXIS, None)
+    np.testing.assert_array_equal(np.asarray(emb), np.asarray(sv.params["embedding"]))
