@@ -2,16 +2,24 @@
 
 The reference's serving endpoint was `tensorflow_model_server` on port 9999
 (DCNClient.java:28); this is its in-tree replacement. A thin adapter maps
-ServiceError codes onto grpc status codes and delegates everything else to
-PredictionServiceImpl. Handler threads block on batcher futures, so the
-thread pool size bounds in-flight RPCs while the batcher thread serializes
-device work.
+ServiceError codes onto grpc status codes, records per-RPC latency/outcome
+metrics, and delegates everything else to PredictionServiceImpl. Handler
+threads block on batcher futures, so the thread pool size bounds in-flight
+RPCs while the batcher thread serializes device work.
+
+CLI (`python -m distributed_tf_serving_tpu.serving.server`) supports the
+full knob set via flags or a TOML config (utils/config.py), serves either a
+demo-initialized model or a training checkpoint, and optionally shards
+execution over a device mesh.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import logging
+import time
 from concurrent import futures
 
 import grpc
@@ -19,6 +27,9 @@ import jax
 
 from ..models import ModelConfig, Servable, ServableRegistry, build_model, ctr_signatures
 from ..proto import add_PredictionServiceServicer_to_server
+from ..utils.config import ServerConfig, load_config
+from ..utils.metrics import ServerMetrics
+from ..utils.tracing import request_trace
 from .batcher import DynamicBatcher
 from .service import PredictionServiceImpl, ServiceError
 
@@ -30,40 +41,48 @@ def _status(code_name: str) -> grpc.StatusCode:
 
 
 class GrpcPredictionService:
-    """grpc servicer adapter; safe against handler-thread exceptions."""
+    """grpc servicer adapter: error mapping + per-RPC metrics."""
 
-    def __init__(self, impl: PredictionServiceImpl):
+    def __init__(self, impl: PredictionServiceImpl, metrics: ServerMetrics | None = None):
         self.impl = impl
+        self.metrics = metrics or ServerMetrics()
 
-    def _call(self, fn, request, context):
+    def _call(self, name: str, fn, request, context):
+        t0 = time.perf_counter()
+        ok = False
         try:
-            return fn(request)
+            resp = fn(request)
+            ok = True
+            return resp
         except ServiceError as e:
             context.abort(_status(e.code), str(e))
         except Exception as e:  # internal bug: surface as INTERNAL, keep serving
-            log.exception("internal error serving %s", fn.__name__)
+            log.exception("internal error serving %s", name)
             context.abort(grpc.StatusCode.INTERNAL, f"internal error: {e}")
+        finally:
+            self.metrics.observe(name, time.perf_counter() - t0, ok)
 
     def Predict(self, request, context):
-        return self._call(self.impl.predict, request, context)
+        return self._call("Predict", self.impl.predict, request, context)
 
     def Classify(self, request, context):
-        return self._call(self.impl.classify, request, context)
+        return self._call("Classify", self.impl.classify, request, context)
 
     def Regress(self, request, context):
-        return self._call(self.impl.regress, request, context)
+        return self._call("Regress", self.impl.regress, request, context)
 
     def MultiInference(self, request, context):
-        return self._call(self.impl.multi_inference, request, context)
+        return self._call("MultiInference", self.impl.multi_inference, request, context)
 
     def GetModelMetadata(self, request, context):
-        return self._call(self.impl.get_model_metadata, request, context)
+        return self._call("GetModelMetadata", self.impl.get_model_metadata, request, context)
 
 
 def create_server(
     impl: PredictionServiceImpl,
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
+    metrics: ServerMetrics | None = None,
 ) -> tuple[grpc.Server, int]:
     """Build (not start) a server; returns (server, bound_port)."""
     server = grpc.server(
@@ -73,7 +92,8 @@ def create_server(
             ("grpc.max_send_message_length", 64 * 1024 * 1024),
         ],
     )
-    add_PredictionServiceServicer_to_server(GrpcPredictionService(impl), server)
+    servicer = GrpcPredictionService(impl, metrics)
+    add_PredictionServiceServicer_to_server(servicer, server)
     port = server.add_insecure_port(address)
     if port == 0:
         raise RuntimeError(f"could not bind {address}")
@@ -106,33 +126,94 @@ def load_demo_servable(
     return servable
 
 
+def build_stack(cfg: ServerConfig, checkpoint: str | None = None):
+    """Registry + batcher (+ mesh executor) + impl from a ServerConfig."""
+    registry = ServableRegistry()
+    run_fn = None
+    mesh = None
+    if cfg.mesh_devices:
+        from ..parallel import ShardedExecutor, make_mesh
+
+        mesh = make_mesh(cfg.mesh_devices, model_parallel=cfg.model_parallel)
+        run_fn = ShardedExecutor(mesh, compress_transfer=cfg.compress_transfer)
+    batcher = DynamicBatcher(
+        buckets=cfg.buckets,
+        max_wait_us=cfg.max_wait_us,
+        compress_transfer=cfg.compress_transfer,
+        run_fn=run_fn,
+    ).start()
+    impl = PredictionServiceImpl(registry, batcher)
+
+    if checkpoint:
+        from ..train.checkpoint import load_servable
+
+        servable = load_servable(checkpoint, mesh=mesh)
+        registry.load(servable)
+        log.info("loaded checkpoint %s: %s v%d", checkpoint, servable.name, servable.version)
+    else:
+        servable = load_demo_servable(
+            registry, kind=cfg.model_kind, name=cfg.model_name, num_fields=cfg.num_fields
+        )
+    if cfg.warmup:
+        log.info("warming bucket ladder %s", cfg.buckets)
+        batcher.warmup(servable)
+    return registry, batcher, impl, servable, mesh
+
+
 def serve(argv=None) -> None:
     parser = argparse.ArgumentParser(description="TPU-native PredictionService")
-    parser.add_argument("--port", type=int, default=9999)  # reference default, DCNClient.java:28
-    parser.add_argument("--host", default="0.0.0.0")
-    parser.add_argument("--model-kind", default="dcn_v2")
-    parser.add_argument("--model-name", default="DCN")
-    parser.add_argument("--num-fields", type=int, default=43)
-    parser.add_argument("--max-workers", type=int, default=16)
-    parser.add_argument("--max-wait-us", type=int, default=200)
-    parser.add_argument("--warmup", action="store_true", help="precompile bucket ladder")
+    parser.add_argument("--config", help="TOML config file ([server] section)")
+    parser.add_argument("--checkpoint", help="servable checkpoint dir (train.save_servable)")
+    parser.add_argument("--port", type=int)
+    parser.add_argument("--host")
+    parser.add_argument("--model-kind", dest="model_kind")
+    parser.add_argument("--model-name", dest="model_name")
+    parser.add_argument("--num-fields", dest="num_fields", type=int)
+    parser.add_argument("--max-workers", dest="max_workers", type=int)
+    parser.add_argument("--max-wait-us", dest="max_wait_us", type=int)
+    parser.add_argument("--mesh-devices", dest="mesh_devices", type=int)
+    parser.add_argument("--model-parallel", dest="model_parallel", type=int)
+    parser.add_argument("--no-warmup", action="store_true")
+    parser.add_argument("--metrics-every-s", type=float, default=0.0,
+                        help="periodically log a metrics snapshot")
     args = parser.parse_args(argv)
 
+    cfg = load_config(args.config)["server"] if args.config else ServerConfig()
+    field_names = {f.name for f in dataclasses.fields(ServerConfig)}
+    overrides = {
+        k: v for k, v in vars(args).items() if v is not None and k in field_names
+    }
+    if args.no_warmup:
+        overrides["warmup"] = False
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+
     logging.basicConfig(level=logging.INFO)
-    registry = ServableRegistry()
-    batcher = DynamicBatcher(max_wait_us=args.max_wait_us).start()
-    impl = PredictionServiceImpl(registry, batcher)
-    servable = load_demo_servable(
-        registry, kind=args.model_kind, name=args.model_name, num_fields=args.num_fields
-    )
-    if args.warmup:
-        log.info("warming bucket ladder %s", batcher.buckets)
-        batcher.warmup(servable)
-    server, port = create_server(impl, f"{args.host}:{args.port}", args.max_workers)
+    registry, batcher, impl, servable, mesh = build_stack(cfg, checkpoint=args.checkpoint)
+    metrics = ServerMetrics()
+    server, port = create_server(impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics)
     server.start()
-    log.info("PredictionService on %s:%d (model=%s kind=%s, devices=%s)",
-             args.host, port, args.model_name, args.model_kind, jax.devices())
-    server.wait_for_termination()
+    log.info(
+        "PredictionService on %s:%d (model=%s kind=%s mesh=%s devices=%s)",
+        cfg.host, port, servable.name, cfg.model_kind,
+        dict(mesh.shape) if mesh else None, jax.devices(),
+    )
+    try:
+        if args.metrics_every_s > 0:
+            # grpc's wait_for_termination(timeout) returns True when the
+            # timeout elapsed with the server still live, False once it
+            # terminates — periodic logging AND termination detection in one
+            # loop (verified against grpcio 1.76 behavior).
+            while server.wait_for_termination(timeout=args.metrics_every_s):
+                snap = metrics.snapshot(batcher.stats)
+                snap["phases"] = request_trace.snapshot()
+                log.info("metrics %s", json.dumps(snap))
+        else:
+            server.wait_for_termination()
+    finally:
+        log.info("shutting down")
+        server.stop(2).wait()
+        batcher.stop()
 
 
 if __name__ == "__main__":

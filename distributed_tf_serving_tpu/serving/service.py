@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import codec
+from ..utils.tracing import request_trace
 from ..models.registry import (
     ModelNotFoundError,
     Servable,
@@ -150,7 +151,8 @@ class PredictionServiceImpl:
                 f"signature {request.model_spec.signature_name!r} has method "
                 f"{signature.method_name!r}; use the matching RPC instead of Predict",
             )
-        arrays = self._decode_and_validate(servable, signature, request.inputs)
+        with request_trace.span("predict.decode"):
+            arrays = self._decode_and_validate(servable, signature, request.inputs)
 
         sig_outputs = [s.name for s in signature.outputs]
         if request.output_filter:
@@ -163,7 +165,8 @@ class PredictionServiceImpl:
             out_names = list(request.output_filter)
         else:
             out_names = sig_outputs
-        outputs = self._run(servable, arrays, output_keys=tuple(out_names))
+        with request_trace.span("predict.execute"):
+            outputs = self._run(servable, arrays, output_keys=tuple(out_names))
         produced = [k for k in out_names if k in outputs]
         if len(produced) != len(out_names):
             # Signature promised tensors the model never produced — a servable
@@ -174,12 +177,13 @@ class PredictionServiceImpl:
                 f"{out_names}",
             )
 
-        resp = apis.PredictResponse()
-        resp.model_spec.CopyFrom(
-            self._echo_spec(servable, request.model_spec.signature_name or "serving_default")
-        )
-        for name in out_names:
-            resp.outputs[name].CopyFrom(codec.from_ndarray(outputs[name]))
+        with request_trace.span("predict.encode"):
+            resp = apis.PredictResponse()
+            resp.model_spec.CopyFrom(
+                self._echo_spec(servable, request.model_spec.signature_name or "serving_default")
+            )
+            for name in out_names:
+                resp.outputs[name].CopyFrom(codec.from_ndarray(outputs[name]))
         return resp
 
     # ----------------------------------------------------- Classify / Regress

@@ -1,0 +1,16 @@
+"""Utilities: config, metrics, tracing."""
+
+from .config import ClientConfig, ServerConfig, load_config
+from .metrics import LatencyHistogram, ServerMetrics
+from .tracing import PhaseTrace, profile_trace, request_trace
+
+__all__ = [
+    "ServerConfig",
+    "ClientConfig",
+    "load_config",
+    "LatencyHistogram",
+    "ServerMetrics",
+    "PhaseTrace",
+    "profile_trace",
+    "request_trace",
+]

@@ -1,0 +1,80 @@
+"""Config system: dataclasses + TOML, covering the reference's knob set.
+
+The reference hard-codes every knob as private static finals — changing
+hosts or batch size means recompiling (DCNClient.java:25-42, SURVEY.md §5).
+This maps that exact knob set (field_num, candidate_num, hosts, port,
+concurrency, request_num, model name/signature/output key, async mode) plus
+the TPU-side knobs (mesh, buckets, batching) onto TOML-loadable dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import tomllib
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    """Serving frontend + batcher + mesh knobs."""
+
+    host: str = "0.0.0.0"
+    port: int = 9999  # reference default, DCNClient.java:28
+    max_workers: int = 16  # reference thread pool size, DCNClient.java:42
+    model_kind: str = "dcn_v2"
+    model_name: str = "DCN"  # DCNClient.java:33
+    num_fields: int = 43  # FIELD_NUM, DCNClient.java:25
+    buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+    max_wait_us: int = 200
+    compress_transfer: bool = True
+    warmup: bool = True
+    # mesh: 0 = single device; >0 = shard over first n devices
+    mesh_devices: int = 0
+    model_parallel: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientConfig:
+    """Fan-out client + closed-loop bench knobs (the DCNClient constants)."""
+
+    hosts: tuple[str, ...] = ("127.0.0.1:9999",)  # DCNClient.java:38
+    model_name: str = "DCN"  # DCNClient.java:33
+    signature_name: str = "serving_default"  # DCNClient.java:34
+    output_key: str = "prediction_node"  # DCNClient.java:35
+    num_fields: int = 43  # FIELD_NUM
+    candidate_num: int = 1500  # DCNClient.java:29
+    request_num: int = 1000  # DCNClient.java:30
+    concurrent_num: int = 6  # DCNClient.java:31
+    full_async_mode: bool = True  # DCNClient.java:27 (sync mode not replicated)
+    sort_scores: bool = True  # the ranking sort, DCNClient.java:195
+    timeout_s: float = 10.0
+    use_tensor_content: bool = True
+
+
+_SECTIONS = {"server": ServerConfig, "client": ClientConfig}
+
+
+def _coerce(cls, data: dict[str, Any]):
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ValueError(f"unknown {cls.__name__} keys: {sorted(unknown)}")
+    kwargs = {}
+    for key, value in data.items():
+        if isinstance(value, list):
+            value = tuple(value)
+        kwargs[key] = value
+    return cls(**kwargs)
+
+
+def load_config(path) -> dict[str, Any]:
+    """Parse a TOML file with optional [server] / [client] sections."""
+    raw = tomllib.loads(pathlib.Path(path).read_text())
+    out: dict[str, Any] = {}
+    for section, cls in _SECTIONS.items():
+        out[section] = _coerce(cls, raw.get(section, {}))
+    extra = set(raw) - set(_SECTIONS)
+    if extra:
+        raise ValueError(f"unknown config sections: {sorted(extra)}")
+    return out

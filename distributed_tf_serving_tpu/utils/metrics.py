@@ -1,0 +1,140 @@
+"""Serving metrics: latency histograms, QPS, per-RPC counters.
+
+The reference's entire metrics system is a synchronized list of per-request
+wall times printed as a mean (timeLists, DCNClient.java:44,198-202,234-236).
+BASELINE.md's target metric set (p50/p99, QPS/chip) needs percentile-capable
+aggregation, so the core here is a fixed-bucket log-scale histogram: O(1)
+record, lock-free-ish (GIL-atomic list ops), percentiles from bucket
+interpolation, mergeable across RPCs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+
+# Log-spaced bucket edges: 1us .. ~107s, 12.5% resolution.
+_BASE_US = 1.0
+_GROWTH = 1.125
+_NUM_BUCKETS = 156
+
+
+def _bucket_index(us: float) -> int:
+    if us <= _BASE_US:
+        return 0
+    return min(int(math.log(us / _BASE_US, _GROWTH)) + 1, _NUM_BUCKETS - 1)
+
+
+_EDGES_US = [_BASE_US * _GROWTH**i for i in range(_NUM_BUCKETS)]
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram with percentile readout."""
+
+    def __init__(self):
+        self._counts = [0] * _NUM_BUCKETS
+        self._total = 0
+        self._sum_us = 0.0
+        self._min_us = math.inf
+        self._max_us = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        us = seconds * 1e6
+        with self._lock:
+            self._counts[_bucket_index(us)] += 1
+            self._total += 1
+            self._sum_us += us
+            self._min_us = min(self._min_us, us)
+            self._max_us = max(self._max_us, us)
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    def mean_ms(self) -> float:
+        return self._sum_us / self._total / 1e3 if self._total else 0.0
+
+    def percentile_ms(self, q: float) -> float:
+        """q in [0, 100]; linear interpolation inside the winning bucket."""
+        with self._lock:
+            if self._total == 0:
+                return 0.0
+            target = q / 100.0 * self._total
+            acc = 0
+            for i, c in enumerate(self._counts):
+                if acc + c >= target and c > 0:
+                    lo = _EDGES_US[i - 1] if i > 0 else 0.0
+                    hi = _EDGES_US[i]
+                    frac = (target - acc) / c
+                    val = lo + (hi - lo) * frac
+                    return min(max(val, self._min_us), self._max_us) / 1e3
+                acc += c
+            return self._max_us / 1e3
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_ms": round(self.mean_ms(), 3),
+            "p50_ms": round(self.percentile_ms(50), 3),
+            "p90_ms": round(self.percentile_ms(90), 3),
+            "p99_ms": round(self.percentile_ms(99), 3),
+        }
+
+
+@dataclasses.dataclass
+class RpcMetrics:
+    latency: LatencyHistogram = dataclasses.field(default_factory=LatencyHistogram)
+    ok: int = 0
+    errors: int = 0
+
+
+class ServerMetrics:
+    """Per-RPC latency/outcome metrics + a QPS window, exported as one dict
+    (the /metrics analog; the reference had only a final stdout mean)."""
+
+    def __init__(self):
+        self._rpcs: dict[str, RpcMetrics] = {}
+        self._lock = threading.Lock()
+        self._start = time.monotonic()
+
+    def rpc(self, name: str) -> RpcMetrics:
+        with self._lock:
+            if name not in self._rpcs:
+                self._rpcs[name] = RpcMetrics()
+            return self._rpcs[name]
+
+    def observe(self, name: str, seconds: float, ok: bool) -> None:
+        m = self.rpc(name)
+        m.latency.record(seconds)
+        with self._lock:  # counters race across handler threads otherwise
+            if ok:
+                m.ok += 1
+            else:
+                m.errors += 1
+
+    def snapshot(self, batcher_stats=None) -> dict:
+        uptime = time.monotonic() - self._start
+        out: dict = {"uptime_s": round(uptime, 1), "rpcs": {}}
+        total = 0
+        with self._lock:  # rpc() may insert concurrently
+            items = sorted(self._rpcs.items())
+        for name, m in items:
+            out["rpcs"][name] = {
+                **m.latency.snapshot(),
+                "ok": m.ok,
+                "errors": m.errors,
+            }
+            total += m.ok + m.errors
+        out["qps"] = round(total / uptime, 2) if uptime > 0 else 0.0
+        if batcher_stats is not None:
+            out["batcher"] = {
+                "batches": batcher_stats.batches,
+                "requests": batcher_stats.requests,
+                "mean_occupancy": round(batcher_stats.mean_occupancy, 3),
+                "mean_requests_per_batch": round(batcher_stats.mean_requests_per_batch, 2),
+                "max_queue_depth": batcher_stats.max_queue_depth,
+            }
+        return out

@@ -1,0 +1,70 @@
+"""Request-path tracing: per-phase timers + jax.profiler integration.
+
+The reference's only tracing is System.nanoTime() around whole requests
+(DCNClient.java:141,198-199; SURVEY.md §5). Serving needs to know where the
+budget goes — decode / queue / pad+pack / compute / readback / encode — so
+PhaseTrace accumulates named spans per request with ~50ns overhead, and
+profile_trace() wraps a block in a jax.profiler trace for deep dives
+(XLA-level timelines viewable in TensorBoard/Perfetto).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+
+
+class PhaseTrace:
+    """Accumulates wall time per named phase, aggregated across requests."""
+
+    def __init__(self):
+        self._totals: dict[str, float] = defaultdict(float)
+        self._counts: dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, phase: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._totals[phase] += dt
+                self._counts[phase] += 1
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {
+                phase: {
+                    "total_ms": round(self._totals[phase] * 1e3, 3),
+                    "count": self._counts[phase],
+                    "mean_us": round(
+                        self._totals[phase] / self._counts[phase] * 1e6, 1
+                    ),
+                }
+                for phase in sorted(self._totals)
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._totals.clear()
+            self._counts.clear()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """jax.profiler trace around a block (XLA + host timeline)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+# Process-wide default trace used by the serving path.
+request_trace = PhaseTrace()

@@ -1,0 +1,110 @@
+"""Aux-subsystem tests: histogram percentiles, server metrics, phase traces,
+TOML config loading (SURVEY.md §5 obligations)."""
+
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu.utils import (
+    ClientConfig,
+    LatencyHistogram,
+    PhaseTrace,
+    ServerConfig,
+    ServerMetrics,
+    load_config,
+)
+
+
+def test_histogram_percentiles_track_numpy():
+    rng = np.random.RandomState(0)
+    samples = rng.lognormal(mean=np.log(5e-3), sigma=0.5, size=20_000)  # seconds
+    h = LatencyHistogram()
+    for s in samples:
+        h.record(s)
+    for q in (50, 90, 99):
+        want = np.percentile(samples, q) * 1e3
+        got = h.percentile_ms(q)
+        assert got == pytest.approx(want, rel=0.15), (q, got, want)
+    assert h.mean_ms() == pytest.approx(samples.mean() * 1e3, rel=1e-6)
+    assert h.count == 20_000
+
+
+def test_histogram_empty_and_single():
+    h = LatencyHistogram()
+    assert h.percentile_ms(50) == 0.0
+    h.record(0.002)
+    assert h.percentile_ms(50) == pytest.approx(2.0, rel=0.15)
+
+
+def test_server_metrics_snapshot():
+    m = ServerMetrics()
+    for _ in range(8):
+        m.observe("Predict", 0.004, ok=True)
+    m.observe("Predict", 0.1, ok=False)
+    m.observe("Classify", 0.01, ok=True)
+    snap = m.snapshot()
+    assert snap["rpcs"]["Predict"]["ok"] == 8
+    assert snap["rpcs"]["Predict"]["errors"] == 1
+    assert snap["rpcs"]["Predict"]["count"] == 9
+    assert snap["rpcs"]["Classify"]["ok"] == 1
+    assert snap["qps"] > 0
+
+
+def test_phase_trace():
+    t = PhaseTrace()
+    with t.span("decode"):
+        pass
+    with t.span("decode"):
+        pass
+    with t.span("execute"):
+        pass
+    snap = t.snapshot()
+    assert snap["decode"]["count"] == 2
+    assert snap["execute"]["count"] == 1
+    t.reset()
+    assert t.snapshot() == {}
+
+
+def test_config_defaults_match_reference_constants():
+    c = ClientConfig()
+    # The DCNClient.java:25-42 knob set.
+    assert c.num_fields == 43
+    assert c.candidate_num == 1500
+    assert c.request_num == 1000
+    assert c.concurrent_num == 6
+    assert c.model_name == "DCN"
+    assert c.signature_name == "serving_default"
+    assert c.output_key == "prediction_node"
+    assert ServerConfig().port == 9999
+
+
+def test_toml_roundtrip(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        """
+[server]
+port = 8500
+buckets = [64, 256]
+model_kind = "dlrm"
+
+[client]
+hosts = ["a:1", "b:2", "c:3"]
+candidate_num = 500
+"""
+    )
+    cfg = load_config(p)
+    assert cfg["server"].port == 8500
+    assert cfg["server"].buckets == (64, 256)
+    assert cfg["server"].model_kind == "dlrm"
+    assert cfg["client"].hosts == ("a:1", "b:2", "c:3")
+    assert cfg["client"].candidate_num == 500
+    assert cfg["client"].num_fields == 43  # untouched default
+
+
+def test_toml_unknown_key_rejected(tmp_path):
+    p = tmp_path / "bad.toml"
+    p.write_text("[server]\nprot = 1\n")
+    with pytest.raises(ValueError, match="unknown ServerConfig keys"):
+        load_config(p)
+    p.write_text("[srever]\n")
+    with pytest.raises(ValueError, match="unknown config sections"):
+        load_config(p)
