@@ -54,6 +54,10 @@ class ModelConfig:
     # numerics
     compute_dtype: str = "bfloat16"  # "float32" for AUC-parity mode
     param_dtype: str = "float32"
+    # Fused Pallas cross-layer kernel (DCN-v2 only). Wins when F*embed_dim is
+    # 128-lane aligned (e.g. 1024): activations stay VMEM-resident across
+    # layers. At unaligned widths padding eats the gain — hence opt-in.
+    use_pallas_cross: bool = False
 
     @property
     def cdtype(self) -> jnp.dtype:

@@ -73,7 +73,18 @@ def _build(config: ModelConfig) -> Model:
         cd = config.cdtype
         emb = field_embed(params["embedding"], batch["feat_ids"], batch["feat_wts"], cd)
         x0 = emb.reshape(emb.shape[0], d)  # [n, F*D]
-        xc = cross_apply(params["cross"], x0, cd)
+        if config.use_pallas_cross and config.cross_full_matrix:
+            import jax as _jax
+
+            from ..ops.cross_kernel import cross_params_to_stacked, fused_cross_apply
+
+            w, b = cross_params_to_stacked(params["cross"])
+            # interpret mode keeps the kernel runnable on the CPU test mesh.
+            xc = fused_cross_apply(
+                x0, w, b, compute_dtype=cd, interpret=_jax.default_backend() == "cpu"
+            )
+        else:
+            xc = cross_apply(params["cross"], x0, cd)
         xd = mlp_apply(params["mlp"], x0, cd)
         h = jnp.concatenate([xc.astype(jnp.float32), xd.astype(jnp.float32)], axis=-1)
         logit = dense_apply(params["out"], h, cd)[:, 0]

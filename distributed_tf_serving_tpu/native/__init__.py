@@ -1,0 +1,130 @@
+"""ctypes bindings for the native host-ops library, with lazy build.
+
+The shared library builds from hostops.cc on first use (g++ -O3, cached in
+native/build/). Absence of a compiler or DTS_TPU_NO_NATIVE=1 degrades
+gracefully to the numpy implementations in ops/transfer.py — callers probe
+`available()` and fall back. Bindings use ctypes because pybind11 is not in
+this image; the C ABI keeps them trivial.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("dts_tpu.native")
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_SRC = _DIR / "hostops.cc"
+_SO = _DIR / "build" / "libhostops.so"
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build() -> bool:
+    _SO.parent.mkdir(exist_ok=True)
+    # Build to a temp path + atomic rename: a killed/failed compile must
+    # never leave a partial .so that later passes the staleness check.
+    tmp = _SO.with_suffix(f".tmp{os.getpid()}.so")
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native hostops build failed (%s); using numpy fallback", e)
+        tmp.unlink(missing_ok=True)
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        lib = _load_locked()
+        # _tried flips only after the outcome is final, under the lock, so
+        # concurrent first callers cannot race the compile or CDLL a
+        # half-written file.
+        _lib = lib
+        _tried = True
+        return _lib
+
+
+def _load_locked() -> ctypes.CDLL | None:
+    if os.environ.get("DTS_TPU_NO_NATIVE") == "1":
+        return None
+    if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+    except OSError as e:
+        log.warning("native hostops load failed (%s); using numpy fallback", e)
+        # A cached .so that will not load is useless; drop it so the next
+        # process attempts a fresh build instead of failing forever.
+        _SO.unlink(missing_ok=True)
+        return None
+    lib.fold_i32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.pack_u24_i32.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    lib.f32_to_bf16.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def warm_async() -> None:
+    """Kick the (possibly compiling) load off-thread so no request pays the
+    first-use g++ latency; callers keep using the numpy fallback until the
+    native path is ready."""
+    threading.Thread(target=_load, name="native-build", daemon=True).start()
+
+
+def _ptr(arr: np.ndarray) -> ctypes.c_void_p:
+    return ctypes.c_void_p(arr.ctypes.data)
+
+
+def fold_i32(ids: np.ndarray, vocab: int) -> np.ndarray:
+    """int64 ids -> int32 ids mod vocab (one pass)."""
+    lib = _load()
+    assert lib is not None
+    ids = np.ascontiguousarray(ids, dtype=np.int64)
+    out = np.empty(ids.shape, np.int32)
+    lib.fold_i32(_ptr(ids), ids.size, vocab, _ptr(out))
+    return out
+
+
+def pack_u24_i32(ids: np.ndarray) -> np.ndarray:
+    """Folded int32 ids [..] -> u24 bytes [.., 3] (one pass)."""
+    lib = _load()
+    assert lib is not None
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    out = np.empty(ids.shape + (3,), np.uint8)
+    lib.pack_u24_i32(_ptr(ids), ids.size, _ptr(out))
+    return out
+
+
+def f32_to_bf16(wts: np.ndarray) -> np.ndarray:
+    """f32 -> bf16 with round-to-nearest-even (one pass)."""
+    import ml_dtypes
+
+    lib = _load()
+    assert lib is not None
+    wts = np.ascontiguousarray(wts, dtype=np.float32)
+    out = np.empty(wts.shape, ml_dtypes.bfloat16)
+    lib.f32_to_bf16(_ptr(wts), wts.size, _ptr(out))
+    return out

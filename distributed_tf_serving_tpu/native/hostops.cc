@@ -1,0 +1,64 @@
+// Native host-side hot path for the serving batcher.
+//
+// The reference keeps its entire runtime on the JVM and delegates native
+// execution to external binaries (SURVEY.md §2.3); here the TPU compute path
+// is XLA/Pallas and THIS file is the native runtime for the host side of the
+// request path: the fold/pack/pad batch assembly that sits between protobuf
+// decode and device transfer. The numpy implementation of the same steps
+// (ops/transfer.py + batcher padding) makes several full passes and
+// temporaries per batch; these kernels do each transform in one pass.
+//
+// Exposed via a C ABI for ctypes (pybind11 is not in this image). All
+// functions are thread-safe (pure element-wise transforms on caller-owned
+// buffers).
+
+#include <cstdint>
+
+extern "C" {
+
+// ids[i] -> int32(ids[i] mod vocab) — the uncompressed fold. Power-of-two
+// vocabs (the common config) take the mask path: two's-complement AND equals
+// the mathematical mod, and skips the 64-bit division.
+void fold_i32(const int64_t* ids, int64_t n, int64_t vocab, int32_t* out) {
+  if ((vocab & (vocab - 1)) == 0) {
+    const int64_t mask = vocab - 1;
+    for (int64_t i = 0; i < n; ++i) {
+      out[i] = static_cast<int32_t>(ids[i] & mask);
+    }
+    return;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t r = ids[i] % vocab;
+    if (r < 0) r += vocab;
+    out[i] = static_cast<int32_t>(r);
+  }
+}
+
+// Already-folded int32 ids -> 3 little-endian bytes each (the u24 transfer
+// packing of ops/transfer.py, one pass, no intermediate view/copy).
+// Requires 0 <= ids[i] < 2^24.
+void pack_u24_i32(const int32_t* ids, int64_t n, uint8_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t v = static_cast<uint32_t>(ids[i]);
+    out[3 * i + 0] = static_cast<uint8_t>(v);
+    out[3 * i + 1] = static_cast<uint8_t>(v >> 8);
+    out[3 * i + 2] = static_cast<uint8_t>(v >> 16);
+  }
+}
+
+// f32 -> bf16 with round-to-nearest-even (numpy/ml_dtypes-compatible,
+// including NaN quieting).
+void f32_to_bf16(const float* in, int64_t n, uint16_t* out) {
+  const uint32_t* bits = reinterpret_cast<const uint32_t*>(in);
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t u = bits[i];
+    if ((u & 0x7fffffffu) > 0x7f800000u) {   // NaN: keep quiet, drop payload
+      out[i] = static_cast<uint16_t>((u >> 16) | 0x0040u);
+    } else {
+      uint32_t rounding = 0x7fffu + ((u >> 16) & 1u);
+      out[i] = static_cast<uint16_t>((u + rounding) >> 16);
+    }
+  }
+}
+
+}  // extern "C"

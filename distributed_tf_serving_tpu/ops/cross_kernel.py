@@ -1,0 +1,108 @@
+"""Pallas TPU kernel: fused DCN-v2 cross-layer stack.
+
+The cross network applies L layers of x = x0 * (x @ W_l + b_l) + x
+(models/dcn.py cross_apply). Under plain XLA each layer's output round-trips
+through HBM between matmuls; this kernel keeps the activation tile resident
+in VMEM across ALL layers — one HBM read of the x0 tile, L MXU matmuls
+against VMEM-resident weights, one HBM write — turning an
+HBM-bandwidth-bound stack into an MXU-bound one for serving-sized tiles.
+
+Numerics mirror cross_apply exactly: matmul in the model's compute dtype
+with f32 accumulation (preferred_element_type), the elementwise update in
+f32, the carried activation cast back to compute dtype per layer — so the
+kernel is a drop-in for the XLA path (test_cross_kernel.py pins equality).
+
+Shapes are padded to TPU tiling (d -> multiple of 128 lanes, rows -> the
+row-tile size): zero-padded W rows/cols and b lanes keep padded activation
+columns identically zero through every layer, so padding never leaks into
+real outputs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+DEFAULT_ROW_TILE = 256
+
+
+def _cross_kernel(x0_ref, w_ref, b_ref, out_ref, *, num_layers: int, compute_dtype):
+    x0 = x0_ref[:]  # (BN, dp) in compute dtype
+    x0_f32 = x0.astype(jnp.float32)
+
+    def layer(l, x):
+        xw = jax.lax.dot_general(
+            x,
+            w_ref[l],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        b = b_ref[l].astype(jnp.float32)
+        nxt = x0_f32 * (xw + b) + x.astype(jnp.float32)
+        return nxt.astype(compute_dtype)
+
+    out_ref[:] = jax.lax.fori_loop(0, num_layers, layer, x0)
+
+
+def _pad_to(value: int, multiple: int) -> int:
+    return (value + multiple - 1) // multiple * multiple
+
+
+@functools.partial(
+    jax.jit, static_argnames=("compute_dtype", "row_tile", "interpret")
+)
+def fused_cross_apply(
+    x0: jax.Array,  # [n, d]
+    w: jax.Array,  # [L, d, d]
+    b: jax.Array,  # [L, d]
+    *,
+    compute_dtype=jnp.bfloat16,
+    row_tile: int = DEFAULT_ROW_TILE,
+    interpret: bool = False,
+) -> jax.Array:
+    """Apply the full DCN-v2 cross stack in one fused kernel; returns [n, d]
+    in compute_dtype (matching models/dcn.py cross_apply output)."""
+    n, d = x0.shape
+    num_layers = w.shape[0]
+    dp = _pad_to(d, LANE)
+    bn = min(row_tile, _pad_to(n, 8))
+    np_ = _pad_to(n, bn)
+
+    cd = jnp.dtype(compute_dtype)
+    x0p = jnp.zeros((np_, dp), cd).at[:n, :d].set(x0.astype(cd))
+    wp = jnp.zeros((num_layers, dp, dp), cd).at[:, :d, :d].set(w.astype(cd))
+    bp = jnp.zeros((num_layers, dp), jnp.float32).at[:, :d].set(b.astype(jnp.float32))
+
+    kernel = functools.partial(
+        _cross_kernel, num_layers=num_layers, compute_dtype=cd
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(np_ // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, dp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            # Constant index maps: weights/biases DMA'd into VMEM once and
+            # stay resident across all row tiles.
+            pl.BlockSpec((num_layers, dp, dp), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((num_layers, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, dp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((np_, dp), cd),
+        interpret=interpret,
+    )(x0p, wp, bp)
+    return out[:n, :d]
+
+
+def cross_params_to_stacked(cross_layers: list) -> tuple[jax.Array, jax.Array]:
+    """models/dcn.py stores cross params as a list of {'w': [d,d], 'b': [d]};
+    stack them for the kernel. Only full-matrix (DCN-v2) layers qualify."""
+    if not cross_layers or cross_layers[0]["w"].ndim != 2:
+        raise ValueError("fused cross kernel requires DCN-v2 (full-matrix) layers")
+    w = jnp.stack([p["w"] for p in cross_layers])
+    b = jnp.stack([p["b"] for p in cross_layers])
+    return w, b

@@ -43,17 +43,30 @@ def transfer_spec(model: Model) -> dict[str, str]:
 
 
 def pack_host(arrays: dict[str, np.ndarray], spec: dict[str, str]) -> dict[str, np.ndarray]:
-    """Apply the spec on host numpy arrays (post-fold, post-pad)."""
+    """Apply the spec on host numpy arrays (post-fold, post-pad).
+
+    Each transform runs through the native one-pass kernels
+    (native/hostops.cc) when built, with bit-identical numpy fallbacks.
+    """
+    from .. import native
+
+    use_native = bool(spec) and native.available()
     out = {}
     for key, arr in arrays.items():
         how = spec.get(key)
         if how == "u24":
             if arr.dtype != np.int32:
                 raise ValueError(f"u24 packing expects folded int32 ids, got {arr.dtype}")
-            b = np.ascontiguousarray(arr).view(np.uint8).reshape(*arr.shape, 4)
-            out[key] = np.ascontiguousarray(b[..., :3])  # little-endian low 3 bytes
+            if use_native:
+                out[key] = native.pack_u24_i32(arr)
+            else:
+                b = np.ascontiguousarray(arr).view(np.uint8).reshape(*arr.shape, 4)
+                out[key] = np.ascontiguousarray(b[..., :3])  # LE low 3 bytes
         elif how == "bf16":
-            out[key] = arr.astype(ml_dtypes.bfloat16)
+            if use_native:
+                out[key] = native.f32_to_bf16(arr)
+            else:
+                out[key] = arr.astype(ml_dtypes.bfloat16)
         else:
             out[key] = arr
     return out

@@ -57,7 +57,13 @@ def bucket_for(n: int, buckets: tuple[int, ...]) -> int:
 
 
 def fold_ids_host(ids: np.ndarray, vocab_size: int) -> np.ndarray:
-    """Exact int64 modulo fold on the host; models re-fold idempotently."""
+    """Exact int64 modulo fold on the host; models re-fold idempotently.
+    Uses the native one-pass kernel when built (native/hostops.cc),
+    numpy remainder+astype otherwise — bit-identical either way."""
+    from .. import native
+
+    if ids.dtype == np.int64 and native.available():
+        return native.fold_i32(ids, vocab_size)
     return np.remainder(ids, np.int64(vocab_size)).astype(np.int32)
 
 
@@ -157,6 +163,11 @@ class DynamicBatcher:
         if not self._started:
             self._started = True
             self._thread.start()
+            # Compile/load the native host ops off-thread so the first
+            # request never pays the g++ latency (numpy fallback until ready).
+            from .. import native
+
+            native.warm_async()
         return self
 
     def stop(self) -> None:

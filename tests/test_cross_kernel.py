@@ -1,0 +1,62 @@
+"""Fused cross-kernel tests (interpret mode on CPU): numerical equality with
+the XLA path, padding neutrality, odd shapes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu.models.dcn import _cross_init, cross_apply
+from distributed_tf_serving_tpu.ops.cross_kernel import (
+    cross_params_to_stacked,
+    fused_cross_apply,
+)
+
+
+def _setup(n, d, L, seed=0):
+    layers = _cross_init(jax.random.PRNGKey(seed), L, d, True, jnp.float32)
+    rng = np.random.RandomState(seed)
+    x0 = jnp.asarray(rng.randn(n, d), jnp.float32)
+    return x0, layers
+
+
+@pytest.mark.parametrize("n,d,L", [(32, 128, 3), (100, 688, 2), (7, 96, 1)])
+def test_matches_xla_path_f32(n, d, L):
+    x0, layers = _setup(n, d, L)
+    want = np.asarray(cross_apply(layers, x0, jnp.float32))
+    w, b = cross_params_to_stacked(layers)
+    got = np.asarray(
+        fused_cross_apply(x0, w, b, compute_dtype=jnp.float32, interpret=True)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_matches_xla_path_bf16():
+    x0, layers = _setup(64, 128, 3)
+    want = np.asarray(cross_apply(layers, x0.astype(jnp.bfloat16), jnp.bfloat16))
+    w, b = cross_params_to_stacked(layers)
+    got = np.asarray(
+        fused_cross_apply(x0, w, b, compute_dtype=jnp.bfloat16, interpret=True)
+    )
+    np.testing.assert_allclose(
+        got.astype(np.float32), want.astype(np.float32), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_padding_is_neutral():
+    """d=100 pads to 128, n=13 pads to the row tile; padded region must not
+    leak into real outputs (compare against unpadded XLA reference)."""
+    x0, layers = _setup(13, 100, 2)
+    want = np.asarray(cross_apply(layers, x0, jnp.float32))
+    w, b = cross_params_to_stacked(layers)
+    got = np.asarray(
+        fused_cross_apply(x0, w, b, compute_dtype=jnp.float32, interpret=True)
+    )
+    assert got.shape == (13, 100)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_rejects_v1_layers():
+    layers = _cross_init(jax.random.PRNGKey(0), 2, 64, False, jnp.float32)
+    with pytest.raises(ValueError, match="full-matrix"):
+        cross_params_to_stacked(layers)

@@ -1,0 +1,88 @@
+"""Native hostops tests: build, and bit-exact equality with the numpy
+reference implementations for every kernel (including negative ids, u24
+boundaries, bf16 rounding/NaN)."""
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def lib_available():
+    if not native.available():
+        pytest.skip("native hostops unavailable (no compiler?)")
+
+
+def test_fold_i32_matches_numpy():
+    rng = np.random.RandomState(0)
+    ids = rng.randint(-(1 << 62), 1 << 62, size=(257, 43), dtype=np.int64)
+    vocab = 1 << 20
+    want = np.remainder(ids, np.int64(vocab)).astype(np.int32)
+    got = native.fold_i32(ids, vocab)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == np.int32
+
+
+def test_fold_i32_pow2_mask_path():
+    """Power-of-two vocab takes the mask fast path; must still equal numpy
+    remainder, including for negative ids."""
+    rng = np.random.RandomState(1)
+    ids = rng.randint(-(1 << 60), 1 << 60, size=(64, 43), dtype=np.int64)
+    vocab = 1 << 20
+    want = np.remainder(ids, np.int64(vocab)).astype(np.int32)
+    np.testing.assert_array_equal(native.fold_i32(ids, vocab), want)
+
+
+def test_pack_u24_boundaries():
+    ids = np.array([[0, 1, 255, 256, 65535, 65536, (1 << 24) - 1]], np.int32)
+    got = native.pack_u24_i32(ids)
+    want = ids.view(np.uint8).reshape(1, -1, 4)[..., :3]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_f32_to_bf16_matches_ml_dtypes():
+    rng = np.random.RandomState(2)
+    vals = np.concatenate(
+        [
+            rng.randn(10_000).astype(np.float32) * rng.lognormal(0, 8, 10_000).astype(np.float32),
+            np.array([0.0, -0.0, 1.0, np.inf, -np.inf, np.nan,
+                      np.float32(3.0000001), 65504.0, 1e-40], np.float32),
+        ]
+    )
+    want = vals.astype(ml_dtypes.bfloat16)
+    got = native.f32_to_bf16(vals)
+    np.testing.assert_array_equal(
+        got.view(np.uint16) & 0xFFBF,  # ignore the quiet-bit choice on NaN payloads
+        want.view(np.uint16) & 0xFFBF,
+    )
+    # Non-NaN values must be fully bit-exact.
+    finite = ~np.isnan(vals)
+    np.testing.assert_array_equal(got[finite].view(np.uint16), want[finite].view(np.uint16))
+
+
+def test_pack_host_native_equals_numpy_path():
+    import os
+
+    from distributed_tf_serving_tpu.ops.transfer import pack_host
+
+    rng = np.random.RandomState(3)
+    arrays = {
+        "feat_ids": rng.randint(0, 1 << 20, size=(32, 43)).astype(np.int32),
+        "feat_wts": rng.rand(32, 43).astype(np.float32),
+    }
+    spec = {"feat_ids": "u24", "feat_wts": "bf16"}
+    native_out = pack_host(arrays, spec)
+    os.environ["DTS_TPU_NO_NATIVE"] = "1"
+    try:
+        # Force the numpy path by resetting the cached load state.
+        native._tried, native._lib = True, None
+        numpy_out = pack_host(arrays, spec)
+    finally:
+        del os.environ["DTS_TPU_NO_NATIVE"]
+        native._tried = False
+    for k in spec:
+        np.testing.assert_array_equal(
+            np.asarray(native_out[k]).view(np.uint8), np.asarray(numpy_out[k]).view(np.uint8)
+        )
