@@ -1,6 +1,6 @@
 """Client layer: candidate sharding, async fan-out Predict, bench harness."""
 
-from .bench import BenchReport, make_payload, run_closed_loop
+from .bench import BenchReport, make_payload, run_closed_loop, run_closed_loop_mp
 from .client import (
     PredictClientError,
     ShardedPredictClient,

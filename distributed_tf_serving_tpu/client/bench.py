@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import multiprocessing as mp
+import queue
 import time
 
 import numpy as np
@@ -96,6 +98,114 @@ async def run_closed_loop(
         latencies_ms=np.asarray(latencies),
         wall_s=wall,
         concurrency=concurrency,
+        requests_per_worker=requests_per_worker,
+        candidates=payload["feat_ids"].shape[0],
+    )
+
+
+def _mp_load_worker(args) -> None:
+    """Child-process load generator: its own event loop, channels, and GIL.
+
+    Runs via the spawn context so it never inherits the parent's grpc/jax
+    state; the client import chain is numpy+grpc only (no jax), keeping child
+    startup cheap.
+    """
+    (hosts, model_name, channels_per_host, ids, wts, concurrency,
+     requests_per_worker, sort_scores, warmup_requests, barrier, out_q) = args
+    payload = {"feat_ids": ids, "feat_wts": wts}
+
+    async def go():
+        async with ShardedPredictClient(
+            hosts, model_name, channels_per_host=channels_per_host
+        ) as client:
+            for _ in range(warmup_requests):
+                await client.predict(payload, sort_scores=sort_scores)
+            barrier.wait(timeout=120)  # all children warmed: start together
+            return await run_closed_loop(
+                client, payload,
+                concurrency=concurrency,
+                requests_per_worker=requests_per_worker,
+                sort_scores=sort_scores,
+                warmup_requests=0,
+            )
+
+    report = asyncio.run(go())
+    # Report the child's own wall: perf_counter epochs are only comparable
+    # within one process, so the parent aggregates per-child walls instead
+    # of subtracting cross-process timestamps.
+    out_q.put((report.latencies_ms, report.wall_s))
+
+
+def run_closed_loop_mp(
+    hosts: list[str],
+    payload: dict[str, np.ndarray],
+    model_name: str = "DCN",
+    processes: int = 4,
+    concurrency: int = 64,
+    requests_per_worker: int = 15,
+    sort_scores: bool = True,
+    warmup_requests: int = 3,
+    channels_per_host: int = 2,
+) -> BenchReport:
+    """Closed loop with the load generators in separate OS processes.
+
+    The reference's 6 load threads ran on a JVM with real parallelism
+    (DCNClient.java:213-224); a single CPython event loop serializes request
+    marshalling behind the GIL it shares with the in-process server, so the
+    generators move out of process. Wall time spans first-start to last-end
+    across children (children synchronize on a barrier after warmup).
+    """
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    barrier = ctx.Barrier(processes)
+    per_proc = max(1, concurrency // processes)
+    args = [
+        (hosts, model_name, channels_per_host, payload["feat_ids"], payload["feat_wts"],
+         per_proc, requests_per_worker, sort_scores, warmup_requests, barrier, out_q)
+        for _ in range(processes)
+    ]
+    procs = [ctx.Process(target=_mp_load_worker, args=(a,), daemon=True) for a in args]
+    for p in procs:
+        p.start()
+    results = []
+    try:
+        while len(results) < len(procs):
+            try:
+                results.append(out_q.get(timeout=2))
+            except queue.Empty:
+                # Each child reports exactly once, right before exiting: more
+                # finished children than reports (whatever the exitcode) means
+                # someone died without reporting — fail fast, don't spin.
+                finished = [p for p in procs if not p.is_alive()]
+                if len(finished) > len(results):
+                    # A report can still be in the feeder pipe between our
+                    # get() timeout and the liveness scan; drain before
+                    # declaring anyone dead.
+                    try:
+                        while True:
+                            results.append(out_q.get_nowait())
+                    except queue.Empty:
+                        pass
+                    if len(finished) > len(results):
+                        raise RuntimeError(
+                            f"{len(finished) - len(results)} load process(es) exited "
+                            f"without reporting (exitcodes "
+                            f"{[p.exitcode for p in finished]}); see their stderr "
+                            "for the underlying error"
+                        ) from None
+    finally:
+        for p in procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+    lat = np.concatenate([r[0] for r in results])
+    # Children start together (post-warmup barrier), so the slowest child's
+    # wall spans the whole run.
+    wall = max(r[1] for r in results)
+    return BenchReport(
+        latencies_ms=lat,
+        wall_s=wall,
+        concurrency=per_proc * processes,
         requests_per_worker=requests_per_worker,
         candidates=payload["feat_ids"].shape[0],
     )

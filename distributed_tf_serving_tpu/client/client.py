@@ -70,6 +70,7 @@ class ShardedPredictClient:
         output_key: str = "prediction_node",
         timeout_s: float = 10.0,
         use_tensor_content: bool = True,
+        channels_per_host: int = 1,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -79,14 +80,24 @@ class ShardedPredictClient:
         self.output_key = output_key
         self.timeout_s = timeout_s
         self.use_tensor_content = use_tensor_content
-        # One plaintext channel per host, created once and shared
-        # (DCNClient.java:118-125).
-        self._channels = [grpc.aio.insecure_channel(h) for h in self.hosts]
-        self._stubs = [PredictionServiceStub(ch) for ch in self._channels]
+        # Long-lived plaintext channels per host, created once and shared
+        # (DCNClient.java:118-125). channels_per_host > 1 stripes requests
+        # over several HTTP/2 connections — one connection's flow-control
+        # window throttles a half-MB-per-request load at high concurrency.
+        self.channels_per_host = max(1, channels_per_host)
+        self._channels = [
+            [grpc.aio.insecure_channel(h) for _ in range(self.channels_per_host)]
+            for h in self.hosts
+        ]
+        self._stubs = [
+            [PredictionServiceStub(ch) for ch in per_host] for per_host in self._channels
+        ]
+        self._rr = 0
 
     async def close(self) -> None:
-        for ch in self._channels:
-            await ch.close()
+        for per_host in self._channels:
+            for ch in per_host:
+                await ch.close()
 
     async def __aenter__(self):
         return self
@@ -94,7 +105,7 @@ class ShardedPredictClient:
     async def __aexit__(self, *exc):
         await self.close()
 
-    async def _predict_shard(self, i: int, shard: dict[str, np.ndarray]) -> np.ndarray:
+    async def _predict_shard(self, i: int, shard: dict[str, np.ndarray], rr: int) -> np.ndarray:
         req = build_predict_request(
             shard,
             self.model_name,
@@ -102,8 +113,12 @@ class ShardedPredictClient:
             output_filter=(self.output_key,),
             use_tensor_content=self.use_tensor_content,
         )
+        stubs = self._stubs[i]
+        # rr advances once per logical request (not per shard), so shard i of
+        # request r lands on channel (r + i) % k: consecutive requests stripe
+        # every host's channels even when the shard count divides k.
         try:
-            resp = await self._stubs[i].Predict(req, timeout=self.timeout_s)
+            resp = await stubs[(rr + i) % len(stubs)].Predict(req, timeout=self.timeout_s)
         except grpc.aio.AioRpcError as e:
             raise PredictClientError(self.hosts[i], e.code(), e.details()) from e
         return codec.to_ndarray(resp.outputs[self.output_key])
@@ -114,8 +129,10 @@ class ShardedPredictClient:
         """One logical request: shard -> concurrent RPCs -> host-order merge
         (-> ascending sort when ranking semantics are wanted)."""
         shards = shard_candidates(arrays, len(self.hosts))
+        self._rr += 1
+        rr = self._rr
         results = await asyncio.gather(
-            *(self._predict_shard(i, s) for i, s in enumerate(shards))
+            *(self._predict_shard(i, s, rr) for i, s in enumerate(shards))
         )
         merged = merge_host_order(list(results))
         if sort_scores:

@@ -73,7 +73,14 @@ def _build(config: ModelConfig) -> Model:
         cd = config.cdtype
         emb = field_embed(params["embedding"], batch["feat_ids"], batch["feat_wts"], cd)
         x0 = emb.reshape(emb.shape[0], d)  # [n, F*D]
-        if config.use_pallas_cross and config.cross_full_matrix:
+        use_fused = config.use_pallas_cross and config.cross_full_matrix
+        if use_fused:
+            from ..ops.cross_kernel import fits_vmem
+
+            # Oversized stacks (all L weight matrices are VMEM-resident in
+            # the fused kernel) fall back to the per-layer XLA path.
+            use_fused = fits_vmem(d, config.num_cross_layers, cd)
+        if use_fused:
             import jax as _jax
 
             from ..ops.cross_kernel import cross_params_to_stacked, fused_cross_apply

@@ -61,11 +61,36 @@ def _load() -> ctypes.CDLL | None:
         return _lib
 
 
-def _load_locked() -> ctypes.CDLL | None:
+def _probe() -> ctypes.CDLL | None:
+    """Non-blocking, non-building _load: never compiles (that is exclusively
+    warm_async/_load territory — a g++ run on the dispatch thread would stall
+    every in-flight request) and never waits on the build lock. Until a
+    fresh .so exists, hot-path callers fall back to numpy; _tried stays
+    unset so they pick the library up once the build lands."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    if not _lock.acquire(blocking=False):
+        return None
+    try:
+        if _tried:
+            return _lib
+        lib = _load_locked(build=False)
+        if lib is not None:
+            # Only a successful load is final here; a missing .so may still
+            # be produced by an in-flight/future warm_async build.
+            _lib = lib
+            _tried = True
+        return lib
+    finally:
+        _lock.release()
+
+
+def _load_locked(build: bool = True) -> ctypes.CDLL | None:
     if os.environ.get("DTS_TPU_NO_NATIVE") == "1":
         return None
     if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-        if not _build():
+        if not build or not _build():
             return None
     try:
         lib = ctypes.CDLL(str(_SO))
@@ -80,10 +105,30 @@ def _load_locked() -> ctypes.CDLL | None:
     ]
     lib.pack_u24_i32.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
     lib.f32_to_bf16.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    lib.hash128.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
     return lib
 
 
+_warm_kicked = False
+
+
 def available() -> bool:
+    """True once the native library is loaded. Never blocks: while the
+    library isn't ready it kicks the build off-thread (once) and returns
+    False, so callers use their numpy fallbacks and transparently upgrade
+    to the native path when the build lands."""
+    global _warm_kicked
+    lib = _probe()
+    if lib is None and not _tried and not _warm_kicked:
+        _warm_kicked = True
+        warm_async()
+    return lib is not None
+
+
+def ensure() -> bool:
+    """Blocking availability: builds the library if needed (seconds of g++).
+    For tests and setup paths that need a definite answer, never for the
+    serving hot path."""
     return _load() is not None
 
 
@@ -116,6 +161,16 @@ def pack_u24_i32(ids: np.ndarray) -> np.ndarray:
     out = np.empty(ids.shape + (3,), np.uint8)
     lib.pack_u24_i32(_ptr(ids), ids.size, _ptr(out))
     return out
+
+
+def hash128(arr: np.ndarray) -> bytes:
+    """16-byte content digest of a contiguous array's bytes (one pass)."""
+    lib = _load()
+    assert lib is not None
+    arr = np.ascontiguousarray(arr)
+    out = np.empty(2, np.uint64)
+    lib.hash128(_ptr(arr), arr.nbytes, _ptr(out))
+    return out.tobytes()
 
 
 def f32_to_bf16(wts: np.ndarray) -> np.ndarray:

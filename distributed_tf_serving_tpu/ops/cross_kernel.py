@@ -29,6 +29,29 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
 DEFAULT_ROW_TILE = 256
+# Conservative per-core VMEM working budget (v4/v5e have ~16 MB; leave room
+# for Mosaic's own scratch and double-buffered DMA).
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def fits_vmem(
+    d: int,
+    num_layers: int,
+    compute_dtype=jnp.bfloat16,
+    row_tile: int = DEFAULT_ROW_TILE,
+) -> bool:
+    """Whether the fused kernel's resident set fits in VMEM.
+
+    The constant-index weight BlockSpec keeps ALL L (dp x dp) matrices
+    resident at once; past the budget Mosaic fails to lower (or thrashes),
+    so callers must fall back to the per-layer XLA path."""
+    dp = _pad_to(d, LANE)
+    itemsize = jnp.dtype(compute_dtype).itemsize
+    weights = num_layers * dp * dp * itemsize
+    biases = num_layers * dp * 4
+    # x0 tile (cd) + x0_f32 + f32 layer temps + out tile ~ 12 bytes/elem.
+    tiles = row_tile * dp * 12
+    return weights + biases + tiles <= VMEM_BUDGET_BYTES
 
 
 def _cross_kernel(x0_ref, w_ref, b_ref, out_ref, *, num_layers: int, compute_dtype):
@@ -69,6 +92,12 @@ def fused_cross_apply(
     in compute_dtype (matching models/dcn.py cross_apply output)."""
     n, d = x0.shape
     num_layers = w.shape[0]
+    if not fits_vmem(d, num_layers, compute_dtype, row_tile):
+        raise ValueError(
+            f"fused cross stack (d={d}, L={num_layers}) exceeds the "
+            f"{VMEM_BUDGET_BYTES >> 20} MB VMEM budget; use cross_apply "
+            "(models/dcn.py falls back automatically via fits_vmem)"
+        )
     dp = _pad_to(d, LANE)
     bn = min(row_tile, _pad_to(n, 8))
     np_ = _pad_to(n, bn)

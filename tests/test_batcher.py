@@ -144,3 +144,79 @@ def test_occupancy_stats(servable):
         assert 0 < batcher.stats.mean_occupancy < 1
     finally:
         batcher.stop()
+
+
+def test_input_cache_correctness_and_hits(servable):
+    """Repeat content must hit the device-input cache and still score
+    exactly; distinct content must never false-hit (the digest keys the
+    device array, so a collision would silently serve wrong scores)."""
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        a = make_arrays(8, seed=1)
+        b = make_arrays(8, seed=2)
+        got_a1 = batcher.submit(servable, a).result()["prediction_node"]
+        h0, m0 = batcher.input_cache.hits, batcher.input_cache.misses
+        got_a2 = batcher.submit(servable, a).result()["prediction_node"]
+        assert batcher.input_cache.hits > h0  # repeat content skipped upload
+        assert batcher.input_cache.misses == m0
+        got_b = batcher.submit(servable, b).result()["prediction_node"]
+        assert batcher.input_cache.misses > m0  # fresh content is a miss
+        np.testing.assert_array_equal(got_a1, got_a2)
+        np.testing.assert_allclose(got_a1, reference_scores(servable, a), rtol=1e-5)
+        np.testing.assert_allclose(got_b, reference_scores(servable, b), rtol=1e-5)
+        assert batcher.input_cache.bytes_skipped > 0
+    finally:
+        batcher.stop()
+
+
+def test_input_cache_lru_eviction(servable):
+    """Capacity bounds device memory: oldest entries fall out, and a
+    re-submission after eviction re-uploads (miss) with correct results."""
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0, input_cache_entries=2).start()
+    try:
+        payloads = [make_arrays(8, seed=s) for s in range(3)]
+        for p in payloads:
+            batcher.submit(servable, p).result()
+        assert len(batcher.input_cache._lru) <= 2
+        m0 = batcher.input_cache.misses
+        got = batcher.submit(servable, payloads[0]).result()["prediction_node"]
+        assert batcher.input_cache.misses > m0  # was evicted -> fresh upload
+        np.testing.assert_allclose(got, reference_scores(servable, payloads[0]), rtol=1e-5)
+    finally:
+        batcher.stop()
+
+
+def test_input_cache_disabled_with_run_fn(servable):
+    """A custom run_fn (the sharded-mesh executor) owns device placement;
+    the batcher must not interpose its own device arrays."""
+    def run_fn(sv, arrays):
+        return sv.model.apply(sv.params, {
+            "feat_ids": fold_ids_host(arrays["feat_ids"], CFG.vocab_size),
+            "feat_wts": arrays["feat_wts"],
+        })
+
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0, run_fn=run_fn).start()
+    try:
+        assert batcher.input_cache is None
+        got = batcher.submit(servable, make_arrays(6)).result()["prediction_node"]
+        assert got.shape == (6,)
+    finally:
+        batcher.stop()
+
+
+def test_input_cache_adaptive_bypass(servable):
+    """Unique-only traffic must stop paying the digest: after probe_window
+    misses with ~no hits the cache flips to pass-through (and results stay
+    correct)."""
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        batcher.input_cache.probe_window = 6  # shrink for the test
+        for s in range(5):
+            batcher.submit(servable, make_arrays(8, seed=100 + s)).result()
+        assert batcher.input_cache.bypassed
+        assert not batcher.input_cache._lru  # device refs dropped
+        p = make_arrays(8, seed=200)
+        got = batcher.submit(servable, p).result()["prediction_node"]
+        np.testing.assert_allclose(got, reference_scores(servable, p), rtol=1e-5)
+    finally:
+        batcher.stop()

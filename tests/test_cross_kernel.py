@@ -60,3 +60,39 @@ def test_rejects_v1_layers():
     layers = _cross_init(jax.random.PRNGKey(0), 2, 64, False, jnp.float32)
     with pytest.raises(ValueError, match="full-matrix"):
         cross_params_to_stacked(layers)
+
+
+def test_fits_vmem_guard():
+    """All L (dp x dp) weight matrices are VMEM-resident in the fused
+    kernel; oversized stacks must be rejected up front (on hardware they
+    would fail at Mosaic lowering), and the model must fall back."""
+    from distributed_tf_serving_tpu.ops.cross_kernel import fits_vmem, fused_cross_apply
+
+    assert fits_vmem(512, 3)                 # serving-sized: fits
+    assert not fits_vmem(2816, 3)            # 43 fields x 64 dim padded: ~48MB
+    big_d = 2816
+    x0 = jnp.zeros((8, big_d), jnp.bfloat16)
+    w = jnp.zeros((3, big_d, big_d), jnp.bfloat16)
+    b = jnp.zeros((3, big_d), jnp.float32)
+    with pytest.raises(ValueError, match="VMEM"):
+        fused_cross_apply(x0, w, b, interpret=True)
+
+
+def test_model_falls_back_when_over_vmem():
+    """use_pallas_cross on an over-budget config must still score (via the
+    XLA cross path) instead of erroring."""
+    import numpy as np
+    from distributed_tf_serving_tpu.models import ModelConfig, build_model
+
+    cfg = ModelConfig(
+        num_fields=43, vocab_size=4096, embed_dim=64, mlp_dims=(32,),
+        num_cross_layers=3, compute_dtype="bfloat16", use_pallas_cross=True,
+    )
+    model = build_model("dcn_v2", cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = {
+        "feat_ids": np.zeros((4, 43), np.int32),
+        "feat_wts": np.ones((4, 43), np.float32),
+    }
+    out = model.apply(params, batch)["prediction_node"]
+    assert out.shape == (4,) and bool(jnp.all(jnp.isfinite(out)))

@@ -383,3 +383,36 @@ def test_fanout_failure_is_typed(three_backends):
     with pytest.raises(PredictClientError) as ei:
         asyncio.run(go())
     assert ei.value.host == "127.0.0.1:1"
+
+
+def test_channels_per_host_stripes_and_scores(three_backends):
+    """channels_per_host multiplies HTTP/2 connections, not semantics:
+    scores must equal the single-channel client's."""
+    servable = _servable(version=1, seed=0)
+    arrays = _arrays(n=10, seed=13)
+    want = _golden(servable, arrays)
+
+    async def go():
+        async with ShardedPredictClient(
+            three_backends, "DCN", channels_per_host=3
+        ) as client:
+            return [await client.predict(arrays) for _ in range(4)]
+
+    for merged in asyncio.run(go()):
+        np.testing.assert_allclose(merged, want, rtol=1e-6)
+
+
+def test_closed_loop_mp_smoke(three_backends):
+    """Spawn-context load generators: end-to-end report over a real socket.
+    Single process x small load — the multi-core fan-out is exercised on
+    real hosts, not this 1-core rig."""
+    from distributed_tf_serving_tpu.client import run_closed_loop_mp
+
+    payload = make_payload(candidates=12, num_fields=CFG.num_fields)
+    report = run_closed_loop_mp(
+        list(three_backends), payload, model_name="DCN",
+        processes=1, concurrency=2, requests_per_worker=2, warmup_requests=1,
+    )
+    s = report.summary()
+    assert s["requests"] == 4
+    assert s["qps"] > 0 and s["p99_ms"] >= s["p50_ms"] > 0
