@@ -1,0 +1,29 @@
+"""Interop with TensorFlow SavedModel exports.
+
+The reference's serving backend loads an externally-exported SavedModel
+(SURVEY.md §0: the "DCN" model with signature `serving_default`,
+DCNClient.java:33-34); users migrating from TF-Serving arrive with such a
+directory. This package ingests it: signatures/metadata parse natively with
+the vendored wire-compatible protos, variable values extract once via a
+TensorFlow subprocess (the TensorBundle format needs TF; TF never enters
+the serving process — its descriptor pool collides with ours), and the
+result lands in the model zoo's native param trees / checkpoint format.
+"""
+
+from .savedmodel import (
+    SavedModelImportError,
+    extract_variables,
+    import_savedmodel,
+    map_variables,
+    read_saved_model,
+    signatures_from_meta_graph,
+)
+
+__all__ = [
+    "SavedModelImportError",
+    "extract_variables",
+    "import_savedmodel",
+    "map_variables",
+    "read_saved_model",
+    "signatures_from_meta_graph",
+]

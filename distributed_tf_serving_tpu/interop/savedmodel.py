@@ -1,0 +1,362 @@
+"""SavedModel importer: TF-Serving's on-disk format -> native Servable.
+
+Split by dependency, so serving never imports TensorFlow:
+
+1. `read_saved_model` / `signatures_from_meta_graph` — parse
+   `saved_model.pb` with the vendored wire-compatible bindings
+   (proto/tf_saved_model.proto); the exported SignatureDefs
+   (meta_graph.proto:297-311 upstream) become the Servable's signature map,
+   so GetModelMetadata answers exactly what the original export declared.
+2. `extract_variables` — one-shot subprocess running TensorFlow's
+   checkpoint reader over `variables/variables.*` (TensorBundle is TF's
+   private format) and dumping a plain `.npz`. TF must not be imported in
+   this process: both register `tensorflow.*` symbols in the default
+   descriptor pool and collide.
+3. `map_variables` — places the extracted arrays into a model-zoo param
+   tree: explicit {param-path: variable-name} mapping when given, otherwise
+   unique-shape matching with an order-based tiebreak for repeated shapes
+   (MLP stacks); ambiguity fails loudly rather than guessing silently.
+
+`import_savedmodel` composes the three into a registry-ready Servable;
+the CLI (`python -m distributed_tf_serving_tpu.interop.savedmodel`)
+converts a SavedModel directory into a native checkpoint
+(train/checkpoint.py layout) for `--checkpoint` serving.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import pathlib
+import re
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+log = logging.getLogger("dts_tpu.interop")
+
+from ..models.base import ModelConfig, build_model
+from ..models.registry import Servable, Signature, TensorSpec
+
+SERVE_TAG = "serve"
+# Object-graph checkpoints suffix every value; strip for readable names.
+_ATTR_SUFFIX = "/.ATTRIBUTES/VARIABLE_VALUE"
+
+
+class SavedModelImportError(RuntimeError):
+    pass
+
+
+# --------------------------------------------------------------- metadata
+
+
+def read_saved_model(saved_model_dir):
+    """Parse `saved_model.pb` natively; returns the SavedModel proto."""
+    from ..proto import tf_saved_model_pb2 as sm
+
+    path = pathlib.Path(saved_model_dir) / "saved_model.pb"
+    if not path.exists():
+        raise SavedModelImportError(f"{path} not found (not a SavedModel dir?)")
+    proto = sm.SavedModel()
+    proto.ParseFromString(path.read_bytes())
+    if not proto.meta_graphs:
+        raise SavedModelImportError(f"{path} contains no meta graphs")
+    return proto
+
+
+def serve_meta_graph(saved_model):
+    """The MetaGraphDef tagged `serve` (TF-Serving's loader selects by tag;
+    meta_graph.proto:62-66 upstream), falling back to the only graph."""
+    for mg in saved_model.meta_graphs:
+        if SERVE_TAG in mg.meta_info_def.tags:
+            return mg
+    if len(saved_model.meta_graphs) == 1:
+        return saved_model.meta_graphs[0]
+    tags = [list(m.meta_info_def.tags) for m in saved_model.meta_graphs]
+    raise SavedModelImportError(f"no meta graph tagged {SERVE_TAG!r}; have {tags}")
+
+
+def signatures_from_meta_graph(meta_graph) -> dict[str, Signature]:
+    """SignatureDef map -> native Signature map (alias keys, dtypes, shapes
+    preserved; -1/unknown dims become None)."""
+
+    def specs(infos) -> tuple[TensorSpec, ...]:
+        out = []
+        for alias, info in sorted(infos.items()):
+            if info.tensor_shape.unknown_rank:
+                dims = None  # unknown rank, not a scalar (tensor_shape.proto)
+            else:
+                dims = tuple(
+                    None if d.size < 0 else int(d.size) for d in info.tensor_shape.dim
+                )
+            out.append(TensorSpec(name=alias, dtype=info.dtype, shape=dims))
+        return tuple(out)
+
+    sigs = {}
+    for name, sd in meta_graph.signature_def.items():
+        sigs[name] = Signature(
+            inputs=specs(sd.inputs),
+            outputs=specs(sd.outputs),
+            method_name=sd.method_name,
+        )
+    if not sigs:
+        raise SavedModelImportError("SavedModel declares no signatures")
+    return sigs
+
+
+# -------------------------------------------------------------- variables
+
+_EXTRACT_SCRIPT = textwrap.dedent(
+    """
+    import sys
+    import numpy as np
+    import tensorflow as tf
+
+    prefix, out = sys.argv[1], sys.argv[2]
+    reader = tf.train.load_checkpoint(prefix)
+    arrays = {}
+    for name in reader.get_variable_to_shape_map():
+        if (
+            "OBJECT_GRAPH" in name
+            or "/.OPTIMIZER_SLOT/" in name
+            or name.split("/")[0] == "save_counter"
+        ):
+            continue  # bookkeeping / optimizer state, not servable weights
+        arrays[name] = reader.get_tensor(name)
+    np.savez(out, **arrays)
+    print(f"extracted {len(arrays)} variables")
+    """
+)
+
+
+def extract_variables(saved_model_dir, out_npz, python: str = sys.executable) -> pathlib.Path:
+    """Dump the SavedModel's variables to `.npz` via a TensorFlow subprocess.
+
+    TF is only needed here (its TensorBundle reader); the output npz is the
+    cacheable, TF-free artifact everything downstream consumes.
+    """
+    prefix = pathlib.Path(saved_model_dir) / "variables" / "variables"
+    out_npz = pathlib.Path(out_npz)
+    proc = subprocess.run(
+        [python, "-c", _EXTRACT_SCRIPT, str(prefix), str(out_npz)],
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    if proc.returncode != 0:
+        raise SavedModelImportError(
+            f"variable extraction failed (is tensorflow importable by {python}?):\n"
+            f"{proc.stderr.strip()[-2000:]}"
+        )
+    return out_npz
+
+
+def _clean_name(name: str) -> str:
+    return name[: -len(_ATTR_SUFFIX)] if name.endswith(_ATTR_SUFFIX) else name
+
+
+def _is_bookkeeping(name: str) -> bool:
+    """TF checkpoint bookkeeping that must never bind to model params (also
+    filtered at extraction; re-checked here for pre-extracted npz files)."""
+    return name.split("/")[0] == "save_counter" or "OBJECT_GRAPH" in name
+
+
+def _natural_key(name: str):
+    """Numeric-aware sort: layer_2 before layer_10 (plain lexicographic
+    ordering would shuffle same-shape stacks past 10 layers)."""
+    return [int(tok) if tok.isdigit() else tok for tok in re.split(r"(\d+)", name)]
+
+
+def _flatten_params(tree, prefix=()) -> dict[str, np.ndarray]:
+    """Nested dict/list param tree -> {'a/b/0/w': array} paths."""
+    flat = {}
+    if isinstance(tree, dict):
+        items = tree.items()
+    elif isinstance(tree, (list, tuple)):
+        items = enumerate(tree)
+    else:
+        return {"/".join(map(str, prefix)): tree}
+    for key, sub in items:
+        flat.update(_flatten_params(sub, prefix + (str(key),)))
+    return flat
+
+
+def _unflatten_like(template, flat: dict[str, np.ndarray], prefix=()):
+    if isinstance(template, dict):
+        return {k: _unflatten_like(v, flat, prefix + (str(k),)) for k, v in template.items()}
+    if isinstance(template, (list, tuple)):
+        seq = [_unflatten_like(v, flat, prefix + (str(i),)) for i, v in enumerate(template)]
+        return type(template)(seq) if isinstance(template, tuple) else seq
+    return flat["/".join(map(str, prefix))]
+
+
+def map_variables(
+    variables: dict[str, np.ndarray],
+    target_params,
+    mapping: dict[str, str] | None = None,
+):
+    """Place extracted TF variables into a model-zoo param tree.
+
+    `mapping` is {our-param-path: tf-variable-name} and wins outright
+    (variable names accepted with or without the checkpoint's
+    `/.ATTRIBUTES/VARIABLE_VALUE` suffix). Without it: exact-shape matching
+    — a shape held by exactly one variable and one slot binds directly;
+    repeated shapes (MLP stacks exported as layer_0/kernel, layer_1/kernel,
+    ...) bind in natural-sorted-name vs tree order (numeric-aware, so
+    layer_10 sorts after layer_2, matching both TF's and our layer
+    numbering). Any leftover ambiguity or shape mismatch raises with the
+    full candidate list.
+    """
+    variables = {
+        _clean_name(k): np.asarray(v)
+        for k, v in variables.items()
+        if not _is_bookkeeping(_clean_name(k))
+    }
+    flat_target = _flatten_params(target_params)
+    chosen: dict[str, str] = {}
+
+    if mapping:
+        mapping = {p: _clean_name(v) for p, v in mapping.items()}
+        missing = set(mapping) - set(flat_target)
+        if missing:
+            raise SavedModelImportError(f"mapping names unknown param paths: {sorted(missing)}")
+        bad_vars = set(mapping.values()) - set(variables)
+        if bad_vars:
+            raise SavedModelImportError(
+                f"mapping names unknown variables: {sorted(bad_vars)}; "
+                f"available: {sorted(variables)}"
+            )
+        chosen.update(mapping)
+    unmapped_params = [p for p in flat_target if p not in chosen]
+    used = set(chosen.values())
+    unused_vars = [v for v in variables if v not in used]
+
+    by_shape_vars: dict[tuple, list[str]] = {}
+    for v in sorted(unused_vars, key=_natural_key):
+        by_shape_vars.setdefault(tuple(variables[v].shape), []).append(v)
+    by_shape_params: dict[tuple, list[str]] = {}
+    for p in unmapped_params:  # tree order
+        by_shape_params.setdefault(tuple(np.shape(flat_target[p])), []).append(p)
+
+    for shape, params in by_shape_params.items():
+        cands = by_shape_vars.get(shape, [])
+        if len(cands) < len(params):
+            raise SavedModelImportError(
+                f"no variable of shape {shape} for param(s) {params}; "
+                f"unused variables: { {v: variables[v].shape for v in unused_vars} }"
+            )
+        if len(cands) > len(params):
+            raise SavedModelImportError(
+                f"ambiguous shape {shape}: params {params} vs variables {cands}; "
+                "pass an explicit mapping for these"
+            )
+        for p, v in zip(params, cands):
+            chosen[p] = v
+
+    flat_out = {}
+    for path, var_name in chosen.items():
+        arr = variables[var_name]
+        want = flat_target[path]
+        if tuple(arr.shape) != tuple(np.shape(want)):
+            raise SavedModelImportError(
+                f"shape mismatch for {path}: param {np.shape(want)} vs "
+                f"variable {var_name} {arr.shape}"
+            )
+        flat_out[path] = arr.astype(np.asarray(want).dtype, copy=False)
+    return _unflatten_like(target_params, flat_out)
+
+
+def _npz_cache_fresh(saved_model_dir, npz_path) -> bool:
+    """The cached extraction is valid only if it postdates every SavedModel
+    artifact — an in-place re-export must trigger re-extraction, never serve
+    stale weights."""
+    npz_path = pathlib.Path(npz_path)
+    if not npz_path.exists():
+        return False
+    cache_mtime = npz_path.stat().st_mtime
+    root = pathlib.Path(saved_model_dir)
+    sources = [root / "saved_model.pb", *(root / "variables").glob("variables.*")]
+    return all(not p.exists() or p.stat().st_mtime <= cache_mtime for p in sources)
+
+
+# ----------------------------------------------------------------- import
+
+
+def import_savedmodel(
+    saved_model_dir,
+    kind: str,
+    config: ModelConfig,
+    name: str = "DCN",
+    version: int = 1,
+    mapping: dict[str, str] | None = None,
+    variables_npz=None,
+    python: str = sys.executable,
+) -> Servable:
+    """SavedModel directory -> registry-ready Servable.
+
+    `kind`/`config` select the model-zoo family the weights belong to (the
+    graph itself is not replayed — the zoo's jitted forward IS the TPU
+    program; SURVEY.md §7 design stance). `variables_npz` reuses an
+    already-extracted dump and skips the TF subprocess.
+    """
+    import jax
+
+    meta_graph = serve_meta_graph(read_saved_model(saved_model_dir))
+    signatures = signatures_from_meta_graph(meta_graph)
+
+    if variables_npz is None:
+        variables_npz = pathlib.Path(saved_model_dir) / "variables_extracted.npz"
+        if _npz_cache_fresh(saved_model_dir, variables_npz):
+            log.info("reusing extracted variables cache %s", variables_npz)
+        else:
+            extract_variables(saved_model_dir, variables_npz, python=python)
+    with np.load(variables_npz) as npz:
+        variables = {k: npz[k] for k in npz.files}
+
+    model = build_model(kind, config)
+    template = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(0)))
+    params = map_variables(variables, template, mapping)
+    return Servable(
+        name=name, version=version, model=model, params=params, signatures=signatures
+    )
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    from ..train.checkpoint import save_servable
+
+    parser = argparse.ArgumentParser(
+        description="Convert a TF SavedModel into a native servable checkpoint"
+    )
+    parser.add_argument("saved_model_dir")
+    parser.add_argument("out_dir")
+    parser.add_argument("--kind", default="dcn_v2")
+    parser.add_argument("--name", default="DCN")
+    parser.add_argument("--version", type=int, default=1)
+    parser.add_argument("--num-fields", type=int, default=43)
+    parser.add_argument("--vocab-size", type=int, default=1 << 20)
+    parser.add_argument("--embed-dim", type=int, default=16)
+    parser.add_argument("--mapping", help="JSON file: {param-path: variable-name}")
+    args = parser.parse_args(argv)
+
+    config = ModelConfig(
+        name=args.name,
+        num_fields=args.num_fields,
+        vocab_size=args.vocab_size,
+        embed_dim=args.embed_dim,
+    )
+    mapping = json.loads(pathlib.Path(args.mapping).read_text()) if args.mapping else None
+    servable = import_savedmodel(
+        args.saved_model_dir, args.kind, config,
+        name=args.name, version=args.version, mapping=mapping,
+    )
+    save_servable(args.out_dir, servable, kind=args.kind)
+    print(f"imported {args.name} v{args.version} ({args.kind}) -> {args.out_dir}; "
+          f"signatures: {sorted(servable.signatures)}")
+
+
+if __name__ == "__main__":
+    main()

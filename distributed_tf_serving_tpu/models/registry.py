@@ -44,12 +44,17 @@ class SignatureNotFoundError(KeyError):
 class TensorSpec:
     name: str  # logical tensor alias (the request/response map key)
     dtype: int  # fw.DataType value
-    shape: tuple[int | None, ...]  # None = unknown/batch dim
+    # Per-dim None = unknown/batch dim; whole-shape None = unknown rank
+    # (tensor_shape.proto unknown_rank, seen in imported SavedModels).
+    shape: tuple[int | None, ...] | None
 
     def to_tensor_info(self) -> mg.TensorInfo:
         info = mg.TensorInfo(name=f"{self.name}:0", dtype=self.dtype)
-        for s in self.shape:
-            info.tensor_shape.dim.add(size=-1 if s is None else s)
+        if self.shape is None:
+            info.tensor_shape.unknown_rank = True
+        else:
+            for s in self.shape:
+                info.tensor_shape.dim.add(size=-1 if s is None else s)
         return info
 
 

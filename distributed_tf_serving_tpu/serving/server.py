@@ -106,11 +106,13 @@ def load_demo_servable(
     name: str = "DCN",
     version: int = 1,
     seed: int = 0,
+    config: ModelConfig | None = None,
     **config_overrides,
 ) -> Servable:
     """Build + register a randomly-initialized servable (demo/bench path;
-    production params come from train/checkpoint.py)."""
-    config = ModelConfig(name=name, **config_overrides)
+    production params come from train/checkpoint.py). An explicit `config`
+    wins over keyword overrides."""
+    config = config or ModelConfig(name=name, **config_overrides)
     model = build_model(kind, config)
     params = jax.jit(model.init)(jax.random.PRNGKey(seed))
     jax.block_until_ready(params)
@@ -126,8 +128,15 @@ def load_demo_servable(
     return servable
 
 
-def build_stack(cfg: ServerConfig, checkpoint: str | None = None):
-    """Registry + batcher (+ mesh executor) + impl from a ServerConfig."""
+def build_stack(
+    cfg: ServerConfig,
+    checkpoint: str | None = None,
+    savedmodel: str | None = None,
+    model_config: ModelConfig | None = None,
+):
+    """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
+    model_config (the TOML [model] section) pins the architecture for the
+    demo and SavedModel-import paths; checkpoints carry their own."""
     registry = ServableRegistry()
     run_fn = None
     mesh = None
@@ -144,7 +153,19 @@ def build_stack(cfg: ServerConfig, checkpoint: str | None = None):
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
 
-    if checkpoint:
+    if savedmodel:
+        from ..interop import import_savedmodel
+
+        servable = import_savedmodel(
+            savedmodel,
+            cfg.model_kind,
+            model_config
+            or ModelConfig(name=cfg.model_name, num_fields=cfg.num_fields),
+            name=cfg.model_name,
+        )
+        registry.load(servable)
+        log.info("imported SavedModel %s: %s v%d", savedmodel, servable.name, servable.version)
+    elif checkpoint:
         from ..train.checkpoint import load_servable
 
         servable = load_servable(checkpoint, mesh=mesh)
@@ -152,7 +173,11 @@ def build_stack(cfg: ServerConfig, checkpoint: str | None = None):
         log.info("loaded checkpoint %s: %s v%d", checkpoint, servable.name, servable.version)
     else:
         servable = load_demo_servable(
-            registry, kind=cfg.model_kind, name=cfg.model_name, num_fields=cfg.num_fields
+            registry,
+            kind=cfg.model_kind,
+            name=cfg.model_name,
+            config=model_config,
+            num_fields=cfg.num_fields,
         )
     if cfg.warmup:
         log.info("warming bucket ladder %s", cfg.buckets)
@@ -164,6 +189,11 @@ def serve(argv=None) -> None:
     parser = argparse.ArgumentParser(description="TPU-native PredictionService")
     parser.add_argument("--config", help="TOML config file ([server] section)")
     parser.add_argument("--checkpoint", help="servable checkpoint dir (train.save_servable)")
+    parser.add_argument(
+        "--savedmodel",
+        help="TF SavedModel dir to import and serve (interop/savedmodel.py; "
+        "model family/config from --model-kind/--num-fields)",
+    )
     parser.add_argument("--port", type=int)
     parser.add_argument("--host")
     parser.add_argument("--model-kind", dest="model_kind")
@@ -178,7 +208,19 @@ def serve(argv=None) -> None:
                         help="periodically log a metrics snapshot")
     args = parser.parse_args(argv)
 
-    cfg = load_config(args.config)["server"] if args.config else ServerConfig()
+    cfgs = load_config(args.config) if args.config else {"server": ServerConfig()}
+    cfg = cfgs["server"]
+    model_config = cfgs.get("model")
+    if model_config is not None:
+        # Explicit CLI architecture flags win over the TOML [model] section
+        # (same precedence as the ServerConfig overrides below).
+        arch_overrides = {
+            k: v
+            for k, v in {"num_fields": args.num_fields, "name": args.model_name}.items()
+            if v is not None
+        }
+        if arch_overrides:
+            model_config = dataclasses.replace(model_config, **arch_overrides)
     field_names = {f.name for f in dataclasses.fields(ServerConfig)}
     overrides = {
         k: v for k, v in vars(args).items() if v is not None and k in field_names
@@ -189,7 +231,12 @@ def serve(argv=None) -> None:
         cfg = dataclasses.replace(cfg, **overrides)
 
     logging.basicConfig(level=logging.INFO)
-    registry, batcher, impl, servable, mesh = build_stack(cfg, checkpoint=args.checkpoint)
+    registry, batcher, impl, servable, mesh = build_stack(
+        cfg,
+        checkpoint=args.checkpoint,
+        savedmodel=args.savedmodel,
+        model_config=model_config,
+    )
     metrics = ServerMetrics()
     server, port = create_server(impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics)
     server.start()

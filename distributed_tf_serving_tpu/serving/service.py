@@ -102,9 +102,10 @@ class PredictionServiceImpl:
                     f"input {name!r}: dtype {arr.dtype} != signature "
                     f"{fw.DataType.Name(spec.dtype)}",
                 )
-            if arr.ndim != len(spec.shape) or any(
-                s is not None and s != d for s, d in zip(spec.shape, arr.shape)
-            ):
+            if spec.shape is not None and (
+                arr.ndim != len(spec.shape)
+                or any(s is not None and s != d for s, d in zip(spec.shape, arr.shape))
+            ):  # shape None = unknown rank: any shape passes
                 raise ServiceError(
                     "INVALID_ARGUMENT",
                     f"input {name!r}: shape {arr.shape} incompatible with signature "

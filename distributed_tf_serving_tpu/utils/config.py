@@ -52,6 +52,12 @@ class ClientConfig:
     use_tensor_content: bool = True
 
 
+def _model_config_cls():
+    from ..models.base import ModelConfig
+
+    return ModelConfig
+
+
 _SECTIONS = {"server": ServerConfig, "client": ClientConfig}
 
 
@@ -69,12 +75,17 @@ def _coerce(cls, data: dict[str, Any]):
 
 
 def load_config(path) -> dict[str, Any]:
-    """Parse a TOML file with optional [server] / [client] sections."""
+    """Parse a TOML file with optional [server] / [client] / [model]
+    sections. [model] carries the architecture knobs (ModelConfig) — present
+    only when the file sets any, so callers can tell "explicit architecture"
+    from "use defaults"."""
     raw = tomllib.loads(pathlib.Path(path).read_text())
     out: dict[str, Any] = {}
     for section, cls in _SECTIONS.items():
         out[section] = _coerce(cls, raw.get(section, {}))
-    extra = set(raw) - set(_SECTIONS)
+    if "model" in raw:
+        out["model"] = _coerce(_model_config_cls(), raw["model"])
+    extra = set(raw) - set(_SECTIONS) - {"model"}
     if extra:
         raise ValueError(f"unknown config sections: {sorted(extra)}")
     return out

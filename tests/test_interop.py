@@ -1,0 +1,296 @@
+"""SavedModel interop tests.
+
+Two tiers mirroring the importer's dependency split (interop/savedmodel.py):
+native-proto metadata + npz-mapped variables run TF-free; one integration
+test drives the real TensorFlow export/extract path in subprocesses (TF
+must never be imported into this process — duplicate descriptor symbols).
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu.interop import (
+    SavedModelImportError,
+    import_savedmodel,
+    map_variables,
+    read_saved_model,
+    signatures_from_meta_graph,
+)
+from distributed_tf_serving_tpu.interop.savedmodel import (
+    _flatten_params,
+    serve_meta_graph,
+)
+from distributed_tf_serving_tpu.models import ModelConfig, build_model
+from distributed_tf_serving_tpu.models.registry import PREDICT_METHOD
+from distributed_tf_serving_tpu.proto import tf_framework_pb2 as fw
+from distributed_tf_serving_tpu.proto import tf_saved_model_pb2 as sm
+
+CFG = ModelConfig(
+    num_fields=6, vocab_size=997, embed_dim=4, mlp_dims=(16,),
+    num_cross_layers=2, compute_dtype="float32",
+)
+
+
+def _write_fake_savedmodel(tmp_path, signature_inputs=True) -> pathlib.Path:
+    """A SavedModel directory written with OUR bindings: byte-compatible
+    with what TF writes (same message schema), no TF involved."""
+    proto = sm.SavedModel(saved_model_schema_version=1)
+    mg = proto.meta_graphs.add()
+    mg.meta_info_def.tags.append("serve")
+    sd = mg.signature_def["serving_default"]
+    sd.method_name = PREDICT_METHOD
+    if signature_inputs:
+        for alias, dtype in (("feat_ids", fw.DT_INT64), ("feat_wts", fw.DT_FLOAT)):
+            info = sd.inputs[alias]
+            info.name = f"{alias}:0"
+            info.dtype = dtype
+            info.tensor_shape.dim.add(size=-1)
+            info.tensor_shape.dim.add(size=CFG.num_fields)
+        out = sd.outputs["prediction_node"]
+        out.name = "prediction_node:0"
+        out.dtype = fw.DT_FLOAT
+        out.tensor_shape.dim.add(size=-1)
+    d = tmp_path / "export"
+    d.mkdir(exist_ok=True)
+    (d / "saved_model.pb").write_bytes(proto.SerializeToString())
+    return d
+
+
+def _donor_npz(tmp_path, seed=11) -> tuple[pathlib.Path, dict]:
+    """Variables npz in TF object-graph naming, tree-ordered so the
+    repeated-shape (cross layers) order tiebreak is exercised."""
+    model = build_model("dcn_v2", CFG)
+    donor = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(seed)))
+    flat = _flatten_params(donor)
+    npz_path = tmp_path / "vars.npz"
+    np.savez(
+        npz_path,
+        **{
+            f"model/layer{i:03d}/.ATTRIBUTES/VARIABLE_VALUE": v
+            for i, (_, v) in enumerate(flat.items())
+        },
+    )
+    return npz_path, donor
+
+
+def test_import_savedmodel_golden_scores(tmp_path):
+    """End-to-end TF-free import: signatures come from saved_model.pb, the
+    weights land in the right slots — scores must equal applying the donor
+    params directly."""
+    export = _write_fake_savedmodel(tmp_path)
+    npz_path, donor = _donor_npz(tmp_path)
+
+    servable = import_savedmodel(
+        export, "dcn_v2", CFG, name="DCN", version=7, variables_npz=npz_path
+    )
+    assert servable.version == 7
+    sig = servable.signature("serving_default")
+    assert {s.name for s in sig.inputs} == {"feat_ids", "feat_wts"}
+    assert sig.method_name == PREDICT_METHOD
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "feat_ids": rng.randint(0, CFG.vocab_size, size=(5, CFG.num_fields)).astype(np.int32),
+        "feat_wts": rng.rand(5, CFG.num_fields).astype(np.float32),
+    }
+    model = build_model("dcn_v2", CFG)
+    want = np.asarray(model.apply(donor, batch)["prediction_node"])
+    got = np.asarray(servable(batch)["prediction_node"])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_signatures_parse_shapes_and_dtypes(tmp_path):
+    export = _write_fake_savedmodel(tmp_path)
+    mg = serve_meta_graph(read_saved_model(export))
+    sigs = signatures_from_meta_graph(mg)
+    ids = next(s for s in sigs["serving_default"].inputs if s.name == "feat_ids")
+    assert ids.dtype == fw.DT_INT64
+    assert ids.shape == (None, CFG.num_fields)
+
+
+def test_map_variables_explicit_mapping_and_errors():
+    model = build_model("dcn_v2", CFG)
+    template = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(0)))
+    flat = _flatten_params(template)
+    variables = {f"v/{p}": v for p, v in flat.items()}
+    mapping = {p: f"v/{p}" for p in flat}
+    out = map_variables(variables, template, mapping)
+    np.testing.assert_array_equal(_flatten_params(out)["embedding"], flat["embedding"])
+    # unknown param path in the mapping fails loudly
+    with pytest.raises(SavedModelImportError, match="unknown param paths"):
+        map_variables(variables, template, {"nope/w": "v/embedding"})
+    # missing shape fails loudly
+    with pytest.raises(SavedModelImportError, match="no variable of shape"):
+        map_variables({"only": np.zeros((1, 1))}, template)
+
+
+def test_map_variables_ambiguous_shape_rejected():
+    template = {"a": np.zeros((3, 3)), "b": np.zeros((2,))}
+    variables = {"x": np.ones((3, 3)), "y": np.ones((3, 3)), "z": np.ones((2,))}
+    with pytest.raises(SavedModelImportError, match="ambiguous shape"):
+        map_variables(variables, template)
+
+
+def test_missing_dir_and_empty_signatures(tmp_path):
+    with pytest.raises(SavedModelImportError, match="not found"):
+        read_saved_model(tmp_path / "nope")
+    proto = sm.SavedModel(saved_model_schema_version=1)
+    mg = proto.meta_graphs.add()
+    mg.meta_info_def.tags.append("serve")
+    d = tmp_path / "empty"
+    d.mkdir()
+    (d / "saved_model.pb").write_bytes(proto.SerializeToString())
+    with pytest.raises(SavedModelImportError, match="no signatures"):
+        signatures_from_meta_graph(serve_meta_graph(read_saved_model(d)))
+
+
+_TF_EXPORT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+out = sys.argv[1]
+
+class M(tf.Module):
+    def __init__(self):
+        super().__init__()
+        self.w = tf.Variable(np.arange(12, dtype=np.float32).reshape(4, 3), name="w")
+        self.b = tf.Variable(np.array([1.5, -2.5], np.float32), name="b")
+
+    @tf.function(input_signature=[tf.TensorSpec([None, 4], tf.float32, name="x")])
+    def __call__(self, x):
+        return {"y": tf.matmul(x, self.w) + self.b[0]}
+
+m = M()
+tf.saved_model.save(m, out, signatures={"serving_default": m.__call__})
+print("saved")
+"""
+
+
+@pytest.mark.slow
+def test_real_tensorflow_export_roundtrip(tmp_path):
+    """A genuine tf.saved_model.save export: our native parser must read its
+    signature_def and the subprocess extractor must recover exact variable
+    values. Skips when TF is not importable by the interpreter."""
+    export = tmp_path / "tf_export"
+    proc = subprocess.run(
+        [sys.executable, "-c", _TF_EXPORT, str(export)],
+        capture_output=True, text=True, timeout=300,
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"tensorflow unavailable: {proc.stderr.strip()[-300:]}")
+
+    # metadata: native parse of TF's own saved_model.pb
+    mg = serve_meta_graph(read_saved_model(export))
+    sigs = signatures_from_meta_graph(mg)
+    assert "serving_default" in sigs
+    x = next(s for s in sigs["serving_default"].inputs if s.dtype == fw.DT_FLOAT)
+    assert x.shape[-1] == 4
+
+    # variables: TF-subprocess extraction recovers exact values
+    from distributed_tf_serving_tpu.interop import extract_variables
+
+    npz_path = extract_variables(export, tmp_path / "vars.npz")
+    with np.load(npz_path) as npz:
+        arrays = {k: npz[k] for k in npz.files}
+    by_shape = {tuple(v.shape): v for v in arrays.values()}
+    np.testing.assert_array_equal(
+        by_shape[(4, 3)], np.arange(12, dtype=np.float32).reshape(4, 3)
+    )
+    np.testing.assert_array_equal(by_shape[(2,)], np.array([1.5, -2.5], np.float32))
+
+
+def test_build_stack_serves_savedmodel(tmp_path):
+    """server.py --savedmodel path: stack comes up with the imported
+    servable registered and scoring (pre-extracted npz cache honored)."""
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import ServerConfig
+
+    export = _write_fake_savedmodel(tmp_path)
+    npz_path, donor = _donor_npz(tmp_path)
+    npz_path.rename(export / "variables_extracted.npz")
+
+    cfg = ServerConfig(
+        model_kind="dcn_v2", model_name="DCN", num_fields=CFG.num_fields, warmup=False
+    )
+    registry, batcher, impl, servable, mesh = build_stack(
+        cfg, savedmodel=str(export), model_config=CFG
+    )
+    try:
+        assert registry.resolve("DCN").version == 1
+        rng = np.random.RandomState(1)
+        arrays = {
+            "feat_ids": rng.randint(0, CFG.vocab_size, size=(4, CFG.num_fields)).astype(np.int64),
+            "feat_wts": rng.rand(4, CFG.num_fields).astype(np.float32),
+        }
+        out = batcher.submit(servable, arrays).result()
+        assert out["prediction_node"].shape == (4,)
+    finally:
+        batcher.stop()
+
+
+def test_bookkeeping_vars_filtered_and_natural_order():
+    """save_counter must never bind to a scalar param, and repeated-shape
+    stacks must bind numerically (layer_10 after layer_2), not
+    lexicographically."""
+    template = {"bias": np.zeros(()), "stack": [np.zeros((3, 3)) for _ in range(12)]}
+    variables = {"save_counter/.ATTRIBUTES/VARIABLE_VALUE": np.int64(41),
+                 "model/bias/.ATTRIBUTES/VARIABLE_VALUE": np.float64(0.25)}
+    for i in range(12):
+        variables[f"model/layer_{i}/kernel/.ATTRIBUTES/VARIABLE_VALUE"] = np.full(
+            (3, 3), float(i)
+        )
+    out = map_variables(variables, template)
+    assert float(out["bias"]) == 0.25  # save_counter did not steal the slot
+    for i in range(12):
+        assert out["stack"][i][0, 0] == float(i), f"layer {i} got wrong weights"
+
+
+def test_mapping_unknown_variable_rejected():
+    template = {"w": np.zeros((2, 2))}
+    variables = {"real/w": np.ones((2, 2))}
+    with pytest.raises(SavedModelImportError, match="unknown variables"):
+        map_variables(variables, template, {"w": "typo"})
+    # suffixed checkpoint names (as copied from tf.train.list_variables) work
+    out = map_variables(variables, template, {"w": "real/w/.ATTRIBUTES/VARIABLE_VALUE"})
+    np.testing.assert_array_equal(out["w"], np.ones((2, 2)))
+
+
+def test_unknown_rank_signature(tmp_path):
+    proto = sm.SavedModel(saved_model_schema_version=1)
+    mg = proto.meta_graphs.add()
+    mg.meta_info_def.tags.append("serve")
+    sd = mg.signature_def["serving_default"]
+    sd.method_name = PREDICT_METHOD
+    info = sd.inputs["anything"]
+    info.dtype = fw.DT_FLOAT
+    info.tensor_shape.unknown_rank = True
+    d = tmp_path / "ur"
+    d.mkdir()
+    (d / "saved_model.pb").write_bytes(proto.SerializeToString())
+    sigs = signatures_from_meta_graph(serve_meta_graph(read_saved_model(d)))
+    spec = sigs["serving_default"].inputs[0]
+    assert spec.shape is None  # unknown rank, NOT scalar ()
+    ti = spec.to_tensor_info()
+    assert ti.tensor_shape.unknown_rank
+
+
+def test_npz_cache_staleness(tmp_path):
+    """An in-place re-export (newer saved_model.pb) must invalidate the
+    extracted-variables cache."""
+    import time as _time
+
+    from distributed_tf_serving_tpu.interop.savedmodel import _npz_cache_fresh
+
+    export = _write_fake_savedmodel(tmp_path)
+    npz = export / "variables_extracted.npz"
+    np.savez(npz, x=np.zeros(1))
+    assert _npz_cache_fresh(export, npz)
+    _time.sleep(0.02)
+    (export / "saved_model.pb").touch()  # re-export
+    assert not _npz_cache_fresh(export, npz)

@@ -108,3 +108,23 @@ def test_toml_unknown_key_rejected(tmp_path):
     p.write_text("[srever]\n")
     with pytest.raises(ValueError, match="unknown config sections"):
         load_config(p)
+
+
+def test_model_section_in_toml(tmp_path):
+    """[model] section maps onto ModelConfig; absent section stays absent so
+    callers can distinguish explicit architecture from defaults."""
+    from distributed_tf_serving_tpu.utils.config import load_config
+
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        '[server]\nport = 9000\n\n'
+        '[model]\nnum_fields = 6\nvocab_size = 997\nembed_dim = 4\n'
+        'mlp_dims = [16]\ncompute_dtype = "float32"\n'
+    )
+    out = load_config(p)
+    assert out["server"].port == 9000
+    assert out["model"].num_fields == 6
+    assert out["model"].mlp_dims == (16,)
+    p2 = tmp_path / "bare.toml"
+    p2.write_text("[server]\nport = 9001\n")
+    assert "model" not in load_config(p2)

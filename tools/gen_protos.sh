@@ -9,6 +9,7 @@ cd "$(dirname "$0")/../distributed_tf_serving_tpu/proto"
 protoc -I. \
   --python_out=. \
   tf_framework.proto tf_graph.proto tf_example.proto tf_meta_graph.proto \
+  tf_saved_model.proto \
   serving_apis.proto
 
 # protoc emits absolute imports between generated modules; rewrite them to
