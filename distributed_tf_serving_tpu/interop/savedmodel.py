@@ -160,7 +160,11 @@ def _clean_name(name: str) -> str:
 def _is_bookkeeping(name: str) -> bool:
     """TF checkpoint bookkeeping that must never bind to model params (also
     filtered at extraction; re-checked here for pre-extracted npz files)."""
-    return name.split("/")[0] == "save_counter" or "OBJECT_GRAPH" in name
+    return (
+        name.split("/")[0] == "save_counter"
+        or "OBJECT_GRAPH" in name
+        or "/.OPTIMIZER_SLOT/" in name
+    )
 
 
 def _natural_key(name: str):
@@ -252,6 +256,19 @@ def map_variables(
                 f"ambiguous shape {shape}: params {params} vs variables {cands}; "
                 "pass an explicit mapping for these"
             )
+        if len(params) > 1:
+            # Order-based binding is only trustworthy within ONE indexed
+            # stack (cross/0/w, cross/1/w, ...). Same-shape params from
+            # different groups (a cross kernel and an MLP kernel both
+            # (16,16)) would zip against variable names whose sort order
+            # has no relation to our tree order — fail instead of guessing.
+            stems = {re.sub(r"\d+", "#", p) for p in params}
+            if len(stems) > 1:
+                raise SavedModelImportError(
+                    f"shape {shape} is shared across different param groups "
+                    f"{sorted(stems)} ({params}); order-based matching would "
+                    "guess — pass an explicit mapping for these"
+                )
         for p, v in zip(params, cands):
             chosen[p] = v
 
@@ -268,6 +285,31 @@ def map_variables(
     return _unflatten_like(target_params, flat_out)
 
 
+def _check_signature_aliases(signatures, kind: str, config: ModelConfig) -> None:
+    """The imported signature is the client-facing contract, but the zoo
+    forward consumes fixed keys; an alias mismatch would import cleanly and
+    then fail every Predict at apply time — fail fast here instead."""
+    from ..models.registry import DEFAULT_SIGNATURE, ctr_signatures
+
+    default = signatures.get(DEFAULT_SIGNATURE)
+    if default is None:
+        return  # no serving_default: caller serves by explicit signature
+    dense = config.num_dense_features if kind == "dlrm" else None
+    required = {s.name for s in ctr_signatures(config.num_fields, with_dense=dense)[
+        DEFAULT_SIGNATURE
+    ].inputs}
+    have = {s.name for s in default.inputs}
+    missing = required - have
+    if missing:
+        raise SavedModelImportError(
+            f"SavedModel serving_default inputs {sorted(have)} lack the "
+            f"{kind!r} forward's required aliases {sorted(missing)}; this "
+            "export's request contract does not match the model family "
+            "(re-export with matching input names, or extend the importer "
+            "with an alias map)"
+        )
+
+
 def _npz_cache_fresh(saved_model_dir, npz_path) -> bool:
     """The cached extraction is valid only if it postdates every SavedModel
     artifact — an in-place re-export must trigger re-extraction, never serve
@@ -277,8 +319,10 @@ def _npz_cache_fresh(saved_model_dir, npz_path) -> bool:
         return False
     cache_mtime = npz_path.stat().st_mtime
     root = pathlib.Path(saved_model_dir)
+    # Strict <: a source touched in the same mtime tick as the cache counts
+    # as newer (re-extracting costs seconds; stale weights cost correctness).
     sources = [root / "saved_model.pb", *(root / "variables").glob("variables.*")]
-    return all(not p.exists() or p.stat().st_mtime <= cache_mtime for p in sources)
+    return all(not p.exists() or p.stat().st_mtime < cache_mtime for p in sources)
 
 
 # ----------------------------------------------------------------- import
@@ -305,6 +349,7 @@ def import_savedmodel(
 
     meta_graph = serve_meta_graph(read_saved_model(saved_model_dir))
     signatures = signatures_from_meta_graph(meta_graph)
+    _check_signature_aliases(signatures, kind, config)
 
     if variables_npz is None:
         variables_npz = pathlib.Path(saved_model_dir) / "variables_extracted.npz"

@@ -102,10 +102,18 @@ class PredictionServiceImpl:
                     f"input {name!r}: dtype {arr.dtype} != signature "
                     f"{fw.DataType.Name(spec.dtype)}",
                 )
-            if spec.shape is not None and (
+            if spec.shape is None:
+                # Unknown-rank signature (imported SavedModels): any shape
+                # passes EXCEPT rank 0 — batching needs a candidate dim.
+                if arr.ndim == 0:
+                    raise ServiceError(
+                        "INVALID_ARGUMENT",
+                        f"input {name!r}: scalar tensor has no candidate dimension",
+                    )
+            elif (
                 arr.ndim != len(spec.shape)
                 or any(s is not None and s != d for s, d in zip(spec.shape, arr.shape))
-            ):  # shape None = unknown rank: any shape passes
+            ):
                 raise ServiceError(
                     "INVALID_ARGUMENT",
                     f"input {name!r}: shape {arr.shape} incompatible with signature "

@@ -142,3 +142,56 @@ class Trainer:
             scores.append(np.asarray(out["prediction_node"]))
             labels.append(raw["labels"])
         return auc(np.concatenate(labels), np.concatenate(scores))
+
+
+def main(argv=None) -> None:
+    """Train on the synthetic CTR stream and write a servable checkpoint:
+    the train -> checkpoint -> serve workflow's first leg."""
+    import argparse
+
+    from ..models.base import ModelConfig, build_model
+    from ..models.registry import Servable, ctr_signatures
+    from .checkpoint import save_servable
+
+    parser = argparse.ArgumentParser(description="Train a CTR model, save a servable")
+    parser.add_argument("--out", required=True, help="checkpoint output dir")
+    parser.add_argument("--kind", default="dcn_v2")
+    parser.add_argument("--name", default="DCN")
+    parser.add_argument("--version", type=int, default=1)
+    parser.add_argument("--steps", type=int, default=1000)
+    parser.add_argument("--batch-size", type=int, default=512)
+    parser.add_argument("--learning-rate", type=float, default=1e-3)
+    parser.add_argument("--num-fields", type=int, default=43)
+    parser.add_argument("--vocab-size", type=int, default=1 << 20)
+    parser.add_argument("--embed-dim", type=int, default=16)
+    parser.add_argument("--mesh-devices", type=int, default=0,
+                        help=">0: shard training over the first n devices")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    config = ModelConfig(
+        name=args.name, num_fields=args.num_fields,
+        vocab_size=args.vocab_size, embed_dim=args.embed_dim,
+    )
+    mesh = None
+    if args.mesh_devices:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.mesh_devices)
+    model = build_model(args.kind, config)
+    trainer = Trainer(model, mesh=mesh, learning_rate=args.learning_rate, seed=args.seed)
+    metrics = trainer.fit(args.steps, batch_size=args.batch_size, log_every=max(args.steps // 10, 1))
+    auc_val = trainer.eval_auc()
+    servable = Servable(
+        name=args.name, version=args.version, model=model,
+        params=trainer.state.params, signatures=ctr_signatures(config.num_fields),
+    )
+    save_servable(args.out, servable, kind=args.kind)
+    print(
+        f"trained {args.kind} {args.steps} steps: loss={metrics['loss']:.4f} "
+        f"auc={auc_val:.4f} ({metrics['examples_per_s']:.0f} ex/s) -> {args.out}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -294,3 +294,46 @@ def test_npz_cache_staleness(tmp_path):
     _time.sleep(0.02)
     (export / "saved_model.pb").touch()  # re-export
     assert not _npz_cache_fresh(export, npz)
+
+
+def test_cross_group_same_shape_requires_mapping():
+    """Same shape appearing in DIFFERENT param groups must not be zipped by
+    name order (cross kernel vs MLP kernel both (4,4) here): demand an
+    explicit mapping instead of guessing."""
+    template = {"cross": [{"w": np.zeros((4, 4))}], "mlp": [{"w": np.zeros((4, 4))}]}
+    variables = {"a": np.ones((4, 4)), "b": np.full((4, 4), 2.0)}
+    with pytest.raises(SavedModelImportError, match="different param groups"):
+        map_variables(variables, template)
+    out = map_variables(variables, template, {"cross/0/w": "a", "mlp/0/w": "b"})
+    assert out["cross"][0]["w"][0, 0] == 1.0 and out["mlp"][0]["w"][0, 0] == 2.0
+
+
+def test_alias_mismatch_fails_at_import(tmp_path):
+    """An export whose serving_default aliases don't cover the model
+    family's request keys must fail at import, not at first Predict."""
+    proto = sm.SavedModel(saved_model_schema_version=1)
+    mg = proto.meta_graphs.add()
+    mg.meta_info_def.tags.append("serve")
+    sd = mg.signature_def["serving_default"]
+    sd.method_name = PREDICT_METHOD
+    info = sd.inputs["x"]  # not feat_ids/feat_wts
+    info.dtype = fw.DT_FLOAT
+    info.tensor_shape.dim.add(size=-1)
+    d = tmp_path / "alias"
+    d.mkdir()
+    (d / "saved_model.pb").write_bytes(proto.SerializeToString())
+    npz = tmp_path / "v.npz"
+    np.savez(npz, w=np.zeros((1, 1)))
+    with pytest.raises(SavedModelImportError, match="required aliases"):
+        import_savedmodel(d, "dcn_v2", CFG, variables_npz=npz)
+
+
+def test_optimizer_slots_filtered_in_premade_npz():
+    template = {"w": np.zeros((2, 2))}
+    variables = {
+        "w/.ATTRIBUTES/VARIABLE_VALUE": np.ones((2, 2)),
+        "w/.OPTIMIZER_SLOT/adam/m/.ATTRIBUTES/VARIABLE_VALUE": np.full((2, 2), 9.0),
+        "w/.OPTIMIZER_SLOT/adam/v/.ATTRIBUTES/VARIABLE_VALUE": np.full((2, 2), 9.0),
+    }
+    out = map_variables(variables, template)  # not ambiguous: slots filtered
+    np.testing.assert_array_equal(out["w"], np.ones((2, 2)))

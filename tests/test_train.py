@@ -100,3 +100,24 @@ def test_checkpoint_restores_onto_mesh(tmp_path):
     emb = loaded.params["embedding"]
     assert emb.sharding.spec == jax.sharding.PartitionSpec(MODEL_AXIS, None)
     np.testing.assert_array_equal(np.asarray(emb), np.asarray(sv.params["embedding"]))
+
+
+def test_trainer_cli_writes_servable_checkpoint(tmp_path):
+    """The train -> checkpoint -> serve workflow's first leg: the CLI must
+    produce a checkpoint load_servable can serve."""
+    from distributed_tf_serving_tpu.train.checkpoint import load_servable
+    from distributed_tf_serving_tpu.train.trainer import main
+
+    out = tmp_path / "ckpt"
+    main([
+        "--out", str(out), "--steps", "3", "--batch-size", "32",
+        "--num-fields", "6", "--vocab-size", "512", "--embed-dim", "4",
+        "--name", "CLI", "--version", "5",
+    ])
+    sv = load_servable(out)
+    assert sv.name == "CLI" and sv.version == 5
+    batch = {
+        "feat_ids": np.zeros((3, 6), np.int32),
+        "feat_wts": np.ones((3, 6), np.float32),
+    }
+    assert sv(batch)["prediction_node"].shape == (3,)
