@@ -294,10 +294,11 @@ def _check_signature_aliases(signatures, kind: str, config: ModelConfig) -> None
     default = signatures.get(DEFAULT_SIGNATURE)
     if default is None:
         return  # no serving_default: caller serves by explicit signature
-    dense = config.num_dense_features if kind == "dlrm" else None
-    required = {s.name for s in ctr_signatures(config.num_fields, with_dense=dense)[
-        DEFAULT_SIGNATURE
-    ].inputs}
+    # dense_features is intentionally NOT required: the DLRM forward
+    # substitutes zeros when it is absent, so sparse-only exports serve fine.
+    required = {
+        s.name for s in ctr_signatures(config.num_fields)[DEFAULT_SIGNATURE].inputs
+    }
     have = {s.name for s in default.inputs}
     missing = required - have
     if missing:

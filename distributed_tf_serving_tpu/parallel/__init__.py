@@ -10,9 +10,13 @@ from .mesh import (
     replicated,
     vocab_sharding,
 )
+from .multihost import MultiHostRunner, global_mesh, init_distributed
 from .sharding import batch_shardings, param_shardings, place_params
 
 __all__ = [
+    "MultiHostRunner",
+    "global_mesh",
+    "init_distributed",
     "DATA_AXIS",
     "MODEL_AXIS",
     "make_mesh",

@@ -40,9 +40,12 @@ class ShardedExecutor:
     collectives the embedding sharding implies.
     """
 
-    def __init__(self, mesh: Mesh, compress_transfer: bool = True):
+    def __init__(
+        self, mesh: Mesh, compress_transfer: bool = True, tensor_parallel: bool = False
+    ):
         self.mesh = mesh
         self.compress_transfer = compress_transfer
+        self.tensor_parallel = tensor_parallel
         # Weak keys: an unloaded servable must not pin its placed params or
         # compiled executable (same rationale as DynamicBatcher._jitted).
         self._placed: weakref.WeakKeyDictionary[Servable, Any] = weakref.WeakKeyDictionary()
@@ -74,7 +77,10 @@ class ShardedExecutor:
                 }
                 return apply(params, batch)
 
-            self._placed[key] = (servable.params, place_params(servable.params, mesh))
+            self._placed[key] = (
+                servable.params,
+                place_params(servable.params, mesh, self.tensor_parallel),
+            )
             self._jitted[key] = (jax.jit(run), spec)
         return self._jitted[key], self._placed[key][1]
 

@@ -1,0 +1,170 @@
+"""Multi-host (DCN-tier) execution: one logical model spanning TPU hosts.
+
+The reference's distribution fabric tops out at independent servers behind a
+client-side scatter (SURVEY.md §2.5: gRPC was the system's entire
+"collective"); that topology is preserved by the fan-out client. This module
+adds the tier the reference never had: a single SPMD program over a
+multi-host slice, where the mesh spans every process's chips, intra-host
+traffic rides ICI and cross-host collectives ride DCN via JAX's distributed
+runtime (`jax.distributed.initialize`).
+
+Serving on a multi-host mesh has a control-flow problem the training loop
+doesn't: requests arrive at ONE host, but every process must enter the same
+jitted computation. The standard JAX answer is a leader/follower step
+protocol built on device collectives:
+
+- `MultiHostRunner.lead(batch)` (process 0): broadcast the batch bytes to
+  all processes (`multihost_utils.broadcast_one_to_all`), run the sharded
+  forward, and gather the candidate-sharded output back to the host
+  (`process_allgather` preserves shard order => the reference's host-order
+  merge semantics, DCNClient.java:161-164).
+- `MultiHostRunner.follow()` (others): block on the same broadcast, execute
+  the same step, loop until the leader broadcasts shutdown.
+
+The gRPC frontend then runs on process 0 only, with `lead` as the batcher's
+run_fn; followers are headless `follow()` loops. Wire protocol and client
+behavior are unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, make_mesh
+
+log = logging.getLogger("dts_tpu.multihost")
+
+_SHUTDOWN = -1  # broadcast control word: negative candidate count = stop
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """jax.distributed.initialize with env fallbacks (COORDINATOR_ADDRESS /
+    NUM_PROCESSES / PROCESS_ID), idempotent for single-process runs."""
+    if num_processes is None:
+        num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address or os.environ["COORDINATOR_ADDRESS"],
+        num_processes=num_processes,
+        process_id=int(os.environ["PROCESS_ID"]) if process_id is None else process_id,
+    )
+
+
+def global_mesh(model_parallel: int = 1) -> Mesh:
+    """Mesh over every device of every process: data-major layout so the
+    candidate axis spans hosts (each host feeds its contiguous rows).
+    Delegates to make_mesh — jax.devices() is already the global (all-
+    process) device list under jax.distributed."""
+    return make_mesh(model_parallel=model_parallel)
+
+
+@dataclasses.dataclass
+class MultiHostRunner:
+    """Leader/follower step protocol over a multi-host mesh.
+
+    `score_fn(params, batch) -> scores` must be identical on every process
+    (same model, same params placement). `batch_template` fixes the wire
+    schema — key order, shapes (leading dim = the padded bucket), dtypes —
+    that every broadcast carries; every process must pass IDENTICAL
+    shapes/dtypes into the collective, so lead() validates batches against
+    the template instead of letting a mismatch hang the slice. Static
+    shapes also keep all processes on one traced program.
+    """
+
+    mesh: Mesh
+    params: Any
+    score_fn: Callable[[Any, dict[str, jax.Array]], jax.Array]
+    batch_template: dict[str, np.ndarray]  # zero-filled exemplar batch
+
+    def __post_init__(self):
+        mesh = self.mesh
+        self._keys = tuple(sorted(self.batch_template))
+        self._zeros = {
+            k: np.zeros_like(self.batch_template[k]) for k in self._keys
+        }
+        self.bucket = next(iter(self._zeros.values())).shape[0]
+
+        def run(params, batch):
+            batch = {
+                k: jax.lax.with_sharding_constraint(
+                    v, NamedSharding(mesh, P(DATA_AXIS, *(None,) * (v.ndim - 1)))
+                )
+                for k, v in batch.items()
+            }
+            return self.score_fn(params, batch)
+
+        self._jitted = jax.jit(run)
+
+    # ------- control-plane broadcast: (header, *batch arrays in key order)
+
+    def _broadcast(self, n: int, batch: dict[str, np.ndarray] | None):
+        arrays = self._zeros if batch is None else {k: batch[k] for k in self._keys}
+        header = np.asarray([n], np.int64)
+        out = multihost_utils.broadcast_one_to_all(
+            (header, *(arrays[k] for k in self._keys))
+        )
+        shared = {k: np.asarray(v) for k, v in zip(self._keys, out[1:])}
+        return int(out[0][0]), shared
+
+    def _validate(self, batch: dict[str, np.ndarray]) -> None:
+        if set(batch) != set(self._keys):
+            raise ValueError(
+                f"batch keys {sorted(batch)} != template keys {list(self._keys)}"
+            )
+        for k in self._keys:
+            want = self._zeros[k]
+            got = batch[k]
+            if got.shape != want.shape or got.dtype != want.dtype:
+                raise ValueError(
+                    f"batch[{k!r}] is {got.dtype}{got.shape}, template requires "
+                    f"{want.dtype}{want.shape} (pad to the bucket and convert "
+                    "dtypes before lead(): all processes must broadcast "
+                    "identical buffers or the collective hangs)"
+                )
+
+    def _step(self, batch: dict[str, np.ndarray]) -> np.ndarray:
+        scores = self._jitted(self.params, batch)
+        # Candidate-sharded output -> full host-order array on every process
+        # (shard order preserved: the reference's concat semantics).
+        return np.asarray(multihost_utils.process_allgather(scores, tiled=True))
+
+    def lead(self, batch: dict[str, np.ndarray]) -> np.ndarray:
+        """Process 0: score one padded batch across all hosts; returns the
+        full score vector (caller slices off padding)."""
+        self._validate(batch)
+        _, shared = self._broadcast(self.bucket, batch)
+        return self._step(shared)
+
+    def follow(self) -> None:
+        """Processes 1..k-1: execute leader-broadcast steps until shutdown.
+
+        A failing step is logged and the loop continues — the follower must
+        return to the broadcast or the leader deadlocks in the next
+        collective. (If the step failure corrupted collective state itself,
+        the runtime surfaces that on the next broadcast; nothing to save.)
+        """
+        while True:
+            n, batch = self._broadcast(_SHUTDOWN, None)
+            if n < 0:
+                return
+            try:
+                self._step(batch)
+            except Exception:
+                log.exception("follower step failed; resuming broadcast loop")
+
+    def shutdown(self) -> None:
+        """Process 0: release followers."""
+        self._broadcast(_SHUTDOWN, None)

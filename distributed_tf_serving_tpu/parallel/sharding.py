@@ -4,7 +4,12 @@ Layout policy (the scaling-book recipe: pick a mesh, annotate shardings, let
 XLA insert collectives):
 - vocab-major tables (embedding bags, wide/linear scalar tables): rows split
   over the model axis — the memory-heavy EP dimension for DLRM-class models.
-- everything else (MLP/cross weights — small for CTR models): replicated.
+- dense MLP/cross weights: replicated by default (small for CTR models), or
+  — with tensor_parallel — split over the model axis (the §2.4 TP row):
+  2-D weights column-sharded on the output-feature dim (row-sharded when
+  only the input dim divides), matching biases sharded alongside. XLA's
+  SPMD partitioner derives the activation all-gathers/psums the layout
+  implies; dims that don't divide the axis stay replicated.
 - batches: candidates split over the data axis, replicating the reference's
   per-host candidate shards (DCNClient.java:46-55) on-mesh.
 """
@@ -22,14 +27,26 @@ from .mesh import DATA_AXIS, MODEL_AXIS
 VOCAB_MAJOR_KEYS = ("embedding", "wide", "linear")
 
 
-def param_shardings(params: Any, mesh: Mesh) -> Any:
+def param_shardings(params: Any, mesh: Mesh, tensor_parallel: bool = False) -> Any:
     """NamedSharding tree matching `params`: vocab tables split over the
-    model axis, the rest replicated."""
+    model axis; dense weights replicated, or model-axis split when
+    tensor_parallel (divisible dims only)."""
+    tp = mesh.shape[MODEL_AXIS]
 
     def rule(path, leaf):
         keys = {getattr(p, "key", None) for p in path}
-        if keys & set(VOCAB_MAJOR_KEYS) and getattr(leaf, "ndim", 0) >= 1:
-            return NamedSharding(mesh, P(MODEL_AXIS, *(None,) * (leaf.ndim - 1)))
+        ndim = getattr(leaf, "ndim", 0)
+        if keys & set(VOCAB_MAJOR_KEYS) and ndim >= 1:
+            return NamedSharding(mesh, P(MODEL_AXIS, *(None,) * (ndim - 1)))
+        if tensor_parallel and tp > 1:
+            shape = getattr(leaf, "shape", ())
+            if ndim == 2:
+                if shape[1] % tp == 0:  # column split (output features)
+                    return NamedSharding(mesh, P(None, MODEL_AXIS))
+                if shape[0] % tp == 0:  # row split (input features)
+                    return NamedSharding(mesh, P(MODEL_AXIS, None))
+            elif ndim == 1 and shape[0] % tp == 0:
+                return NamedSharding(mesh, P(MODEL_AXIS))
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(rule, params)
@@ -43,6 +60,6 @@ def batch_shardings(batch: dict, mesh: Mesh) -> dict:
     }
 
 
-def place_params(params: Any, mesh: Mesh) -> Any:
+def place_params(params: Any, mesh: Mesh, tensor_parallel: bool = False) -> Any:
     """Device-put a param tree according to param_shardings."""
-    return jax.device_put(params, param_shardings(params, mesh))
+    return jax.device_put(params, param_shardings(params, mesh, tensor_parallel))

@@ -144,7 +144,11 @@ def build_stack(
         from ..parallel import ShardedExecutor, make_mesh
 
         mesh = make_mesh(cfg.mesh_devices, model_parallel=cfg.model_parallel)
-        run_fn = ShardedExecutor(mesh, compress_transfer=cfg.compress_transfer)
+        run_fn = ShardedExecutor(
+            mesh,
+            compress_transfer=cfg.compress_transfer,
+            tensor_parallel=cfg.tensor_parallel,
+        )
     batcher = DynamicBatcher(
         buckets=cfg.buckets,
         max_wait_us=cfg.max_wait_us,
@@ -203,6 +207,10 @@ def serve(argv=None) -> None:
     parser.add_argument("--max-wait-us", dest="max_wait_us", type=int)
     parser.add_argument("--mesh-devices", dest="mesh_devices", type=int)
     parser.add_argument("--model-parallel", dest="model_parallel", type=int)
+    parser.add_argument(
+        "--tensor-parallel", dest="tensor_parallel", action="store_true", default=None,
+        help="shard dense MLP/cross weights over the model axis",
+    )
     parser.add_argument("--no-warmup", action="store_true")
     parser.add_argument("--metrics-every-s", type=float, default=0.0,
                         help="periodically log a metrics snapshot")

@@ -84,13 +84,14 @@ class Trainer:
         mesh: Mesh | None = None,
         learning_rate: float = 1e-3,
         seed: int = 0,
+        tensor_parallel: bool = False,
     ):
         self.model = model
         self.mesh = mesh
         self.optimizer = optax.adamw(learning_rate)
         params = jax.jit(model.init)(jax.random.PRNGKey(seed))
         if mesh is not None:
-            params = place_params(params, mesh)
+            params = place_params(params, mesh, tensor_parallel)
         opt_state = jax.jit(self.optimizer.init)(params)
         self.state = TrainState(params=params, opt_state=opt_state, step=jnp.asarray(0))
         self.step_fn = make_train_step(model, self.optimizer)

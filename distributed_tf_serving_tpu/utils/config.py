@@ -32,6 +32,9 @@ class ServerConfig:
     # mesh: 0 = single device; >0 = shard over first n devices
     mesh_devices: int = 0
     model_parallel: int = 1
+    # shard dense MLP/cross weights over the model axis (§2.4 TP row;
+    # embedding tables are always vocab-sharded when a mesh is used)
+    tensor_parallel: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -26,3 +26,9 @@ jax.config.update("jax_platforms", "cpu")
 
 def pytest_report_header(config):
     return f"jax devices: {jax.devices()}"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / TF-subprocess integration tests"
+    )

@@ -1,0 +1,109 @@
+"""Multi-host (DCN tier) integration: a REAL two-process jax.distributed
+run on CPU — coordinator handshake, global 8-device mesh spanning both
+processes, leader/follower broadcast protocol, ordered cross-process score
+gather (SURVEY.md §2.5's DCN tier, which the reference delegated entirely
+to client-side gRPC fan-out)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert len(jax.devices()) == 8, jax.devices()
+
+    from distributed_tf_serving_tpu.models import ModelConfig, build_model
+    from distributed_tf_serving_tpu.parallel.multihost import MultiHostRunner, global_mesh
+    from distributed_tf_serving_tpu.serving.batcher import fold_ids_host
+
+    cfg = ModelConfig(
+        num_fields=8, vocab_size=512, embed_dim=4, mlp_dims=(16,),
+        num_cross_layers=1, compute_dtype="float32",
+    )
+    model = build_model("dcn_v2", cfg)
+    params = model.init(jax.random.PRNGKey(0))  # deterministic: same on both
+
+    mesh = global_mesh(model_parallel=2)
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+    BUCKET = 32
+    template = {
+        "feat_ids": np.zeros((BUCKET, cfg.num_fields), np.int32),
+        "feat_wts": np.zeros((BUCKET, cfg.num_fields), np.float32),
+    }
+    runner = MultiHostRunner(
+        mesh=mesh, params=params,
+        score_fn=lambda p, b: model.apply(p, b)["prediction_node"],
+        batch_template=template,
+    )
+
+    if pid == 0:
+        rng = np.random.RandomState(7)
+        batch = {
+            "feat_ids": fold_ids_host(
+                rng.randint(0, 1 << 40, size=(BUCKET, cfg.num_fields)), cfg.vocab_size
+            ),
+            "feat_wts": rng.rand(BUCKET, cfg.num_fields).astype(np.float32),
+        }
+        scores = runner.lead(batch)
+        runner.shutdown()
+        golden = np.asarray(model.apply(params, batch)["prediction_node"])
+        np.testing.assert_allclose(scores, golden, rtol=1e-5)
+        print("MULTIHOST_OK", scores.shape)
+    else:
+        runner.follow()
+        print("FOLLOWER_DONE")
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_leader_follower_scores():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(port), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multihost workers hung; partial output: {outs}")
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    assert "MULTIHOST_OK" in outs[0]
+    assert "FOLLOWER_DONE" in outs[1]
