@@ -21,9 +21,9 @@ protocol built on device collectives:
 - `MultiHostRunner.follow()` (others): block on the same broadcast, execute
   the same step, loop until the leader broadcasts shutdown.
 
-The gRPC frontend then runs on process 0 only, with `lead` as the batcher's
-run_fn; followers are headless `follow()` loops. Wire protocol and client
-behavior are unchanged.
+The gRPC frontend then runs on process 0 only, with `as_run_fn()` plugged
+into a single-bucket DynamicBatcher; followers are headless `follow()`
+loops. Wire protocol and client behavior are unchanged.
 """
 
 from __future__ import annotations
@@ -151,10 +151,11 @@ class MultiHostRunner:
     def follow(self) -> None:
         """Processes 1..k-1: execute leader-broadcast steps until shutdown.
 
-        A failing step is logged and the loop continues — the follower must
-        return to the broadcast or the leader deadlocks in the next
-        collective. (If the step failure corrupted collective state itself,
-        the runtime surfaces that on the next broadcast; nothing to save.)
+        A failing step re-raises after logging: the leader is blocked inside
+        the same SPMD computation, so "recovering" into the broadcast loop
+        would only desynchronize the collective stream into a silent hang.
+        Exiting lets the distributed runtime's coordinator surface a real
+        error on every process — fail fast, restart the job.
         """
         while True:
             n, batch = self._broadcast(_SHUTDOWN, None)
@@ -163,8 +164,42 @@ class MultiHostRunner:
             try:
                 self._step(batch)
             except Exception:
-                log.exception("follower step failed; resuming broadcast loop")
+                log.exception(
+                    "follower step failed; exiting so the coordinator surfaces it"
+                )
+                raise
 
     def shutdown(self) -> None:
         """Process 0: release followers."""
         self._broadcast(_SHUTDOWN, None)
+
+    def as_run_fn(self, output_key: str = "prediction_node"):
+        """Adapter matching DynamicBatcher's run_fn contract
+        (run_fn(servable, arrays) -> {key: array}).
+
+        The runner executes ONE static bucket (all processes share one
+        traced program), so configure the batcher with a single-rung ladder
+        equal to the template's leading dim — e.g.
+        ``DynamicBatcher(buckets=(runner.bucket,), run_fn=runner.as_run_fn())``.
+        Arrays are padded up to the bucket here; the batcher slices each
+        request's rows back out of the returned full-bucket scores.
+        """
+
+        def run(servable, arrays: dict[str, np.ndarray]):
+            del servable  # single-model runner; params are bound at construction
+            n = next(iter(arrays.values())).shape[0]
+            if n > self.bucket:
+                raise ValueError(f"batch of {n} exceeds multihost bucket {self.bucket}")
+            padded = {}
+            for k in self._keys:
+                tmpl = self._zeros[k]
+                if k not in arrays:
+                    padded[k] = tmpl  # optional input (e.g. dense): zeros
+                    continue
+                arr = np.asarray(arrays[k], dtype=tmpl.dtype)
+                buf = np.zeros_like(tmpl)
+                buf[:n] = arr
+                padded[k] = buf
+            return {output_key: self.lead(padded)}
+
+        return run

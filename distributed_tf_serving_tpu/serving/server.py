@@ -172,7 +172,7 @@ def build_stack(
     elif checkpoint:
         from ..train.checkpoint import load_servable
 
-        servable = load_servable(checkpoint, mesh=mesh)
+        servable = load_servable(checkpoint, mesh=mesh, tensor_parallel=cfg.tensor_parallel)
         registry.load(servable)
         log.info("loaded checkpoint %s: %s v%d", checkpoint, servable.name, servable.version)
     else:

@@ -40,9 +40,11 @@ def save_servable(path, servable: Servable, kind: str) -> None:
         ckptr.save((path / PARAMS_DIR).absolute(), servable.params, force=True)
 
 
-def load_servable(path, mesh=None) -> Servable:
+def load_servable(path, mesh=None, tensor_parallel: bool = False) -> Servable:
     """Reconstruct a Servable; with a mesh, params restore pre-placed
-    (vocab tables over the model axis) instead of replicated."""
+    (vocab tables over the model axis; dense weights model-axis split too
+    when tensor_parallel) instead of replicated — restoring straight into
+    the serving layout avoids a second full-tree resharding pass."""
     path = pathlib.Path(path)
     manifest = json.loads((path / MANIFEST).read_text())
     config = ModelConfig(**{**manifest["config"], "mlp_dims": tuple(manifest["config"]["mlp_dims"]),
@@ -53,7 +55,7 @@ def load_servable(path, mesh=None) -> Servable:
     if mesh is not None:
         from ..parallel.sharding import param_shardings
 
-        shardings = param_shardings(target, mesh)
+        shardings = param_shardings(target, mesh, tensor_parallel)
         target = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             target,

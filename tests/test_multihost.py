@@ -64,9 +64,24 @@ _WORKER = textwrap.dedent(
             "feat_wts": rng.rand(BUCKET, cfg.num_fields).astype(np.float32),
         }
         scores = runner.lead(batch)
-        runner.shutdown()
         golden = np.asarray(model.apply(params, batch)["prediction_node"])
         np.testing.assert_allclose(scores, golden, rtol=1e-5)
+
+        # The advertised serving integration: a single-bucket DynamicBatcher
+        # on the leader with the runner as its run_fn.
+        from distributed_tf_serving_tpu.models import Servable, ctr_signatures
+        from distributed_tf_serving_tpu.serving import DynamicBatcher
+
+        sv = Servable(name="DCN", version=1, model=model, params=params,
+                      signatures=ctr_signatures(cfg.num_fields))
+        batcher = DynamicBatcher(
+            buckets=(BUCKET,), max_wait_us=0, run_fn=runner.as_run_fn()
+        ).start()
+        small = {k: v[:10] for k, v in batch.items()}
+        got = batcher.submit(sv, small).result()["prediction_node"]
+        np.testing.assert_allclose(got, golden[:10], rtol=1e-5)
+        batcher.stop()
+        runner.shutdown()
         print("MULTIHOST_OK", scores.shape)
     else:
         runner.follow()
