@@ -4,8 +4,12 @@ from .batcher import BatcherStats, BatchTooLargeError, DynamicBatcher, bucket_fo
 from .example_codec import ExampleDecodeError, decode_input, make_example
 from .server import GrpcPredictionService, create_server, load_demo_servable, serve
 from .service import PredictionServiceImpl, ServiceError
+from .version_watcher import VersionWatcher, VersionWatcherConfig, scan_versions
 
 __all__ = [
+    "VersionWatcher",
+    "VersionWatcherConfig",
+    "scan_versions",
     "DynamicBatcher",
     "BatcherStats",
     "BatchTooLargeError",

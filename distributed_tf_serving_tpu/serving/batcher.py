@@ -301,16 +301,41 @@ class DynamicBatcher:
         self._queue.put(item)
         return fut
 
+    @staticmethod
+    def warmup_arrays(servable: Servable, n: int) -> dict[str, np.ndarray]:
+        """Zero batch matching the servable's default-signature inputs —
+        signature-driven so optional inputs (DLRM dense_features) are
+        included and imported signatures warm what they actually declare."""
+        from .. import codec
+
+        sig = servable.signature("")
+        out = {}
+        for spec in sig.inputs:
+            if spec.shape is None or len(spec.shape) < 1:
+                continue  # unknown rank: nothing sensible to synthesize
+            dims = (n,) + tuple(d or 1 for d in spec.shape[1:])
+            out[spec.name] = np.zeros(dims, codec.dtype_to_numpy(spec.dtype))
+        return out
+
     def warmup(self, servable: Servable, buckets: tuple[int, ...] | None = None) -> None:
         """Precompile the bucket ladder for a servable (compile storms belong
-        at load time, not first-request time)."""
-        cfg = servable.model.config
+        at load time, not first-request time). Executes directly — only safe
+        before the batcher serves traffic; once live, use warmup_via_queue."""
         for b in buckets or self.buckets:
-            arrays = {
-                "feat_ids": np.zeros((b, cfg.num_fields), np.int32),
-                "feat_wts": np.zeros((b, cfg.num_fields), np.float32),
-            }
-            self._execute(servable, arrays)
+            self._execute(servable, prepare_inputs(servable.model, self.warmup_arrays(servable, b)))
+
+    def warmup_via_queue(
+        self, servable: Servable, buckets: tuple[int, ...] | None = None
+    ) -> None:
+        """Warm a servable THROUGH the request queue: compilation happens on
+        the batching thread exactly like live traffic, so hot-loading a new
+        model version never races the jit caches with in-flight requests."""
+        futures = [
+            self.submit(servable, self.warmup_arrays(servable, b))
+            for b in buckets or self.buckets
+        ]
+        for fut in futures:
+            fut.result(timeout=600)
 
     # ------------------------------------------------------------- internals
 

@@ -133,10 +133,13 @@ def build_stack(
     checkpoint: str | None = None,
     savedmodel: str | None = None,
     model_config: ModelConfig | None = None,
+    model_base_path: str | None = None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
-    demo and SavedModel-import paths; checkpoints carry their own."""
+    demo and SavedModel-import paths; checkpoints carry their own.
+    model_base_path switches to TF-Serving's versioned-directory lifecycle
+    (serving/version_watcher.py) instead of a fixed artifact."""
     registry = ServableRegistry()
     run_fn = None
     mesh = None
@@ -157,6 +160,36 @@ def build_stack(
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
 
+    if model_base_path:
+        if checkpoint or savedmodel:
+            raise ValueError(
+                "--model-base-path is mutually exclusive with "
+                "--checkpoint/--savedmodel (the base path owns version lifecycle)"
+            )
+        from .version_watcher import VersionWatcher, VersionWatcherConfig
+
+        watcher = VersionWatcher(
+            model_base_path,
+            registry,
+            VersionWatcherConfig(
+                model_name=cfg.model_name, model_kind=cfg.model_kind
+            ),
+            # warmup_via_queue: compilation rides the batching thread, so a
+            # hot-load never races the jit caches with live traffic.
+            warmup=batcher.warmup_via_queue if cfg.warmup else None,
+            model_config=model_config
+            or ModelConfig(name=cfg.model_name, num_fields=cfg.num_fields),
+            mesh=mesh,
+            tensor_parallel=cfg.tensor_parallel,
+        ).start()
+        versions = registry.models().get(cfg.model_name, [])
+        if not versions:
+            log.warning("no ready versions under %s yet; watching", model_base_path)
+            servable = None
+        else:
+            servable = registry.resolve(cfg.model_name)
+            log.info("serving %s versions %s from %s", cfg.model_name, versions, model_base_path)
+        return registry, batcher, impl, servable, mesh, watcher
     if savedmodel:
         from ..interop import import_savedmodel
 
@@ -186,7 +219,7 @@ def build_stack(
     if cfg.warmup:
         log.info("warming bucket ladder %s", cfg.buckets)
         batcher.warmup(servable)
-    return registry, batcher, impl, servable, mesh
+    return registry, batcher, impl, servable, mesh, None
 
 
 def serve(argv=None) -> None:
@@ -197,6 +230,11 @@ def serve(argv=None) -> None:
         "--savedmodel",
         help="TF SavedModel dir to import and serve (interop/savedmodel.py; "
         "model family/config from --model-kind/--num-fields)",
+    )
+    parser.add_argument(
+        "--model-base-path", dest="model_base_path",
+        help="TF-Serving-style versioned base dir (<base>/1/, <base>/2/, ...): "
+        "hot-loads new versions, retires old ones (serving/version_watcher.py)",
     )
     parser.add_argument("--port", type=int)
     parser.add_argument("--host")
@@ -239,19 +277,20 @@ def serve(argv=None) -> None:
         cfg = dataclasses.replace(cfg, **overrides)
 
     logging.basicConfig(level=logging.INFO)
-    registry, batcher, impl, servable, mesh = build_stack(
+    registry, batcher, impl, servable, mesh, watcher = build_stack(
         cfg,
         checkpoint=args.checkpoint,
         savedmodel=args.savedmodel,
         model_config=model_config,
+        model_base_path=args.model_base_path,
     )
     metrics = ServerMetrics()
     server, port = create_server(impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics)
     server.start()
     log.info(
         "PredictionService on %s:%d (model=%s kind=%s mesh=%s devices=%s)",
-        cfg.host, port, servable.name, cfg.model_kind,
-        dict(mesh.shape) if mesh else None, jax.devices(),
+        cfg.host, port, servable.name if servable else "<awaiting versions>",
+        cfg.model_kind, dict(mesh.shape) if mesh else None, jax.devices(),
     )
     try:
         if args.metrics_every_s > 0:
@@ -267,6 +306,8 @@ def serve(argv=None) -> None:
             server.wait_for_termination()
     finally:
         log.info("shutting down")
+        if watcher is not None:
+            watcher.stop()
         server.stop(2).wait()
         batcher.stop()
 

@@ -1,0 +1,194 @@
+"""Filesystem model-version lifecycle — TF-Serving's base-path convention.
+
+The reference delegates model loading/versioning to tensorflow_model_server
+(SURVEY.md §0 "implicit capabilities": model.proto:9-19 latest-version
+semantics), whose operational contract is a *base path* containing numeric
+version subdirectories: `<base>/1/`, `<base>/2/`, ... — the server loads the
+newest, hot-swaps when a new version directory appears, and unloads retired
+ones without dropping traffic. This module is that contract for the TPU
+runtime:
+
+- each version directory is either a native checkpoint
+  (train/checkpoint.py layout: servable.json + params/) or a TF SavedModel
+  export (saved_model.pb + variables/ — imported via interop/savedmodel.py);
+- a poller thread diffs the directory against loaded versions, loads new
+  ones (warming the batcher's bucket ladder BEFORE registering, so the
+  version flip never serves a cold cache), and unloads versions that fell
+  out of the retention window;
+- `ServableRegistry.resolve`'s latest-version default makes the swap atomic
+  from the client's view: requests pin a version or follow the newest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import pathlib
+import threading
+from typing import Callable
+
+from ..models.registry import Servable, ServableRegistry
+
+log = logging.getLogger("dts_tpu.versions")
+
+
+def scan_versions(base_path) -> dict[int, pathlib.Path]:
+    """Numeric subdirectories of the base path (TF-Serving's convention;
+    non-numeric entries are ignored, matching upstream behavior)."""
+    base = pathlib.Path(base_path)
+    if not base.is_dir():
+        return {}
+    out: dict[int, pathlib.Path] = {}
+    for child in base.iterdir():
+        if child.is_dir() and child.name.isdigit():
+            out[int(child.name)] = child
+    return out
+
+
+def is_native_checkpoint(path: pathlib.Path) -> bool:
+    return (path / "servable.json").exists()
+
+
+def is_saved_model(path: pathlib.Path) -> bool:
+    return (path / "saved_model.pb").exists()
+
+
+def _version_ready(path: pathlib.Path) -> bool:
+    """Only load fully-written versions. Native checkpoints commit by
+    writing servable.json AFTER params/ (train/checkpoint.py write order),
+    so manifest + params presence means complete; SavedModel exports are
+    considered ready once both saved_model.pb and variables/ exist."""
+    if is_native_checkpoint(path):
+        return (path / "params").exists()
+    if is_saved_model(path):
+        return (path / "variables").is_dir()
+    return False
+
+
+@dataclasses.dataclass
+class VersionWatcherConfig:
+    poll_interval_s: float = 5.0
+    keep_versions: int = 2  # retention window, newest-first
+    model_name: str = "DCN"
+    model_kind: str = "dcn_v2"  # for SavedModel version dirs
+    # Transient failures (e.g. a slow writer racing the readiness probe)
+    # get this many polls before the version is blacklisted for good.
+    max_load_attempts: int = 3
+
+
+class VersionWatcher:
+    """Poll a base path; keep the registry serving its newest versions.
+
+    `loader(version, path) -> Servable` is injected so serving policy
+    (mesh placement, import config, warmup) stays with the caller; the
+    default loader handles both directory flavors.
+    """
+
+    def __init__(
+        self,
+        base_path,
+        registry: ServableRegistry,
+        config: VersionWatcherConfig | None = None,
+        loader: Callable[[int, pathlib.Path], Servable] | None = None,
+        warmup: Callable[[Servable], None] | None = None,
+        model_config=None,  # ModelConfig for SavedModel version dirs
+        mesh=None,  # restore-time placement for native checkpoints
+        tensor_parallel: bool = False,
+    ):
+        self.base_path = pathlib.Path(base_path)
+        self.registry = registry
+        self.config = config or VersionWatcherConfig()
+        self.loader = loader or self._default_loader
+        self.warmup = warmup
+        self.model_config = model_config
+        self.mesh = mesh
+        self.tensor_parallel = tensor_parallel
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="version-watcher", daemon=True
+        )
+        self._attempts: dict[int, int] = {}  # version -> failed load count
+
+    # ----------------------------------------------------------------- API
+
+    def start(self) -> "VersionWatcher":
+        self.poll_once()  # synchronous first scan: serve something at start
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def poll_once(self) -> None:
+        """One reconcile pass: load new ready versions, retire old ones."""
+        name = self.config.model_name
+        on_disk = scan_versions(self.base_path)
+        loaded = set(self.registry.models().get(name, ()))
+
+        for version in sorted(v for v in on_disk if v not in loaded):
+            path = on_disk[version]
+            if not _version_ready(path):
+                continue  # partial write; next poll
+            if self._attempts.get(version, 0) >= self.config.max_load_attempts:
+                continue  # blacklisted after repeated failures
+            try:
+                servable = self.loader(version, path)
+                if self.warmup is not None:
+                    self.warmup(servable)  # cold-cache work BEFORE the flip
+                self.registry.load(servable)
+                self._attempts.pop(version, None)
+                log.info("loaded %s v%d from %s", name, version, path)
+            except Exception:
+                self._attempts[version] = self._attempts.get(version, 0) + 1
+                log.exception(
+                    "failed to load %s v%d from %s (attempt %d/%d)",
+                    name, version, path,
+                    self._attempts[version], self.config.max_load_attempts,
+                )
+
+        # Retention: keep the newest K of the union; unload the rest (only
+        # versions that are actually loaded).
+        loaded = set(self.registry.models().get(name, ()))
+        keep = set(sorted(loaded, reverse=True)[: self.config.keep_versions])
+        for version in sorted(loaded - keep):
+            self.registry.unload(name, version)
+            log.info("retired %s v%d (retention window %d)",
+                     name, version, self.config.keep_versions)
+
+    # ------------------------------------------------------------ internals
+
+    def _default_loader(self, version: int, path: pathlib.Path) -> Servable:
+        import dataclasses as dc
+
+        if is_native_checkpoint(path):
+            from ..train.checkpoint import load_servable
+
+            servable = load_servable(
+                path, mesh=self.mesh, tensor_parallel=self.tensor_parallel
+            )
+        else:
+            from ..interop import import_savedmodel
+            from ..models.base import ModelConfig
+
+            servable = import_savedmodel(
+                path,
+                self.config.model_kind,
+                self.model_config or ModelConfig(name=self.config.model_name),
+                name=self.config.model_name,
+                version=version,
+            )
+        # The directory number is authoritative (TF-Serving semantics),
+        # whatever version the artifact itself recorded.
+        if servable.version != version or servable.name != self.config.model_name:
+            servable = dc.replace(
+                servable, version=version, name=self.config.model_name
+            )
+        return servable
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                log.exception("version poll failed; retrying next interval")

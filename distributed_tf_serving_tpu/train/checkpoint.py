@@ -26,7 +26,12 @@ PARAMS_DIR = "params"
 
 
 def save_servable(path, servable: Servable, kind: str) -> None:
-    """Write params + manifest. `kind` is the model-zoo family name."""
+    """Write params + manifest. `kind` is the model-zoo family name.
+
+    Write order is a commit protocol: params first, manifest LAST — the
+    manifest's existence marks the checkpoint complete, so a concurrent
+    reader (serving/version_watcher.py polling a base path) never loads a
+    half-written params tree."""
     path = pathlib.Path(path)
     path.mkdir(parents=True, exist_ok=True)
     manifest = {
@@ -35,9 +40,9 @@ def save_servable(path, servable: Servable, kind: str) -> None:
         "kind": kind,
         "config": dataclasses.asdict(servable.model.config),
     }
-    (path / MANIFEST).write_text(json.dumps(manifest, indent=2))
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save((path / PARAMS_DIR).absolute(), servable.params, force=True)
+    (path / MANIFEST).write_text(json.dumps(manifest, indent=2))
 
 
 def load_servable(path, mesh=None, tensor_parallel: bool = False) -> Servable:

@@ -220,3 +220,31 @@ def test_input_cache_adaptive_bypass(servable):
         np.testing.assert_allclose(got, reference_scores(servable, p), rtol=1e-5)
     finally:
         batcher.stop()
+
+
+def test_warmup_arrays_signature_driven():
+    """Warmup batches come from the servable's signature, so optional
+    inputs (DLRM dense_features) are included — a DLRM warmup must not
+    KeyError, and queue-path warmup must compile through the batcher
+    thread."""
+    dlrm_cfg = ModelConfig(
+        num_fields=8, vocab_size=1009, embed_dim=4, mlp_dims=(16,),
+        bottom_mlp_dims=(8, 4), num_dense_features=5, compute_dtype="float32",
+    )
+    model = build_model("dlrm", dlrm_cfg)
+    sv = Servable(
+        name="DLRM", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(dlrm_cfg.num_fields, with_dense=5),
+    )
+    arrays = DynamicBatcher.warmup_arrays(sv, 16)
+    assert set(arrays) == {"feat_ids", "feat_wts", "dense_features"}
+    assert arrays["feat_ids"].dtype == np.int64  # wire dtype, folded on submit
+    assert arrays["dense_features"].shape == (16, 5)
+
+    batcher = DynamicBatcher(buckets=(16, 32), max_wait_us=0).start()
+    try:
+        batcher.warmup(sv)  # direct path (pre-start)
+        batcher.warmup_via_queue(sv)  # live path (hot-load)
+    finally:
+        batcher.stop()

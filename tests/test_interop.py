@@ -218,7 +218,7 @@ def test_build_stack_serves_savedmodel(tmp_path):
     cfg = ServerConfig(
         model_kind="dcn_v2", model_name="DCN", num_fields=CFG.num_fields, warmup=False
     )
-    registry, batcher, impl, servable, mesh = build_stack(
+    registry, batcher, impl, servable, mesh, _watcher = build_stack(
         cfg, savedmodel=str(export), model_config=CFG
     )
     try:

@@ -1,0 +1,167 @@
+"""Model-version lifecycle (TF-Serving base-path convention): numeric
+version dirs, hot-load of new versions, latest-version flip visible over a
+live gRPC socket, retention-window unload, partial-write and poison-version
+handling."""
+
+import numpy as np
+import pytest
+
+import grpc
+import jax
+
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.serving import (
+    DynamicBatcher,
+    PredictionServiceImpl,
+    VersionWatcher,
+    VersionWatcherConfig,
+    create_server,
+    scan_versions,
+)
+from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+CFG = ModelConfig(
+    num_fields=6, vocab_size=512, embed_dim=4, mlp_dims=(8,),
+    num_cross_layers=1, compute_dtype="float32",
+)
+
+
+def _servable(version, seed):
+    model = build_model("dcn", CFG)
+    return Servable(
+        name="DCN", version=version, model=model,
+        params=model.init(jax.random.PRNGKey(seed)),
+        signatures=ctr_signatures(CFG.num_fields),
+    )
+
+
+def _write_version(base, version, seed):
+    sv = _servable(version, seed)
+    save_servable(base / str(version), sv, kind="dcn")
+    return sv
+
+
+def _watcher(base, registry, keep=2):
+    return VersionWatcher(
+        base, registry,
+        VersionWatcherConfig(poll_interval_s=3600, keep_versions=keep, model_name="DCN"),
+    )
+
+
+def test_scan_ignores_non_numeric(tmp_path):
+    (tmp_path / "1").mkdir()
+    (tmp_path / "notaversion").mkdir()
+    (tmp_path / "2").mkdir()
+    (tmp_path / "file.txt").write_text("x")
+    assert sorted(scan_versions(tmp_path)) == [1, 2]
+    assert scan_versions(tmp_path / "missing") == {}
+
+
+def test_load_retire_and_latest_flip(tmp_path):
+    registry = ServableRegistry()
+    _write_version(tmp_path, 1, seed=1)
+    w = _watcher(tmp_path, registry)
+    w.poll_once()
+    assert registry.models() == {"DCN": [1]}
+    assert registry.resolve("DCN").version == 1
+
+    _write_version(tmp_path, 2, seed=2)
+    _write_version(tmp_path, 3, seed=3)
+    w.poll_once()
+    # keep_versions=2: v1 retired, latest resolution flipped to 3
+    assert registry.models() == {"DCN": [2, 3]}
+    assert registry.resolve("DCN").version == 3
+    assert registry.resolve("DCN", version=2).version == 2
+
+
+def test_partial_version_dir_skipped_then_loaded(tmp_path):
+    registry = ServableRegistry()
+    (tmp_path / "7").mkdir()  # writer created the dir, content not yet there
+    w = _watcher(tmp_path, registry)
+    w.poll_once()
+    assert registry.models() == {}
+    _write_version(tmp_path, 7, seed=7)
+    w.poll_once()
+    assert registry.models() == {"DCN": [7]}
+
+
+def test_poison_version_bounded_retries(tmp_path):
+    """A corrupt version is retried a bounded number of times (covers slow
+    writers racing the readiness probe) then blacklisted — never a retry
+    storm, never an exception out of poll_once."""
+    registry = ServableRegistry()
+    bad = tmp_path / "9"
+    bad.mkdir()
+    (bad / "servable.json").write_text("{not json")
+    (bad / "params").mkdir()  # looks ready; load will fail
+    w = _watcher(tmp_path, registry)
+    for i in range(5):
+        w.poll_once()
+        assert registry.models() == {}
+    assert w._attempts[9] == w.config.max_load_attempts  # capped, not 5
+
+
+def test_transient_failure_recovers_within_attempts(tmp_path):
+    """A version that becomes loadable before the attempt cap is served."""
+    registry = ServableRegistry()
+    d = tmp_path / "4"
+    d.mkdir()
+    (d / "servable.json").write_text("{not json}")
+    (d / "params").mkdir()
+    w = _watcher(tmp_path, registry)
+    w.poll_once()  # fails once
+    assert w._attempts[4] == 1
+    import shutil
+
+    shutil.rmtree(d)
+    _write_version(tmp_path, 4, seed=4)  # writer finishes properly
+    w.poll_once()
+    assert registry.models() == {"DCN": [4]}
+    assert 4 not in w._attempts
+
+
+def test_hot_swap_over_live_socket(tmp_path):
+    """A new version dir appearing mid-serve changes what unpinned requests
+    score with — without restarting the server or dropping the socket."""
+    from distributed_tf_serving_tpu.client import predict_sync
+    from distributed_tf_serving_tpu.serving.batcher import fold_ids_host
+
+    registry = ServableRegistry()
+    sv1 = _write_version(tmp_path, 1, seed=1)
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    server, port = create_server(impl, "127.0.0.1:0")
+    server.start()
+    w = _watcher(tmp_path, registry).start()
+    try:
+        rng = np.random.RandomState(0)
+        arrays = {
+            "feat_ids": rng.randint(0, 512, size=(5, CFG.num_fields)).astype(np.int64),
+            "feat_wts": rng.rand(5, CFG.num_fields).astype(np.float32),
+        }
+        folded = {
+            "feat_ids": fold_ids_host(arrays["feat_ids"], CFG.vocab_size),
+            "feat_wts": arrays["feat_wts"],
+        }
+        got1 = predict_sync(f"127.0.0.1:{port}", arrays)["prediction_node"]
+        np.testing.assert_allclose(
+            got1, np.asarray(sv1(folded)["prediction_node"]), rtol=1e-5
+        )
+
+        sv2 = _write_version(tmp_path, 2, seed=2)
+        w.poll_once()
+        got2 = predict_sync(f"127.0.0.1:{port}", arrays)["prediction_node"]
+        np.testing.assert_allclose(
+            got2, np.asarray(sv2(folded)["prediction_node"]), rtol=1e-5
+        )
+        assert not np.allclose(got1, got2)  # genuinely different params
+    finally:
+        w.stop()
+        server.stop(0)
+        batcher.stop()
