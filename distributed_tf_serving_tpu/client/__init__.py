@@ -5,6 +5,7 @@ from .client import (
     PredictClientError,
     ShardedPredictClient,
     build_predict_request,
+    client_from_config,
     predict_sync,
 )
 from .partition import (
@@ -19,6 +20,7 @@ __all__ = [
     "ShardedPredictClient",
     "PredictClientError",
     "build_predict_request",
+    "client_from_config",
     "predict_sync",
     "partition_bounds",
     "partition_list",

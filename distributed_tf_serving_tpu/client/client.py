@@ -71,6 +71,7 @@ class ShardedPredictClient:
         timeout_s: float = 10.0,
         use_tensor_content: bool = True,
         channels_per_host: int = 1,
+        full_async: bool = True,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -80,6 +81,12 @@ class ShardedPredictClient:
         self.output_key = output_key
         self.timeout_s = timeout_s
         self.use_tensor_content = use_tensor_content
+        # full_async=True fans the per-shard RPCs out concurrently (the
+        # reference's default CompletableFuture mode, DCNClient.java:27,
+        # 146-159); False issues them sequentially in host order — the
+        # legacy mode's *scheduling* without replicating its out-of-order
+        # merge laxity (merge order stays pinned either way).
+        self.full_async = full_async
         # Long-lived plaintext channels per host, created once and shared
         # (DCNClient.java:118-125). channels_per_host > 1 stripes requests
         # over several HTTP/2 connections — one connection's flow-control
@@ -131,13 +138,33 @@ class ShardedPredictClient:
         shards = shard_candidates(arrays, len(self.hosts))
         self._rr += 1
         rr = self._rr
-        results = await asyncio.gather(
-            *(self._predict_shard(i, s, rr) for i, s in enumerate(shards))
-        )
+        if self.full_async:
+            results = await asyncio.gather(
+                *(self._predict_shard(i, s, rr) for i, s in enumerate(shards))
+            )
+        else:
+            results = [
+                await self._predict_shard(i, s, rr) for i, s in enumerate(shards)
+            ]
         merged = merge_host_order(list(results))
         if sort_scores:
             merged = np.sort(merged)  # ascending, Collections.sort parity
         return merged
+
+
+def client_from_config(cfg) -> ShardedPredictClient:
+    """ShardedPredictClient from a utils.config.ClientConfig — every
+    reference knob (DCNClient.java:25-40) lands on the matching client
+    parameter, including the sync/async mode flag."""
+    return ShardedPredictClient(
+        list(cfg.hosts),
+        model_name=cfg.model_name,
+        signature_name=cfg.signature_name,
+        output_key=cfg.output_key,
+        timeout_s=cfg.timeout_s,
+        use_tensor_content=cfg.use_tensor_content,
+        full_async=cfg.full_async_mode,
+    )
 
 
 def predict_sync(

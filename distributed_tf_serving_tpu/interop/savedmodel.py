@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import pathlib
 import re
 import subprocess
@@ -311,6 +312,47 @@ def _check_signature_aliases(signatures, kind: str, config: ModelConfig) -> None
         )
 
 
+def _default_npz_cache_path(saved_model_dir) -> pathlib.Path:
+    """Extraction-cache location OUTSIDE the SavedModel directory.
+
+    Serving artifacts are commonly mounted read-only, and writing into the
+    artifact both fails there and mutates the export's content/mtimes for
+    every other consumer (round-1 advisor finding). The cache lives in a
+    per-user temp dir keyed by the absolute SavedModel path; staleness is
+    still governed by _npz_cache_fresh's mtime comparison against the
+    export's own files."""
+    import hashlib
+    import tempfile
+
+    root = pathlib.Path(tempfile.gettempdir()) / f"dts_tpu_sm_cache_{os.getuid()}"
+    root.mkdir(mode=0o700, parents=True, exist_ok=True)
+    # Fail closed against a pre-created dir in the shared /tmp namespace:
+    # mkdir's mode is NOT applied when the dir already exists, and a foreign
+    # owner could plant a fresh-mtime npz the importer would np.load as
+    # model weights.
+    st = root.stat()
+    if st.st_uid != os.getuid() or (st.st_mode & 0o077):
+        raise SavedModelImportError(
+            f"extraction cache dir {root} is not exclusively owned by uid "
+            f"{os.getuid()} (uid={st.st_uid}, mode={oct(st.st_mode & 0o777)}); "
+            "refusing to trust cached weights from it"
+        )
+    # Key on path AND a content fingerprint (name/size/mtime of the pb and
+    # every variables file): a version dir replaced wholesale (rsync/tar/mv
+    # preserving build-time mtimes) must miss the old cache — the mtime-only
+    # freshness test cannot see that replacement, a path-only key would
+    # silently serve the previous model's weights.
+    sm = pathlib.Path(saved_model_dir)
+    h = hashlib.sha1(str(sm.resolve()).encode())
+    for p in [sm / "saved_model.pb", *sorted((sm / "variables").glob("*"))]:
+        try:
+            st = p.stat()
+            h.update(f"{p.name}:{st.st_size}:{st.st_mtime_ns};".encode())
+        except OSError:
+            continue
+    return root / f"{h.hexdigest()[:24]}.npz"
+
+
 def _npz_cache_fresh(saved_model_dir, npz_path) -> bool:
     """The cached extraction is valid only if it postdates every SavedModel
     artifact — an in-place re-export must trigger re-extraction, never serve
@@ -353,11 +395,20 @@ def import_savedmodel(
     _check_signature_aliases(signatures, kind, config)
 
     if variables_npz is None:
-        variables_npz = pathlib.Path(saved_model_dir) / "variables_extracted.npz"
-        if _npz_cache_fresh(saved_model_dir, variables_npz):
+        # Honor a FRESH cache shipped inside the artifact (a deliberate
+        # pre-extraction); anything needing (re-)extraction goes to the
+        # out-of-artifact default — the artifact dir may be a read-only
+        # mount and must never be mutated by the importer.
+        in_dir = pathlib.Path(saved_model_dir) / "variables_extracted.npz"
+        if in_dir.exists() and _npz_cache_fresh(saved_model_dir, in_dir):
+            variables_npz = in_dir
             log.info("reusing extracted variables cache %s", variables_npz)
         else:
-            extract_variables(saved_model_dir, variables_npz, python=python)
+            variables_npz = _default_npz_cache_path(saved_model_dir)
+            if _npz_cache_fresh(saved_model_dir, variables_npz):
+                log.info("reusing extracted variables cache %s", variables_npz)
+            else:
+                extract_variables(saved_model_dir, variables_npz, python=python)
     with np.load(variables_npz) as npz:
         variables = {k: npz[k] for k in npz.files}
 

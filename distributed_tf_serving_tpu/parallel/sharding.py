@@ -30,13 +30,39 @@ VOCAB_MAJOR_KEYS = ("embedding", "wide", "linear")
 def param_shardings(params: Any, mesh: Mesh, tensor_parallel: bool = False) -> Any:
     """NamedSharding tree matching `params`: vocab tables split over the
     model axis; dense weights replicated, or model-axis split when
-    tensor_parallel (divisible dims only)."""
+    tensor_parallel (divisible dims only).
+
+    A 1-D param (bias) is split over the model axis only when a sibling 2-D
+    weight in the same subtree is column-split with a matching output dim —
+    a column-split weight's output y = x @ W is already MODEL_AXIS-sharded
+    on features, so the bias layout matches the activation it adds into. A
+    row-split weight's output is replicated (post-psum), so its bias must be
+    replicated too; sharding it anyway forces the partitioner to insert an
+    extra all-gather per layer (round-1 advisor finding)."""
     tp = mesh.shape[MODEL_AXIS]
+    vocab_keys = set(VOCAB_MAJOR_KEYS)
+
+    def is_vocab(path) -> bool:
+        return bool({getattr(p, "key", None) for p in path} & vocab_keys)
+
+    # Output dims of column-split 2-D weights, per parent subtree: a 1-D
+    # sibling of that length rides the same feature sharding.
+    col_split_dims: dict[tuple, set[int]] = {}
+    if tensor_parallel and tp > 1:
+        def scan(path, leaf):
+            if (
+                getattr(leaf, "ndim", 0) == 2
+                and not is_vocab(path)
+                and leaf.shape[1] % tp == 0
+            ):
+                col_split_dims.setdefault(path[:-1], set()).add(leaf.shape[1])
+            return leaf
+
+        jax.tree_util.tree_map_with_path(scan, params)
 
     def rule(path, leaf):
-        keys = {getattr(p, "key", None) for p in path}
         ndim = getattr(leaf, "ndim", 0)
-        if keys & set(VOCAB_MAJOR_KEYS) and ndim >= 1:
+        if is_vocab(path) and ndim >= 1:
             return NamedSharding(mesh, P(MODEL_AXIS, *(None,) * (ndim - 1)))
         if tensor_parallel and tp > 1:
             shape = getattr(leaf, "shape", ())
@@ -45,7 +71,7 @@ def param_shardings(params: Any, mesh: Mesh, tensor_parallel: bool = False) -> A
                     return NamedSharding(mesh, P(None, MODEL_AXIS))
                 if shape[0] % tp == 0:  # row split (input features)
                     return NamedSharding(mesh, P(MODEL_AXIS, None))
-            elif ndim == 1 and shape[0] % tp == 0:
+            elif ndim == 1 and shape[0] in col_split_dims.get(path[:-1], ()):
                 return NamedSharding(mesh, P(MODEL_AXIS))
         return NamedSharding(mesh, P())
 

@@ -1,6 +1,13 @@
 """Serving stack: dynamic batcher + PredictionService semantics + gRPC frontend."""
 
-from .batcher import BatcherStats, BatchTooLargeError, DynamicBatcher, bucket_for
+from .batcher import (
+    BatcherStats,
+    BatchTooLargeError,
+    DeviceWedgedError,
+    DynamicBatcher,
+    QueueOverloadError,
+    bucket_for,
+)
 from .example_codec import ExampleDecodeError, decode_input, make_example
 from .server import GrpcPredictionService, create_server, load_demo_servable, serve
 from .service import PredictionServiceImpl, ServiceError
@@ -13,6 +20,8 @@ __all__ = [
     "DynamicBatcher",
     "BatcherStats",
     "BatchTooLargeError",
+    "QueueOverloadError",
+    "DeviceWedgedError",
     "bucket_for",
     "decode_input",
     "make_example",

@@ -29,13 +29,12 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import queue
 import threading
 import time
 import weakref
 from collections.abc import Callable
-from collections import OrderedDict
-from concurrent.futures import Future, ThreadPoolExecutor
+from collections import OrderedDict, deque
+from concurrent.futures import Future, InvalidStateError, ThreadPoolExecutor
 
 import jax
 import numpy as np
@@ -43,12 +42,27 @@ import numpy as np
 from ..models.base import Model
 from ..models.registry import Servable
 from ..ops.transfer import pack_host, transfer_spec, unpack_device
+from ..utils.tracing import request_trace
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
 class BatchTooLargeError(ValueError):
     pass
+
+
+class QueueOverloadError(RuntimeError):
+    """Queue admission refused: accepting more work would only build a
+    backlog no deadline survives. Maps to RESOURCE_EXHAUSTED at the RPC
+    layer — shedding beats queueing past the client's deadline."""
+
+
+class DeviceWedgedError(RuntimeError):
+    """Circuit breaker open: a dispatched batch has been stuck past the
+    wedge threshold, so the device (or its compile path) is presumed hung.
+    New work fails fast (UNAVAILABLE) instead of burning a handler thread
+    per request for the full RPC deadline; the breaker closes by itself the
+    moment the stuck batch completes."""
 
 
 def bucket_for(n: int, buckets: tuple[int, ...]) -> int:
@@ -70,7 +84,15 @@ def fold_ids_host(ids: np.ndarray, vocab_size: int) -> np.ndarray:
 
 
 def prepare_inputs(model: Model, arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
-    """Host-side normalization before padding/transfer."""
+    """Host-side normalization before padding/transfer.
+
+    Every output array is OWNED (never aliases the caller's buffer): submit()
+    returns before the batch is padded/uploaded, so an aliased input would
+    let a caller mutating its array after submit() race the async device
+    transfer — and poison the content-addressed DeviceInputCache digest
+    (round-1 advisor finding). fold/astype copy as a side effect; the
+    passthrough branch copies explicitly (~20 us per 1k x 43 float32 batch,
+    noise next to decode)."""
     out = {}
     for key, arr in arrays.items():
         if key == "feat_ids":
@@ -78,7 +100,7 @@ def prepare_inputs(model: Model, arrays: dict[str, np.ndarray]) -> dict[str, np.
         elif arr.dtype == np.float64:
             out[key] = arr.astype(np.float32)
         else:
-            out[key] = arr
+            out[key] = arr.copy()
     return out
 
 
@@ -210,6 +232,8 @@ class DynamicBatcher:
         completion_workers: int = 4,
         compress_transfer: bool = True,
         input_cache_entries: int = 64,
+        queue_capacity_candidates: int | None = None,
+        breaker_timeout_s: float | None = 90.0,
     ):
         self.compress_transfer = compress_transfer
         # Content-addressed device-resident inputs (only meaningful for the
@@ -226,7 +250,31 @@ class DynamicBatcher:
         self.max_batch_candidates = min(
             max_batch_candidates or self.buckets[-1], self.buckets[-1]
         )
-        self._queue: queue.SimpleQueue[_WorkItem | None] = queue.SimpleQueue()
+        # Admission bound: at most this many candidates queued (not yet
+        # dispatched). 16 full max-size batches of backlog is already several
+        # deadlines' worth of work; past that, shedding with
+        # RESOURCE_EXHAUSTED is strictly kinder than queueing.
+        # Clamped to at least one full max-size batch: a capacity below
+        # buckets[-1] would permanently reject every request larger than it
+        # even on an idle queue.
+        self.queue_capacity_candidates = max(
+            queue_capacity_candidates
+            if queue_capacity_candidates is not None
+            else 16 * self.buckets[-1],
+            self.buckets[-1],
+        )
+        # Wedge threshold for the circuit breaker. Default is above any sane
+        # steady-state batch but below the 120s RPC deadline; first compiles
+        # belong in warmup(), not live traffic.
+        self.breaker_timeout_s = breaker_timeout_s
+        self._items: "deque[_WorkItem]" = deque()
+        self._cv = threading.Condition()
+        self._queued_candidates = 0
+        # Wedge bookkeeping: wall-clock starts of (a) the dispatch currently
+        # on the batcher thread and (b) every readback in flight.
+        self._dispatching_since: float | None = None
+        self._inflight: dict[int, float] = {}
+        self._inflight_seq = 0
         # Weak keys: unloaded servables must not pin their compiled
         # executables, and a recycled object address must not serve a stale
         # one (Servable uses eq=False, so it is hashable and weakref-able).
@@ -235,8 +283,6 @@ class DynamicBatcher:
         )
         self._run_fn = run_fn
         self.stats = BatcherStats()
-        self._depth = 0
-        self._depth_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, name="batcher", daemon=True)
         self._started = False
         self._stopping = False
@@ -264,11 +310,33 @@ class DynamicBatcher:
 
     def stop(self) -> None:
         if self._started:
-            self._stopping = True
-            self._queue.put(None)
+            with self._cv:
+                self._stopping = True
+                self._cv.notify_all()
             self._thread.join(timeout=5)
             self._completers.shutdown(wait=True)
             self._started = False
+
+    def _wedged_for(self, now: float) -> float:
+        """Seconds the oldest stuck batch has been in flight past the
+        breaker threshold; 0.0 when healthy. Caller holds _cv."""
+        t = self.breaker_timeout_s
+        if t is None:
+            return 0.0
+        worst = 0.0
+        if self._dispatching_since is not None:
+            worst = now - self._dispatching_since
+        for t0 in self._inflight.values():
+            worst = max(worst, now - t0)
+        return worst if worst > t else 0.0
+
+    def _shed_queued(self, exc: Exception) -> None:
+        """Fail every queued (not yet dispatched) item. Caller holds _cv."""
+        while self._items:
+            it = self._items.popleft()
+            self._queued_candidates -= it.n
+            if not it.future.done():
+                it.future.set_exception(exc)
 
     def submit(
         self,
@@ -278,7 +346,13 @@ class DynamicBatcher:
     ) -> Future:
         """Enqueue one request's arrays; returns a Future of output arrays
         (sliced back to the request's own candidate count). output_keys limits
-        which model outputs are fetched back to the host."""
+        which model outputs are fetched back to the host.
+
+        Admission control (SURVEY.md §5 failure-detection obligations): a
+        wedged device fails the request immediately (DeviceWedgedError, and
+        the backlog is shed with it), and a backlog past
+        queue_capacity_candidates is refused (QueueOverloadError) instead of
+        queueing work no deadline survives."""
         if self._stopping:
             raise RuntimeError("batcher is stopped")
         ns = {k: v.shape[0] for k, v in arrays.items()}
@@ -286,19 +360,43 @@ class DynamicBatcher:
         if any(v != n for v in ns.values()):
             raise ValueError(f"inconsistent candidate counts across inputs: {ns}")
         bucket_for(n, self.buckets)  # validate size up front, raises if too big
+        # Admission BEFORE the defensive copy: a shed request must not pay
+        # the copy/fold cost — overload is exactly when the host can least
+        # afford it. Capacity is reserved under the lock so concurrent
+        # submits cannot overshoot while this one prepares its arrays.
+        with self._cv:
+            stuck_s = self._wedged_for(time.perf_counter())
+            if stuck_s:
+                exc = DeviceWedgedError(
+                    f"a dispatched batch has been stuck {stuck_s:.1f}s "
+                    f"(> breaker {self.breaker_timeout_s:.0f}s); failing fast"
+                )
+                self._shed_queued(exc)
+                raise exc
+            if self._queued_candidates + n > self.queue_capacity_candidates:
+                raise QueueOverloadError(
+                    f"queue holds {self._queued_candidates} candidates; admitting "
+                    f"{n} more would exceed capacity {self.queue_capacity_candidates}"
+                )
+            self._queued_candidates += n
         fut: Future = Future()
-        item = _WorkItem(
-            servable=servable,
-            arrays=prepare_inputs(servable.model, arrays),
-            n=n,
-            future=fut,
-            enqueue_t=time.perf_counter(),
-            output_keys=output_keys,
-        )
-        with self._depth_lock:
-            self._depth += 1
-            self.stats.max_queue_depth = max(self.stats.max_queue_depth, self._depth)
-        self._queue.put(item)
+        try:
+            item = _WorkItem(
+                servable=servable,
+                arrays=prepare_inputs(servable.model, arrays),
+                n=n,
+                future=fut,
+                enqueue_t=time.perf_counter(),
+                output_keys=output_keys,
+            )
+        except BaseException:
+            with self._cv:
+                self._queued_candidates -= n
+            raise
+        with self._cv:
+            self._items.append(item)
+            self.stats.max_queue_depth = max(self.stats.max_queue_depth, len(self._items))
+            self._cv.notify()
         return fut
 
     @staticmethod
@@ -363,9 +461,50 @@ class DynamicBatcher:
             packed = {k: self.input_cache.get_or_put(k, v) for k, v in packed.items()}
         return fn(servable.params, packed)
 
+    def _take(self) -> _WorkItem | None:
+        """Pop the next live queued item, blocking; None on shutdown after
+        the queue drains (every accepted item is still served)."""
+        with self._cv:
+            while True:
+                while self._items:
+                    it = self._items.popleft()
+                    self._queued_candidates -= it.n
+                    if it.future.cancelled():
+                        continue  # waiter gave up (RPC deadline); skip the work
+                    return it
+                if self._stopping:
+                    return None
+                self._cv.wait()
+
+    def _coalesce_next(self, item: _WorkItem, total: int, deadline: float) -> _WorkItem | None:
+        """Next same-target item within the deadline, or None. The head item
+        stays put when it doesn't match — deque order is preserved (the old
+        SimpleQueue requeue pushed it to the BACK, reordering traffic)."""
+        with self._cv:
+            while True:
+                while not self._items:
+                    timeout = deadline - time.perf_counter()
+                    if timeout <= 0 or self._stopping:
+                        return None
+                    self._cv.wait(timeout)
+                nxt = self._items[0]
+                if nxt.future.cancelled():
+                    self._items.popleft()
+                    self._queued_candidates -= nxt.n
+                    continue
+                if (
+                    nxt.servable is item.servable
+                    and nxt.arrays.keys() == item.arrays.keys()
+                    and total + nxt.n <= self.max_batch_candidates
+                ):
+                    self._items.popleft()
+                    self._queued_candidates -= nxt.n
+                    return nxt
+                return None
+
     def _loop(self) -> None:
         while True:
-            item = self._queue.get()
+            item = self._take()
             if item is None:
                 return
             group = [item]
@@ -373,53 +512,39 @@ class DynamicBatcher:
             deadline = item.enqueue_t + self.max_wait_s
             # Coalesce same-servable work until the deadline or size cap.
             while total < self.max_batch_candidates:
-                timeout = deadline - time.perf_counter()
-                try:
-                    nxt = self._queue.get(timeout=max(timeout, 0.0)) if timeout > 0 else self._queue.get_nowait()
-                except queue.Empty:
-                    break
+                nxt = self._coalesce_next(item, total, deadline)
                 if nxt is None:
-                    # Mid-coalesce shutdown: re-enqueue the sentinel BEHIND
-                    # any requeued items so they still get dispatched before
-                    # the loop exits (a requeued item stuck behind the
-                    # sentinel would otherwise hang its waiter forever).
-                    self._queue.put(None)
                     break
-                if (
-                    nxt.servable is item.servable
-                    and nxt.arrays.keys() == item.arrays.keys()
-                    and total + nxt.n <= self.max_batch_candidates
-                ):
-                    group.append(nxt)
-                    total += nxt.n
-                else:
-                    # Different target or overflow: run what we have, requeue.
-                    self._queue.put(nxt)
-                    break
+                group.append(nxt)
+                total += nxt.n
             self._dispatch(group, total)
 
     def _dispatch(self, group: list[_WorkItem], total: int) -> None:
-        with self._depth_lock:
-            self._depth -= len(group)
+        with self._cv:
+            self._dispatching_since = time.perf_counter()
         try:
             bucket = bucket_for(total, self.buckets)
             first = group[0]
             keys = list(first.arrays.keys())
             batched = {}
-            for k in keys:
-                parts = [it.arrays[k] for it in group]
-                if len(parts) == 1 and parts[0].shape[0] == bucket:
-                    batched[k] = parts[0]
-                    continue
-                # Single allocation + one copy per part (no concat temporaries).
-                out = np.empty((bucket,) + parts[0].shape[1:], parts[0].dtype)
-                off = 0
-                for p in parts:
-                    out[off : off + p.shape[0]] = p
-                    off += p.shape[0]
-                out[off:] = 0  # padding rows
-                batched[k] = out
-            outputs = self._execute(first.servable, batched)  # async dispatch
+            with request_trace.span("batch.pad"):
+                for k in keys:
+                    parts = [it.arrays[k] for it in group]
+                    if len(parts) == 1 and parts[0].shape[0] == bucket:
+                        # Safe to pass through uncopied: prepare_inputs
+                        # guarantees item arrays never alias caller buffers.
+                        batched[k] = parts[0]
+                        continue
+                    # Single allocation + one copy per part (no concat temporaries).
+                    out = np.empty((bucket,) + parts[0].shape[1:], parts[0].dtype)
+                    off = 0
+                    for p in parts:
+                        out[off : off + p.shape[0]] = p
+                        off += p.shape[0]
+                    out[off:] = 0  # padding rows
+                    batched[k] = out
+            with request_trace.span("batch.dispatch"):
+                outputs = self._execute(first.servable, batched)  # async dispatch
 
             # Union of the group's wanted outputs; None on any item = all.
             wanted: set[str] | None = set()
@@ -443,23 +568,44 @@ class DynamicBatcher:
             self.stats.padded_candidates += bucket
 
             # Readback + distribution off-thread: the batching thread moves on
-            # to the next batch immediately, pipelining device work.
-            self._completers.submit(self._complete, group, fetch)
+            # to the next batch immediately, pipelining device work. The batch
+            # is registered in-flight first so a readback that never returns
+            # is visible to the circuit breaker.
+            with self._cv:
+                self._inflight_seq += 1
+                batch_id = self._inflight_seq
+                self._inflight[batch_id] = time.perf_counter()
+            self._completers.submit(self._complete, batch_id, group, fetch)
         except Exception as exc:  # propagate to every waiter, keep serving
             for it in group:
                 if not it.future.done():
                     it.future.set_exception(exc)
+        finally:
+            with self._cv:
+                self._dispatching_since = None
 
-    @staticmethod
-    def _complete(group: list[_WorkItem], outputs) -> None:
+    def _complete(self, batch_id: int, group: list[_WorkItem], outputs) -> None:
         try:
-            host = {k: np.asarray(v) for k, v in outputs.items()}
+            with request_trace.span("batch.readback"):
+                host = {k: np.asarray(v) for k, v in outputs.items()}
             off = 0
             for it in group:
                 sliced = {k: v[off : off + it.n] for k, v in host.items()}
                 off += it.n
-                it.future.set_result(sliced)
+                try:
+                    if not it.future.cancelled():
+                        it.future.set_result(sliced)
+                except InvalidStateError:
+                    # A service-deadline cancel can land between the check
+                    # and set_result; that waiter is gone, but its race must
+                    # not poison co-batched requests via the except below.
+                    pass
         except Exception as exc:
             for it in group:
                 if not it.future.done():
                     it.future.set_exception(exc)
+        finally:
+            # The breaker closes itself here: once the stuck (or healthy)
+            # readback finishes, the wedge condition clears with it.
+            with self._cv:
+                self._inflight.pop(batch_id, None)

@@ -30,7 +30,12 @@ from ..models.registry import (
 )
 from ..proto import serving_apis_pb2 as apis
 from ..proto import tf_framework_pb2 as fw
-from .batcher import BatchTooLargeError, DynamicBatcher
+from .batcher import (
+    BatchTooLargeError,
+    DeviceWedgedError,
+    DynamicBatcher,
+    QueueOverloadError,
+)
 from .example_codec import ExampleDecodeError, decode_input
 
 SIGNATURE_DEF_FIELD = "signature_def"
@@ -138,16 +143,25 @@ class PredictionServiceImpl:
         arrays: dict[str, np.ndarray],
         output_keys: tuple[str, ...] | None = None,
     ) -> dict[str, np.ndarray]:
+        fut = None
         try:
             # Bounded wait: a wedged batcher must not permanently consume an
             # RPC handler thread (first compile of a large bucket through a
             # remote-compile path can legitimately take tens of seconds).
-            return self.batcher.submit(servable, arrays, output_keys=output_keys).result(
-                timeout=120.0
-            )
+            fut = self.batcher.submit(servable, arrays, output_keys=output_keys)
+            return fut.result(timeout=120.0)
         except BatchTooLargeError as e:
             raise ServiceError("RESOURCE_EXHAUSTED", str(e)) from e
+        except QueueOverloadError as e:
+            raise ServiceError("RESOURCE_EXHAUSTED", str(e)) from e
+        except DeviceWedgedError as e:
+            raise ServiceError("UNAVAILABLE", str(e)) from e
         except TimeoutError as e:
+            # Withdraw the work: a cancelled item is skipped by the batcher,
+            # so an abandoned deadline never turns into a zombie dispatch
+            # that delays everyone behind it.
+            if fut is not None:
+                fut.cancel()
             raise ServiceError("DEADLINE_EXCEEDED", "batch execution timed out") from e
         except RuntimeError as e:
             raise ServiceError("UNAVAILABLE", str(e)) from e

@@ -56,13 +56,32 @@ def is_saved_model(path: pathlib.Path) -> bool:
 def _version_ready(path: pathlib.Path) -> bool:
     """Only load fully-written versions. Native checkpoints commit by
     writing servable.json AFTER params/ (train/checkpoint.py write order),
-    so manifest + params presence means complete; SavedModel exports are
-    considered ready once both saved_model.pb and variables/ exist."""
+    so manifest + params presence means complete. SavedModel exports are
+    ready once variables/variables.index exists — TF writes the index after
+    the data shards, so probing for the directory alone can fire while
+    shards are still streaming in (ADVICE.md round 1)."""
     if is_native_checkpoint(path):
         return (path / "params").exists()
     if is_saved_model(path):
-        return (path / "variables").is_dir()
+        # Strictly require the index: an empty variables/ dir is exactly
+        # what a writer that has created the dir but not yet streamed the
+        # shards looks like, so it must not probe ready.
+        return (path / "variables" / "variables.index").exists()
     return False
+
+
+def _version_mtime(path: pathlib.Path) -> int:
+    """Newest mtime under the version dir (1 level deep) — cheap change
+    signal used to un-blacklist a version once its writer finishes."""
+    try:
+        stamps = [path.stat().st_mtime_ns]
+        for child in path.iterdir():
+            stamps.append(child.stat().st_mtime_ns)
+            if child.is_dir():
+                stamps.extend(g.stat().st_mtime_ns for g in child.iterdir())
+        return max(stamps)
+    except OSError:
+        return 0
 
 
 @dataclasses.dataclass
@@ -108,6 +127,7 @@ class VersionWatcher:
             target=self._loop, name="version-watcher", daemon=True
         )
         self._attempts: dict[int, int] = {}  # version -> failed load count
+        self._attempt_mtime: dict[int, int] = {}  # version -> mtime at last failure
 
     # ----------------------------------------------------------------- API
 
@@ -121,26 +141,42 @@ class VersionWatcher:
         self._thread.join(timeout=10)
 
     def poll_once(self) -> None:
-        """One reconcile pass: load new ready versions, retire old ones."""
+        """One reconcile pass: load new ready versions, retire old ones.
+
+        Load candidates are the newest `keep_versions` READY versions on
+        disk (TF-Serving's latest-N version policy). Considering every
+        unloaded on-disk version would re-load each retired one on every
+        poll — a continuous load/compile/unload storm competing with live
+        traffic once history outgrows the retention window (the round-1
+        advisor's high-severity finding)."""
         name = self.config.model_name
         on_disk = scan_versions(self.base_path)
         loaded = set(self.registry.models().get(name, ()))
 
-        for version in sorted(v for v in on_disk if v not in loaded):
-            path = on_disk[version]
-            if not _version_ready(path):
-                continue  # partial write; next poll
+        ready = {v: p for v, p in on_disk.items() if _version_ready(p)}
+        candidates = sorted(ready, reverse=True)[: self.config.keep_versions]
+        for version in sorted(v for v in candidates if v not in loaded):
+            path = ready[version]
             if self._attempts.get(version, 0) >= self.config.max_load_attempts:
-                continue  # blacklisted after repeated failures
+                # Blacklisted — but a writer that finished late changes the
+                # directory; give the version a fresh set of attempts then,
+                # so recovery never requires a server restart.
+                mtime = _version_mtime(path)
+                if mtime == self._attempt_mtime.get(version):
+                    continue
+                self._attempts.pop(version, None)
+                self._attempt_mtime.pop(version, None)
             try:
                 servable = self.loader(version, path)
                 if self.warmup is not None:
                     self.warmup(servable)  # cold-cache work BEFORE the flip
                 self.registry.load(servable)
                 self._attempts.pop(version, None)
+                self._attempt_mtime.pop(version, None)
                 log.info("loaded %s v%d from %s", name, version, path)
             except Exception:
                 self._attempts[version] = self._attempts.get(version, 0) + 1
+                self._attempt_mtime[version] = _version_mtime(path)
                 log.exception(
                     "failed to load %s v%d from %s (attempt %d/%d)",
                     name, version, path,

@@ -49,7 +49,9 @@ class ClientConfig:
     candidate_num: int = 1500  # DCNClient.java:29
     request_num: int = 1000  # DCNClient.java:30
     concurrent_num: int = 6  # DCNClient.java:31
-    full_async_mode: bool = True  # DCNClient.java:27 (sync mode not replicated)
+    # DCNClient.java:27 — True: concurrent per-shard fan-out; False: shards
+    # issued sequentially in host order (ShardedPredictClient.full_async).
+    full_async_mode: bool = True
     sort_scores: bool = True  # the ranking sort, DCNClient.java:195
     timeout_s: float = 10.0
     use_tensor_content: bool = True

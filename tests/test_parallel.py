@@ -242,3 +242,61 @@ def test_tensor_parallel_training_step():
     assert np.isfinite(metrics["loss"])
     spec = tr.state.params["mlp"][0]["w"].sharding.spec
     assert spec == jax.sharding.PartitionSpec(None, MODEL_AXIS)
+
+
+def test_tp_bias_follows_sibling_weight_split():
+    """A 1-D param rides the model axis only when a sibling 2-D weight in
+    the same subtree is column-split with a matching output dim; 1-D params
+    with no such sibling stay replicated — sharding them anyway mismatches
+    the (replicated) activation they combine with and forces the partitioner
+    to insert per-layer all-gathers (round-1 advisor finding)."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(8, model_parallel=2)
+    params = {
+        # col-split weight (out dim 4 divides tp=2): bias rides along
+        "proj": {"w": np.zeros((8, 4)), "b": np.zeros((4,))},
+        # DCN-v1-style vector cross layer: no 2-D sibling -> replicated,
+        # even though both lengths divide the axis
+        "gate": {"w": np.zeros((4,)), "b": np.zeros((4,))},
+    }
+    sh = param_shardings(params, mesh, tensor_parallel=True)
+    assert sh["proj"]["w"].spec == P(None, MODEL_AXIS)
+    assert sh["proj"]["b"].spec == P(MODEL_AXIS)
+    assert sh["gate"]["w"].spec == P()
+    assert sh["gate"]["b"].spec == P()
+
+
+def test_client_full_async_mode_knob():
+    """ClientConfig.full_async_mode reaches the client: sequential host-order
+    shard issue (False) must produce the identical merged result as the
+    concurrent fan-out (True) — the knob changes scheduling, never merge
+    semantics (DCNClient.java:27)."""
+    import asyncio
+
+    from distributed_tf_serving_tpu.client import client_from_config
+    from distributed_tf_serving_tpu.utils import ClientConfig
+
+    cfg = ClientConfig(hosts=("h1", "h2"), full_async_mode=False)
+    client = client_from_config(cfg)
+    assert client.full_async is False
+    assert client.hosts == ["h1", "h2"]
+
+    # Scheduling-equivalence on a live socket is covered by the serving
+    # integration tests; here pin the wiring + the sequential code path via
+    # a stubbed shard call.
+    calls = []
+
+    async def fake_shard(i, shard, rr):
+        calls.append(i)
+        await asyncio.sleep(0.01 if i == 0 else 0)  # tempt reordering
+        return np.full((shard["feat_ids"].shape[0],), float(i), np.float32)
+
+    client._predict_shard = fake_shard
+    arrays = {
+        "feat_ids": np.zeros((6, 3), np.int64),
+        "feat_wts": np.zeros((6, 3), np.float32),
+    }
+    merged = asyncio.run(client.predict(arrays))
+    assert calls == [0, 1]  # strictly sequential in host order
+    np.testing.assert_array_equal(merged, [0, 0, 0, 1, 1, 1])

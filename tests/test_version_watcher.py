@@ -126,6 +126,70 @@ def test_transient_failure_recovers_within_attempts(tmp_path):
     assert 4 not in w._attempts
 
 
+def test_retired_versions_never_reloaded(tmp_path):
+    """Steady state: on-disk history exceeds keep_versions (the watcher never
+    deletes directories). Re-polling must NOT re-load retired versions — the
+    round-1 advisor's load/compile/unload-storm finding. Load candidates are
+    the newest keep_versions ready dirs only."""
+    registry = ServableRegistry()
+    for v in (1, 2, 3):
+        _write_version(tmp_path, v, seed=v)
+    w = _watcher(tmp_path, registry, keep=2)
+    loads = []
+    inner = w.loader
+    w.loader = lambda version, path: (loads.append(version), inner(version, path))[1]
+
+    w.poll_once()
+    assert registry.models() == {"DCN": [2, 3]}
+    assert loads == [2, 3]  # v1 never even loaded, not loaded-then-retired
+
+    for _ in range(3):  # steady-state polls: zero loader activity
+        w.poll_once()
+    assert loads == [2, 3]
+    assert registry.models() == {"DCN": [2, 3]}
+
+
+def test_blacklisted_version_recovers_when_writer_finishes(tmp_path):
+    """A version blacklisted after max_load_attempts gets fresh attempts
+    once its directory content changes (a slow writer completing) — recovery
+    must not require a server restart (round-1 advisor finding)."""
+    import os
+    import shutil
+
+    registry = ServableRegistry()
+    d = tmp_path / "5"
+    d.mkdir()
+    (d / "servable.json").write_text("{not json")
+    (d / "params").mkdir()
+    w = _watcher(tmp_path, registry)
+    for _ in range(4):
+        w.poll_once()
+    assert w._attempts[5] == w.config.max_load_attempts  # blacklisted
+    assert registry.models() == {}
+
+    shutil.rmtree(d)
+    _write_version(tmp_path, 5, seed=5)
+    # Force a visible mtime change even on coarse-granularity filesystems.
+    os.utime(tmp_path / "5" / "servable.json")
+    w.poll_once()
+    assert registry.models() == {"DCN": [5]}
+    assert 5 not in w._attempts
+
+
+def test_saved_model_readiness_requires_variables_index(tmp_path):
+    """saved_model.pb + a variables/ dir mid-write must not probe ready;
+    the index file (written after the data shards) is the commit marker."""
+    from distributed_tf_serving_tpu.serving.version_watcher import _version_ready
+
+    d = tmp_path / "1"
+    (d / "variables").mkdir(parents=True)
+    (d / "saved_model.pb").write_bytes(b"")
+    (d / "variables" / "variables.data-00000-of-00001").write_bytes(b"partial")
+    assert not _version_ready(d)
+    (d / "variables" / "variables.index").write_bytes(b"")
+    assert _version_ready(d)
+
+
 def test_hot_swap_over_live_socket(tmp_path):
     """A new version dir appearing mid-serve changes what unpinned requests
     score with — without restarting the server or dropping the socket."""
