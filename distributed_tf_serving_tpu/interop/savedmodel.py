@@ -197,6 +197,42 @@ def _unflatten_like(template, flat: dict[str, np.ndarray], prefix=()):
     return flat["/".join(map(str, prefix))]
 
 
+# Name-pattern roles for mapping-free import of real-world exports
+# (VERDICT.md round-1 item 4). Keras/estimator exports carry a standard
+# vocabulary (dense_1/kernel, embedding/embeddings, linear/linear_model/...,
+# tfrs cross layers); classifying both sides into coarse roles lets
+# same-shape kernels from DIFFERENT groups (a cross (d,d) vs an MLP (d,d))
+# bind without an explicit mapping. First match wins, so the more specific
+# roles come first.
+_VAR_ROLE_PATTERNS: tuple[tuple[str, str], ...] = (
+    ("embedding", r"embedding|embeddings|emb_|_emb\b|lookup_table"),
+    ("wide", r"wide|linear_model|(^|/)linear(/|$)"),
+    ("cross", r"cross"),
+    ("user", r"user|query"),
+    ("item", r"(^|/|_)item|candidate"),
+    ("out", r"logits|output|head|prediction|score|(^|/)out(/|$)"),
+    ("deep", r"dense|dnn|deep|mlp|hidden|(^|/)fc|sequential|tower"),
+)
+
+_PARAM_ROLE_PATTERNS: tuple[tuple[str, str], ...] = (
+    ("embedding", r"embedding"),
+    ("wide", r"wide|linear"),
+    ("cross", r"cross"),
+    ("user", r"user"),
+    ("item", r"item"),
+    ("out", r"(^|/)out(/|$)|bias"),
+    ("deep", r"mlp"),
+)
+
+
+def _role(name: str, patterns) -> str:
+    low = name.lower()
+    for role, pat in patterns:
+        if re.search(pat, low):
+            return role
+    return "other"
+
+
 def map_variables(
     variables: dict[str, np.ndarray],
     target_params,
@@ -206,13 +242,19 @@ def map_variables(
 
     `mapping` is {our-param-path: tf-variable-name} and wins outright
     (variable names accepted with or without the checkpoint's
-    `/.ATTRIBUTES/VARIABLE_VALUE` suffix). Without it: exact-shape matching
-    — a shape held by exactly one variable and one slot binds directly;
-    repeated shapes (MLP stacks exported as layer_0/kernel, layer_1/kernel,
-    ...) bind in natural-sorted-name vs tree order (numeric-aware, so
-    layer_10 sorts after layer_2, matching both TF's and our layer
-    numbering). Any leftover ambiguity or shape mismatch raises with the
-    full candidate list.
+    `/.ATTRIBUTES/VARIABLE_VALUE` suffix). Without it, two passes:
+
+    1. *Role pass* — both sides are classified into coarse semantic roles by
+       name patterns (_VAR_ROLE_PATTERNS: the common Keras/estimator export
+       vocabulary; _PARAM_ROLE_PATTERNS: the zoo's own tree vocabulary).
+       Within a (role, shape) bucket whose candidate counts agree, variables
+       bind to params in natural-sorted-name vs tree order. Buckets that
+       don't line up defer — the role pass never errors.
+    2. *Shape pass* (the original semantics) — leftovers bind by exact
+       shape; a shape held by exactly one variable and one slot binds
+       directly; repeated shapes bind in natural order only within one
+       indexed stack. Leftover ambiguity or mismatch raises with the full
+       candidate list.
     """
     variables = {
         _clean_name(k): np.asarray(v)
@@ -234,12 +276,35 @@ def map_variables(
                 f"available: {sorted(variables)}"
             )
         chosen.update(mapping)
-    unmapped_params = [p for p in flat_target if p not in chosen]
-    used = set(chosen.values())
-    unused_vars = [v for v in variables if v not in used]
 
+    def remaining():
+        used = set(chosen.values())
+        params = [p for p in flat_target if p not in chosen]  # tree order
+        varnames = [v for v in sorted(variables, key=_natural_key) if v not in used]
+        return params, varnames
+
+    # ---- pass 1: role-partitioned shape matching (defer on any mismatch)
+    unmapped_params, unused_vars = remaining()
+    buckets: dict[tuple[str, tuple], tuple[list[str], list[str]]] = {}
+    for p in unmapped_params:
+        key = (_role(p, _PARAM_ROLE_PATTERNS), tuple(np.shape(flat_target[p])))
+        buckets.setdefault(key, ([], []))[0].append(p)
+    for v in unused_vars:
+        key = (_role(v, _VAR_ROLE_PATTERNS), tuple(variables[v].shape))
+        if key in buckets:
+            buckets[key][1].append(v)
+    for (role, _shape), (params, cands) in buckets.items():
+        if role == "other" or not params or len(params) != len(cands):
+            continue  # defer to the shape pass
+        if len(params) > 1 and len({re.sub(r"\d+", "#", p) for p in params}) > 1:
+            continue  # multiple stacks share (role, shape): don't guess here
+        for p, v in zip(params, cands):
+            chosen[p] = v
+
+    # ---- pass 2: global shape matching over whatever the role pass left
+    unmapped_params, unused_vars = remaining()
     by_shape_vars: dict[tuple, list[str]] = {}
-    for v in sorted(unused_vars, key=_natural_key):
+    for v in unused_vars:
         by_shape_vars.setdefault(tuple(variables[v].shape), []).append(v)
     by_shape_params: dict[tuple, list[str]] = {}
     for p in unmapped_params:  # tree order

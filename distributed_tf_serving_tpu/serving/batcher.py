@@ -194,6 +194,9 @@ class _WorkItem:
     future: Future  # resolves to dict[str, np.ndarray]
     enqueue_t: float
     output_keys: tuple[str, ...] | None  # None = all model outputs
+    # Warmup work legitimately spends minutes compiling on the batcher
+    # thread; it must not read as a wedged device to the circuit breaker.
+    warmup: bool = False
 
 
 @dataclasses.dataclass
@@ -343,6 +346,7 @@ class DynamicBatcher:
         servable: Servable,
         arrays: dict[str, np.ndarray],
         output_keys: tuple[str, ...] | None = None,
+        _warmup: bool = False,
     ) -> Future:
         """Enqueue one request's arrays; returns a Future of output arrays
         (sliced back to the request's own candidate count). output_keys limits
@@ -388,6 +392,7 @@ class DynamicBatcher:
                 future=fut,
                 enqueue_t=time.perf_counter(),
                 output_keys=output_keys,
+                warmup=_warmup,
             )
         except BaseException:
             with self._cv:
@@ -429,7 +434,7 @@ class DynamicBatcher:
         the batching thread exactly like live traffic, so hot-loading a new
         model version never races the jit caches with in-flight requests."""
         futures = [
-            self.submit(servable, self.warmup_arrays(servable, b))
+            self.submit(servable, self.warmup_arrays(servable, b), _warmup=True)
             for b in buckets or self.buckets
         ]
         for fut in futures:
@@ -521,7 +526,14 @@ class DynamicBatcher:
 
     def _dispatch(self, group: list[_WorkItem], total: int) -> None:
         with self._cv:
-            self._dispatching_since = time.perf_counter()
+            # An all-warmup group is exempt from the wedge clock: hot-load
+            # warmup (warmup_via_queue during a version rollout) legitimately
+            # compiles for minutes on this thread, and tripping the breaker
+            # then would shed live traffic during every rollout. A live
+            # request coalesced into the group re-arms the clock.
+            self._dispatching_since = (
+                None if all(it.warmup for it in group) else time.perf_counter()
+            )
         try:
             bucket = bucket_for(total, self.buckets)
             first = group[0]
@@ -574,7 +586,8 @@ class DynamicBatcher:
             with self._cv:
                 self._inflight_seq += 1
                 batch_id = self._inflight_seq
-                self._inflight[batch_id] = time.perf_counter()
+                if not all(it.warmup for it in group):
+                    self._inflight[batch_id] = time.perf_counter()
             self._completers.submit(self._complete, batch_id, group, fetch)
         except Exception as exc:  # propagate to every waiter, keep serving
             for it in group:

@@ -205,8 +205,19 @@ class PredictionServiceImpl:
             resp.model_spec.CopyFrom(
                 self._echo_spec(servable, request.model_spec.signature_name or "serving_default")
             )
+            # Mirror the client's tensor encoding: a client that sent
+            # repeated fields (the grpc-java builder style, DCNClient.java:
+            # 98-108) reads outputs via getFloatValList(), which is EMPTY if
+            # we reply with tensor_content — TF-Serving itself replies
+            # AsProtoField-style. Clients that sent tensor_content get the
+            # zero-copy fast path back.
+            mirror_content = any(
+                tp.tensor_content for tp in request.inputs.values()
+            )
             for name in out_names:
-                resp.outputs[name].CopyFrom(codec.from_ndarray(outputs[name]))
+                resp.outputs[name].CopyFrom(
+                    codec.from_ndarray(outputs[name], use_tensor_content=mirror_content)
+                )
         return resp
 
     # ----------------------------------------------------- Classify / Regress

@@ -166,6 +166,10 @@ class VersionWatcher:
                     continue
                 self._attempts.pop(version, None)
                 self._attempt_mtime.pop(version, None)
+            # Snapshot BEFORE loading: a writer finishing mid-attempt would
+            # otherwise be recorded at its final mtime, making the blacklist
+            # look current forever (no restart-free recovery).
+            pre_mtime = _version_mtime(path)
             try:
                 servable = self.loader(version, path)
                 if self.warmup is not None:
@@ -176,7 +180,7 @@ class VersionWatcher:
                 log.info("loaded %s v%d from %s", name, version, path)
             except Exception:
                 self._attempts[version] = self._attempts.get(version, 0) + 1
-                self._attempt_mtime[version] = _version_mtime(path)
+                self._attempt_mtime[version] = pre_mtime
                 log.exception(
                     "failed to load %s v%d from %s (attempt %d/%d)",
                     name, version, path,

@@ -280,7 +280,8 @@ def test_wedged_device_circuit_breaker(servable):
     batcher = DynamicBatcher(
         buckets=(32,), max_wait_us=0,
         run_fn=_blocking_run_fn(release, calls),
-        breaker_timeout_s=1.5,
+        breaker_timeout_s=5.0,  # generous: the backlog submit below must
+        # land before the breaker can open even on a heavily loaded host
     ).start()
     try:
         stuck = batcher.submit(servable, make_arrays(4))  # wedges the loop
@@ -384,5 +385,37 @@ def test_exact_fill_fast_path_copies_caller_array(servable):
         arrays["feat_wts"][:] = -1e9  # caller mutates immediately after submit
         got = fut.result(timeout=30)["prediction_node"]
         np.testing.assert_allclose(got, want, rtol=1e-6)
+    finally:
+        batcher.stop()
+
+
+def test_warmup_compile_does_not_trip_breaker(servable):
+    """Hot-load warmup (warmup_via_queue) legitimately spends a long time
+    compiling on the batcher thread; the wedge clock must not count it, or
+    every version rollout would shed live traffic."""
+    import time
+
+    def slow_warmup_run(servable, batched):
+        time.sleep(0.8)  # far past the breaker threshold below
+        n = batched["feat_ids"].shape[0]
+        return {"prediction_node": np.zeros((n,), np.float32)}
+
+    batcher = DynamicBatcher(
+        buckets=(8, 32), max_wait_us=0,
+        run_fn=slow_warmup_run,
+        breaker_timeout_s=0.3,
+    ).start()
+    try:
+        t = threading.Thread(
+            target=lambda: batcher.warmup_via_queue(servable, buckets=(8, 32)),
+            daemon=True,
+        )
+        t.start()
+        time.sleep(0.5)  # inside the first slow warmup dispatch
+        # A live submit during warmup must be accepted, not DeviceWedged.
+        fut = batcher.submit(servable, make_arrays(4))
+        assert fut.result(timeout=30)["prediction_node"].shape == (4,)
+        t.join(timeout=30)
+        assert not t.is_alive()
     finally:
         batcher.stop()

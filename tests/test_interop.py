@@ -6,6 +6,7 @@ test drives the real TensorFlow export/extract path in subprocesses (TF
 must never be imported into this process — duplicate descriptor symbols).
 """
 
+import dataclasses
 import pathlib
 import subprocess
 import sys
@@ -337,3 +338,192 @@ def test_optimizer_slots_filtered_in_premade_npz():
     }
     out = map_variables(variables, template)  # not ambiguous: slots filtered
     np.testing.assert_array_equal(out["w"], np.ones((2, 2)))
+
+
+# -------------------------------------------- role-based mapping-free import
+
+
+def test_keras_names_resolve_cross_vs_mlp_shape_collision():
+    """The VERDICT.md round-1 scenario: a DCN-v2 whose cross kernel and MLP
+    kernels share one shape. Pure shape-matching must refuse to guess; the
+    Keras name vocabulary (cross_0/kernel vs dense/kernel) must resolve it
+    with NO explicit mapping, binding every weight to its donor value."""
+    cfg = dataclasses.replace(
+        CFG, num_fields=4, embed_dim=4, mlp_dims=(16, 16), num_cross_layers=1
+    )
+    model = build_model("dcn_v2", cfg)
+    donor = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(1)))
+    template = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(0)))
+    flat = _flatten_params(donor)
+
+    def keras_name(p):
+        leaf = "kernel" if p.endswith("w") else "bias"
+        parts = p.split("/")
+        if p == "embedding":
+            return "model/embedding/embeddings"
+        if parts[0] == "cross":
+            return f"model/cross_{parts[1]}/{leaf}"
+        if parts[0] == "mlp":
+            i = int(parts[1])
+            return f"model/dense/{leaf}" if i == 0 else f"model/dense_{i}/{leaf}"
+        assert parts[0] == "out"
+        return f"model/dense_7/{leaf}"  # final head: plain Keras Dense name
+
+    variables = {keras_name(p): v for p, v in flat.items()}
+    # sanity: the collision is real — without name signal this refuses
+    with pytest.raises(SavedModelImportError, match="shared across|ambiguous"):
+        map_variables({f"v{i}": v for i, v in enumerate(flat.values())}, template)
+
+    out = map_variables(variables, template)  # mapping-free
+    got = _flatten_params(out)
+    for p in flat:
+        np.testing.assert_array_equal(got[p], flat[p], err_msg=p)
+
+
+@pytest.mark.parametrize("kind,extra", [
+    ("wide_deep", {}),
+    ("deepfm", {}),
+    ("dcn_v2", {}),
+    ("two_tower", {"num_user_fields": 3}),
+])
+def test_mapping_free_import_per_family(kind, extra):
+    """Every BASELINE config family imports mapping-free from Keras-style
+    export names (VERDICT.md round-1 item 4 'a documented recipe per
+    BASELINE config family')."""
+    cfg = dataclasses.replace(CFG, mlp_dims=(8, 8), **extra)
+    model = build_model(kind, cfg)
+    donor = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(2)))
+    template = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(0)))
+    flat = _flatten_params(donor)
+
+    def keras_name(p):
+        leaf = "kernel" if p.endswith("/w") else ("bias" if p.endswith("/b") else None)
+        parts = p.split("/")
+        if "embedding" in p:
+            return "model/embedding/embeddings"
+        if parts[0] in ("wide", "linear"):
+            return f"model/linear_model/{p.replace('/', '_')}"
+        if parts[0] == "wide_bias":
+            return "model/linear_model/bias_weight"
+        if parts[0] == "bias":
+            return "model/top_bias"
+        if parts[0] == "temperature":
+            return "model/temperature_scale"
+        if parts[0] == "cross":
+            return f"model/cross_{parts[1]}/{leaf}"
+        if parts[0] in ("user_mlp", "item_mlp"):
+            tower = "user_tower" if parts[0] == "user_mlp" else "item_tower"
+            i = int(parts[1])
+            suffix = "dense" if i == 0 else f"dense_{i}"
+            return f"model/{tower}/{suffix}/{leaf}"
+        if parts[0] == "mlp":
+            i = int(parts[1])
+            suffix = "dense" if i == 0 else f"dense_{i}"
+            return f"model/{suffix}/{leaf}"
+        if parts[0] == "out":
+            return f"model/dense_9/{leaf}"
+        raise AssertionError(f"unexpected param path {p}")
+
+    variables = {keras_name(p): v for p, v in flat.items()}
+    out = map_variables(variables, template)
+    got = _flatten_params(out)
+    for p in flat:
+        np.testing.assert_array_equal(got[p], flat[p], err_msg=f"{kind}:{p}")
+
+
+_TF_DCN_EXPORT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+out, golden_npz = sys.argv[1], sys.argv[2]
+V, F, D, L = 499, 4, 3, 2      # vocab, fields, embed dim, cross layers
+d = F * D
+MLP = (d, d)                   # deliberately collides with the (d,d) cross kernels
+
+rng = np.random.RandomState(5)
+
+
+class KerasishDCN(tf.Module):
+    # Attribute names are the checkpoint variable paths: deliberately
+    # NON-zoo vocabulary (embedding/cross_*/dense*/output_*) — the import
+    # must resolve them by role patterns, not by matching our tree names.
+    def __init__(self):
+        super().__init__()
+        self.embedding = tf.Variable((rng.randn(V, D) / np.sqrt(D)).astype(np.float32))
+        self.cross_kernels = [
+            tf.Variable((rng.randn(d, d) / np.sqrt(d)).astype(np.float32)) for _ in range(L)
+        ]
+        self.cross_biases = [tf.Variable(np.zeros(d, np.float32) + 0.01 * i) for i in range(L)]
+        self.dense0_kernel = tf.Variable((rng.randn(d, MLP[0]) * np.sqrt(2.0 / d)).astype(np.float32))
+        self.dense0_bias = tf.Variable(np.full(MLP[0], 0.02, np.float32))
+        self.dense1_kernel = tf.Variable(
+            (rng.randn(MLP[0], MLP[1]) * np.sqrt(2.0 / MLP[0])).astype(np.float32)
+        )
+        self.dense1_bias = tf.Variable(np.full(MLP[1], 0.03, np.float32))
+        self.output_kernel = tf.Variable(
+            (rng.randn(d + MLP[1], 1) * np.sqrt(2.0 / (d + MLP[1]))).astype(np.float32)
+        )
+        self.output_bias = tf.Variable(np.zeros(1, np.float32))
+
+    @tf.function(input_signature=[
+        tf.TensorSpec([None, F], tf.int64, name="feat_ids"),
+        tf.TensorSpec([None, F], tf.float32, name="feat_wts"),
+    ])
+    def __call__(self, feat_ids, feat_wts):
+        rows = tf.cast(tf.math.floormod(feat_ids, tf.constant(V, tf.int64)), tf.int32)
+        emb = tf.gather(self.embedding, rows) * feat_wts[..., None]
+        x0 = tf.reshape(emb, [-1, d])
+        x = x0
+        for w, b in zip(self.cross_kernels, self.cross_biases):
+            x = x0 * (tf.matmul(x, w) + b) + x
+        h = x0
+        for w, b in ((self.dense0_kernel, self.dense0_bias),
+                     (self.dense1_kernel, self.dense1_bias)):
+            h = tf.nn.relu(tf.matmul(h, w) + b)
+        cat = tf.concat([x, h], axis=-1)
+        logit = tf.matmul(cat, self.output_kernel)[:, 0] + self.output_bias[0]
+        return {"prediction_node": tf.sigmoid(logit)}
+
+
+m = KerasishDCN()
+tf.saved_model.save(m, out, signatures={"serving_default": m.__call__})
+ids = rng.randint(0, 1 << 40, size=(7, F)).astype(np.int64)
+wts = rng.rand(7, F).astype(np.float32)
+scores = m(tf.constant(ids), tf.constant(wts))["prediction_node"].numpy()
+np.savez(golden_npz, ids=ids, wts=wts, scores=scores)
+print("saved")
+"""
+
+
+@pytest.mark.slow
+def test_real_keras_named_dcn_import_golden_scores(tmp_path):
+    """VERDICT.md round-1 item 4 'Done' condition: a genuinely TF-exported
+    DCN with non-zoo variable names (embedding / cross_kernels/N /
+    denseN_kernel / output_kernel) imports with NO mapping and serves TF's
+    own golden scores. Skips when TF is unavailable."""
+    export = tmp_path / "keras_dcn"
+    golden_npz = tmp_path / "golden.npz"
+    proc = subprocess.run(
+        [sys.executable, "-c", _TF_DCN_EXPORT, str(export), str(golden_npz)],
+        capture_output=True, text=True, timeout=300,
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"tensorflow unavailable: {proc.stderr.strip()[-300:]}")
+
+    from distributed_tf_serving_tpu.interop import import_savedmodel
+    from distributed_tf_serving_tpu.serving.batcher import prepare_inputs
+
+    cfg = ModelConfig(
+        num_fields=4, vocab_size=499, embed_dim=3, mlp_dims=(12, 12),
+        num_cross_layers=2, compute_dtype="float32",
+    )
+    servable = import_savedmodel(export, "dcn_v2", cfg, name="DCN", version=3)
+    with np.load(golden_npz) as g:
+        ids, wts, want = g["ids"], g["wts"], g["scores"]
+    got = np.asarray(
+        servable(prepare_inputs(servable.model, {"feat_ids": ids, "feat_wts": wts}))[
+            "prediction_node"
+        ]
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5)

@@ -277,26 +277,33 @@ def test_client_full_async_mode_knob():
     from distributed_tf_serving_tpu.client import client_from_config
     from distributed_tf_serving_tpu.utils import ClientConfig
 
-    cfg = ClientConfig(hosts=("h1", "h2"), full_async_mode=False)
-    client = client_from_config(cfg)
-    assert client.full_async is False
-    assert client.hosts == ["h1", "h2"]
-
-    # Scheduling-equivalence on a live socket is covered by the serving
-    # integration tests; here pin the wiring + the sequential code path via
-    # a stubbed shard call.
     calls = []
 
-    async def fake_shard(i, shard, rr):
-        calls.append(i)
-        await asyncio.sleep(0.01 if i == 0 else 0)  # tempt reordering
-        return np.full((shard["feat_ids"].shape[0],), float(i), np.float32)
+    async def go():
+        # grpc.aio channels need a running event loop at construction, so
+        # the whole client lifecycle lives inside asyncio.run.
+        cfg = ClientConfig(hosts=("h1", "h2"), full_async_mode=False)
+        client = client_from_config(cfg)
+        assert client.full_async is False
+        assert client.hosts == ["h1", "h2"]
 
-    client._predict_shard = fake_shard
-    arrays = {
-        "feat_ids": np.zeros((6, 3), np.int64),
-        "feat_wts": np.zeros((6, 3), np.float32),
-    }
-    merged = asyncio.run(client.predict(arrays))
+        # Scheduling-equivalence on a live socket is covered by the serving
+        # integration tests; here pin the wiring + the sequential code path
+        # via a stubbed shard call.
+        async def fake_shard(i, shard, rr):
+            calls.append(i)
+            await asyncio.sleep(0.01 if i == 0 else 0)  # tempt reordering
+            return np.full((shard["feat_ids"].shape[0],), float(i), np.float32)
+
+        client._predict_shard = fake_shard
+        arrays = {
+            "feat_ids": np.zeros((6, 3), np.int64),
+            "feat_wts": np.zeros((6, 3), np.float32),
+        }
+        merged = await client.predict(arrays)
+        await client.close()
+        return merged
+
+    merged = asyncio.run(go())
     assert calls == [0, 1]  # strictly sequential in host order
     np.testing.assert_array_equal(merged, [0, 0, 0, 1, 1, 1])

@@ -1,0 +1,355 @@
+"""Java wire-compatibility fixtures (VERDICT.md round-1 item 5).
+
+No JVM exists in this image, so the golden bytes are derived BY HAND from the
+protobuf wire specification, independently of any protobuf runtime: a minimal
+varint/tag writer below replicates exactly what protobuf-java 3.16.1's
+generated builders emit for the reference client's payload
+(DCNClient.java:91-115 — fields serialized in field-number order, map entries
+in insertion order, packed repeated scalars), and a minimal reader decodes our
+responses the way the generated Java parser would. If any field number,
+wire type, or encoding in our vendored protos drifts from the reference's
+(predict.proto:12-40, model.proto:9-19, tensor.proto:14-84), these tests
+fail.
+
+Pinned here:
+- request parse: hand-built Java-style PredictRequest bytes (int64_val /
+  float_val repeated encodings, Int64Value version wrapper, either map
+  order) decode through our pb2 + codec to the exact arrays;
+- request emit: our client's repeated-field encoding walks back under the
+  independent reader with the Java field numbers/wire types;
+- response: a repeated-field request gets float_val outputs a Java client's
+  getFloatValList() can read (tensor_content requests get tensor_content).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_tf_serving_tpu import codec
+from distributed_tf_serving_tpu.client import build_predict_request
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+from distributed_tf_serving_tpu.serving import DynamicBatcher, PredictionServiceImpl
+from distributed_tf_serving_tpu.serving.batcher import fold_ids_host
+
+# ------------------------- minimal wire writer (spec-derived, no protobuf)
+
+WIRE_VARINT, WIRE_I64, WIRE_LEN, WIRE_I32 = 0, 1, 2, 5
+
+
+def varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's-complement 64-bit, per the spec
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def tag(field: int, wire: int) -> bytes:
+    return varint((field << 3) | wire)
+
+
+def ld(field: int, payload: bytes) -> bytes:
+    """Length-delimited field (submessage / string / packed / bytes)."""
+    return tag(field, WIRE_LEN) + varint(len(payload)) + payload
+
+
+def packed_varints(field: int, values) -> bytes:
+    return ld(field, b"".join(varint(int(v)) for v in values))
+
+
+def packed_f32(field: int, values) -> bytes:
+    return ld(field, struct.pack(f"<{len(values)}f", *values))
+
+
+# TensorProto field numbers (tensor.proto:14-84): dtype=1, tensor_shape=2,
+# tensor_content=4, float_val=5, int64_val=10. TensorShapeProto.dim=2,
+# Dim.size=1. DataType: DT_FLOAT=1, DT_INT64=9.
+DT_FLOAT, DT_INT64 = 1, 9
+
+
+def shape_bytes(dims) -> bytes:
+    return b"".join(ld(2, tag(1, WIRE_VARINT) + varint(d)) for d in dims)
+
+
+def tensor_int64(ids: np.ndarray) -> bytes:
+    return (
+        tag(1, WIRE_VARINT) + varint(DT_INT64)
+        + ld(2, shape_bytes(ids.shape))
+        + packed_varints(10, ids.ravel())
+    )
+
+
+def tensor_float(wts: np.ndarray) -> bytes:
+    return (
+        tag(1, WIRE_VARINT) + varint(DT_FLOAT)
+        + ld(2, shape_bytes(wts.shape))
+        + packed_f32(5, wts.ravel())
+    )
+
+
+def model_spec_bytes(name="DCN", signature="serving_default", version=None) -> bytes:
+    # ModelSpec (model.proto:9-19): name=1, version=2 (google.protobuf.
+    # Int64Value{value=1}), signature_name=3.
+    out = ld(1, name.encode())
+    if version is not None:
+        out += ld(2, tag(1, WIRE_VARINT) + varint(version))
+    out += ld(3, signature.encode())
+    return out
+
+
+def java_predict_request_bytes(
+    ids: np.ndarray, wts: np.ndarray, version=None, reverse_map=False
+) -> bytes:
+    """What protobuf-java 3.16.1 emits for DCNClient.sendRequest
+    (DCNClient.java:91-115): PredictRequest.model_spec=1 then inputs map
+    entries (field 2, entry{key=1,value=2}) in insertion order — feat_ids
+    first (DCNClient.java:98-102), feat_wts second (:104-108).
+    reverse_map covers the map-ordering tolerance a parser must have."""
+    entries = [
+        ld(2, ld(1, b"feat_ids") + ld(2, tensor_int64(ids))),
+        ld(2, ld(1, b"feat_wts") + ld(2, tensor_float(wts))),
+    ]
+    if reverse_map:
+        entries.reverse()
+    return ld(1, model_spec_bytes(version=version)) + b"".join(entries)
+
+
+# -------------------------- minimal wire reader (how Java would parse us)
+
+
+def walk(buf: bytes):
+    """Yield (field, wire, value) triples; value is bytes for LEN fields."""
+    i = 0
+    while i < len(buf):
+        key = 0
+        shift = 0
+        while True:
+            b = buf[i]
+            i += 1
+            key |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        field, wire = key >> 3, key & 7
+        if wire == WIRE_VARINT:
+            v = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                v |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            yield field, wire, v
+        elif wire == WIRE_LEN:
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            yield field, wire, buf[i : i + ln]
+            i += ln
+        elif wire == WIRE_I64:
+            yield field, wire, buf[i : i + 8]
+            i += 8
+        elif wire == WIRE_I32:
+            yield field, wire, buf[i : i + 4]
+            i += 4
+        else:
+            raise AssertionError(f"unexpected wire type {wire}")
+
+
+def fields(buf: bytes) -> dict:
+    out: dict = {}
+    for field, _, v in walk(buf):
+        out.setdefault(field, []).append(v)
+    return out
+
+
+# ------------------------------------------------------------------ setup
+
+CFG = ModelConfig(
+    num_fields=6, vocab_size=512, embed_dim=4, mlp_dims=(8,),
+    num_cross_layers=1, compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def servable():
+    model = build_model("dcn", CFG)
+    return Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(CFG.num_fields),
+    )
+
+
+@pytest.fixture(scope="module")
+def service(servable):
+    registry = ServableRegistry()
+    registry.load(servable)
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    yield PredictionServiceImpl(registry, batcher)
+    batcher.stop()
+
+
+def payload(n=5, seed=3):
+    rng = np.random.RandomState(seed)
+    return (
+        rng.randint(0, 512, size=(n, CFG.num_fields)).astype(np.int64),
+        rng.rand(n, CFG.num_fields).astype(np.float32),
+    )
+
+
+def golden(servable, ids, wts):
+    batch = {
+        "feat_ids": fold_ids_host(ids, CFG.vocab_size),
+        "feat_wts": wts,
+    }
+    return np.asarray(servable.model.apply(servable.params, batch)["prediction_node"])
+
+
+# ------------------------------------------------------------------- tests
+
+
+def test_java_request_bytes_parse_to_exact_arrays():
+    """Our pb2 must decode the hand-built Java bytes to the exact payload:
+    field numbers, packed repeated encodings, shapes — any drift fails."""
+    ids, wts = payload()
+    req = apis.PredictRequest.FromString(java_predict_request_bytes(ids, wts))
+    assert req.model_spec.name == "DCN"
+    assert req.model_spec.signature_name == "serving_default"
+    assert not req.model_spec.HasField("version")
+    np.testing.assert_array_equal(codec.to_ndarray(req.inputs["feat_ids"]), ids)
+    np.testing.assert_allclose(codec.to_ndarray(req.inputs["feat_wts"]), wts, rtol=0)
+
+
+def test_java_request_map_order_tolerance():
+    ids, wts = payload()
+    a = apis.PredictRequest.FromString(java_predict_request_bytes(ids, wts))
+    b = apis.PredictRequest.FromString(
+        java_predict_request_bytes(ids, wts, reverse_map=True)
+    )
+    for req in (a, b):
+        assert set(req.inputs) == {"feat_ids", "feat_wts"}
+    np.testing.assert_array_equal(
+        codec.to_ndarray(a.inputs["feat_ids"]), codec.to_ndarray(b.inputs["feat_ids"])
+    )
+
+
+def test_java_int64value_version_wrapper(service, servable):
+    """ModelSpec.version rides an Int64Value wrapper (model.proto:14): the
+    hand-built wrapper bytes must resolve the pinned version, and the echoed
+    response model_spec must carry it back in the same encoding."""
+    ids, wts = payload()
+    req = apis.PredictRequest.FromString(
+        java_predict_request_bytes(ids, wts, version=1)
+    )
+    assert req.model_spec.version.value == 1
+    resp = service.predict(req)
+    spec_fields = fields(fields(resp.SerializeToString())[2][0])
+    # ModelSpec.version (field 2) -> Int64Value.value (field 1) == 1
+    version_msg = fields(spec_fields[2][0])
+    assert version_msg[1] == [1]
+
+
+def test_end_to_end_java_request_scores(service, servable):
+    """The full server path fed raw Java bytes returns the golden scores."""
+    ids, wts = payload()
+    resp = service.predict(
+        apis.PredictRequest.FromString(java_predict_request_bytes(ids, wts))
+    )
+    got = codec.to_ndarray(resp.outputs["prediction_node"])
+    np.testing.assert_allclose(got, golden(servable, ids, wts), rtol=1e-5)
+
+
+def test_our_repeated_encoding_walks_as_java_would():
+    """build_predict_request(use_tensor_content=False) must emit exactly the
+    field numbers / wire types the generated Java parser reads."""
+    ids, wts = payload()
+    req = build_predict_request(
+        {"feat_ids": ids, "feat_wts": wts}, "DCN", use_tensor_content=False
+    )
+    top = fields(req.SerializeToString())
+    spec = fields(top[1][0])
+    assert spec[1] == [b"DCN"]
+    assert spec[3] == [b"serving_default"]
+    entries = {}
+    for entry in top[2]:
+        f = fields(entry)
+        entries[f[1][0]] = fields(f[2][0])
+    tp_ids = entries[b"feat_ids"]
+    assert tp_ids[1] == [DT_INT64]  # dtype field/value
+    dims = [fields(d)[1][0] for d in fields(tp_ids[2][0])[2]]
+    assert dims == list(ids.shape)
+    packed = tp_ids[10][0]  # int64_val packed (field 10, LEN)
+    # decode the packed payload as raw varints
+    vals = []
+    i = 0
+    while i < len(packed):
+        v = 0
+        shift = 0
+        while True:
+            b = packed[i]
+            i += 1
+            v |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        vals.append(v)
+    np.testing.assert_array_equal(np.array(vals, np.int64).reshape(ids.shape), ids)
+    tp_wts = entries[b"feat_wts"]
+    assert tp_wts[1] == [DT_FLOAT]
+    raw = tp_wts[5][0]  # float_val packed (field 5, LEN)
+    np.testing.assert_allclose(
+        np.frombuffer(raw, "<f4").reshape(wts.shape), wts, rtol=0
+    )
+
+
+def test_response_mirrors_java_repeated_encoding(service, servable):
+    """A repeated-field request (the Java client) must get float_val outputs
+    — getFloatValList() reads field 5; tensor_content would read back empty
+    (TF-Serving itself responds AsProtoField-style)."""
+    ids, wts = payload()
+    resp = service.predict(
+        apis.PredictRequest.FromString(java_predict_request_bytes(ids, wts))
+    )
+    outputs = {}
+    for entry in fields(resp.SerializeToString())[1]:
+        f = fields(entry)
+        outputs[f[1][0]] = fields(f[2][0])
+    value = outputs[b"prediction_node"]
+    assert value[1] == [DT_FLOAT]
+    assert 4 not in value  # no tensor_content
+    scores = np.frombuffer(value[5][0], "<f4")
+    np.testing.assert_allclose(scores, golden(servable, ids, wts), rtol=1e-5)
+
+
+def test_response_mirrors_tensor_content(service):
+    """tensor_content in -> tensor_content out (our client's fast path)."""
+    ids, wts = payload()
+    req = build_predict_request(
+        {"feat_ids": ids, "feat_wts": wts}, "DCN", use_tensor_content=True
+    )
+    resp = service.predict(req)
+    tp = resp.outputs["prediction_node"]
+    assert tp.tensor_content and not tp.float_val
