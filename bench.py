@@ -8,15 +8,22 @@ PredictionService over a real localhost gRPC socket — the full stack the
 reference exercised, with tensorflow_model_server replaced by the JAX/XLA
 backend and its server-side batching by the padded-bucket pipeline batcher.
 
-Headline metric is per-chip QPS at the 1k-candidate workload point
-(BASELINE.json: "CTR QPS & p50/p99 latency per chip at 1k-candidate batch").
-vs_baseline compares against the north-star-implied 500 QPS/chip (<=2 ms p50
-per 1k-candidate request => 500 sequential requests/s/chip). p50/p99 are
-reported alongside; this rig reaches its TPU through a relay whose measured
-round-trip floor (rtt_floor_ms) lower-bounds any single-request latency, so
-wall latency is tunnel-bound, not stack-bound — the per-phase host breakdown
-(phases_us: decode/pad/dispatch/readback/encode) shows the on-host budget
-net of the tunnel, and the batcher pipelines past it for throughput.
+Round-3 scope (VERDICT r2 tasks 1-5, 8), all in the ONE json line:
+- the model served is TRAINED ON THE CHIP first (train block: steps, wall,
+  loss, AUC) — the headline number scores a real model, not random init;
+- the Pallas fused cross kernel runs on the real TPU (interpret=False),
+  equality-checked and timed against the per-layer XLA path, and is
+  auto-enabled for serving when it wins (pallas block);
+- the sustained load loop runs >= 5,000 requests / tens of seconds;
+- both traffic shapes are reported: qps_repeated (reference methodology,
+  payload built once) and qps_unique (per-request-varying payloads, so the
+  content-addressed DeviceInputCache and jit caches cannot flatter);
+- the throughput decomposition (device block): pure on-device step time per
+  bucket (amortized K-run differencing nets out the tunnel), implied
+  device-limited QPS, achieved fraction, transfer bytes/batch, rough MFU —
+  separating the chip's ceiling from the rig's relay-tunnel ceiling;
+- an adversarial overload phase past queue capacity records shed behavior
+  (RESOURCE_EXHAUSTED) on the real serving stack.
 
 Failure posture (round-1 lesson, BENCH_r01.json rc=1 on a wedged TPU relay):
 the process that touches the device can hang un-interruptibly inside backend
@@ -36,8 +43,6 @@ import time
 
 CANDIDATES = 1000
 NUM_FIELDS = 43
-CONCURRENCY = 64
-REQUESTS_PER_WORKER = 15
 TARGET_QPS = 500.0  # north-star-implied: 1 req / 2ms p50, per chip
 
 PROBE_TIMEOUT_S = 150
@@ -163,30 +168,313 @@ def _parent_main() -> None:
 # --------------------------------------------------------------------- child
 
 
-def measure_rtt_floor() -> float:
-    """Round-trip floor of the host<->device link: tiny dispatch + fetch."""
+class Scale:
+    """Workload scaling: flagship numbers on the accelerator, a fast smoke
+    on the 1-core CPU fallback (same code path, smaller everything)."""
+
+    def __init__(self, platform: str):
+        self.tpu = platform != "cpu"
+        self.concurrency = 64 if self.tpu else 8
+        self.requests_per_worker = 250 if self.tpu else 4  # 16k sustained on TPU
+        self.unique_requests_per_worker = 60 if self.tpu else 3
+        self.unique_pool = 128 if self.tpu else 8
+        self.buckets = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192) if self.tpu \
+            else (32, 64, 128, 256, 512, 1024)
+        self.timed_buckets = (1024, 2048, 4096, 8192) if self.tpu else (256, 1024)
+        self.train_steps = 150 if self.tpu else 8
+        self.train_batch = 2048 if self.tpu else 256
+        self.vocab_size = 1 << 20 if self.tpu else 1 << 14
+        self.embed_dim = 16 if self.tpu else 8
+        self.mlp_dims = (256, 128, 64) if self.tpu else (32, 16)
+        self.overload_tasks = 128 if self.tpu else 24
+        self.pallas_rows = 4096 if self.tpu else 256
+        self.pallas_widths = (NUM_FIELDS * self.embed_dim, 1024) if self.tpu \
+            else (NUM_FIELDS * self.embed_dim,)
+
+
+# Peak dense bf16 FLOP/s by device-string fragment (public spec sheets);
+# used only for the rough-MFU line in the decomposition block.
+_PEAK_BF16 = (("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+              ("v4", 275e12), ("v6", 918e12))
+
+
+def peak_flops_for(device: str) -> float | None:
+    dev = device.lower()
+    for frag, peak in _PEAK_BF16:
+        if frag in dev:
+            return peak
+    return None
+
+
+def flops_per_example(config) -> float:
+    """Dense-FLOPs estimate for one candidate through DCN-v2 (embedding
+    gather is bandwidth, not FLOPs; 2 FLOPs per MAC)."""
+    d = config.num_fields * config.embed_dim
+    cross = config.num_cross_layers * (2 * d * d + 3 * d)
+    dims = (d,) + tuple(config.mlp_dims)
+    mlp = sum(2 * a * b for a, b in zip(dims[:-1], dims[1:]))
+    out = 2 * (d + (config.mlp_dims[-1] if config.mlp_dims else 0))
+    return float(cross + mlp + out)
+
+
+def measure_rtt_floor() -> float | None:
+    """Round-trip floor of the host<->device link: tiny dispatch + fetch.
+    Diagnostic-only, so bounded and guarded: a relay flap here must not
+    burn the child watchdog (VERDICT r2 weak #5) — returns None on trouble."""
     import jax
     import numpy as np
 
-    x = jax.device_put(np.ones((8,), np.float32))
-    jax.block_until_ready(x)
-    f = jax.jit(lambda v: v * 2.0)
-    np.asarray(f(x))  # compile + settle
-    samples = []
-    for _ in range(5):
+    try:
+        x = jax.device_put(np.ones((8,), np.float32))
+        jax.block_until_ready(x)
+        f = jax.jit(lambda v: v * 2.0)
+        np.asarray(f(x))  # compile + settle
+        samples = []
+        deadline = time.perf_counter() + 20.0
+        for _ in range(5):
+            if time.perf_counter() > deadline:
+                break
+            t0 = time.perf_counter()
+            np.asarray(f(x))
+            samples.append((time.perf_counter() - t0) * 1e3)
+        return min(samples) if samples else None
+    except Exception as exc:  # noqa: BLE001 — diagnostic must not kill the run
+        log("rtt_floor", f"unavailable: {type(exc).__name__}: {exc}")
+        return None
+
+
+def device_loop_step_s(step_fn, carry, est_iters: int = 200, target_s: float = 0.12) -> float:
+    """Pure per-step device time: chain `step_fn` (carry -> carry) INSIDE
+    one jitted fori_loop so a single dispatch covers N sequential steps —
+    host dispatch rate cannot contaminate the measurement, and the fixed
+    cost (one tunnel round-trip per call) cancels in a two-N difference.
+    The loop bound is a traced argument, so every N shares one executable.
+
+    N is sized ADAPTIVELY: this rig's relay rtt jitters by +-1-3 ms, so the
+    long run's total body time must dwarf that (target_s) or the difference
+    is noise — fixed small N produced physically impossible readings (r3
+    run #3: 5 us for a 16-GFLOP cross stack). A coarse estimate pass picks
+    N; min-of-2 walls reject stragglers. Calibration: a chained bf16
+    4096x688x688 matmul measures 25.2 us/step = 78% MFU on the v5e."""
+    import jax
+
+    @jax.jit
+    def many(c, iters):
+        return jax.lax.fori_loop(0, iters, lambda i, x: step_fn(x), c)
+
+    def run(iters: int) -> float:
         t0 = time.perf_counter()
-        np.asarray(f(x))
-        samples.append((time.perf_counter() - t0) * 1e3)
-    return min(samples)
+        jax.block_until_ready(many(carry, iters))
+        return time.perf_counter() - t0
+
+    run(2)  # compile + settle
+    est = max((run(est_iters) - run(2)) / (est_iters - 2), 1e-8)
+    iters_long = int(min(50_000, max(4 * est_iters, target_s / est)))
+    iters_short = max(iters_long // 8, 2)
+    w_short = min(run(iters_short) for _ in range(2))
+    w_long = min(run(iters_long) for _ in range(2))
+    return max((w_long - w_short) / (iters_long - iters_short), 1e-9)
+
+
+def train_on_chip(scale: Scale, config):
+    """VERDICT r2 task 4: the served model is trained on this device first.
+    Returns (model, trained params, train block for the JSON line)."""
+    from distributed_tf_serving_tpu.models import build_model
+    from distributed_tf_serving_tpu.train.trainer import Trainer
+
+    model = build_model("dcn_v2", config)
+    t0 = time.perf_counter()
+    trainer = Trainer(model, learning_rate=1e-3, seed=0)
+    metrics = trainer.fit(scale.train_steps, batch_size=scale.train_batch)
+    auc_val = trainer.eval_auc(batches=4, batch_size=scale.train_batch)
+    block = {
+        "steps": scale.train_steps,
+        "batch_size": scale.train_batch,
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "step_wall_s": round(metrics["wall_s"], 1),
+        "examples_per_s": round(metrics["examples_per_s"], 0),
+        "loss": round(metrics["loss"], 4),
+        "auc": round(auc_val, 4),
+    }
+    return model, trainer.state.params, block
+
+
+def pallas_probe(scale: Scale, config, cross_params) -> tuple[dict, bool]:
+    """VERDICT r2 task 3: run the fused Pallas cross kernel on the REAL
+    device (interpret only on the CPU smoke), assert it matches the XLA
+    path, time both, and decide whether serving should use it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tf_serving_tpu.models.dcn import cross_apply
+    from distributed_tf_serving_tpu.ops.cross_kernel import (
+        cross_params_to_stacked,
+        fused_cross_apply,
+    )
+
+    interpret = not scale.tpu
+    cd = config.cdtype
+    block: dict = {"interpreted": interpret, "rows": scale.pallas_rows}
+    enable = False
+    for d in scale.pallas_widths:
+        entry: dict = {}
+        try:
+            if d == config.num_fields * config.embed_dim:
+                w, b = cross_params_to_stacked(cross_params)
+                layers = cross_params
+            else:  # aligned-width synthetic point (128-lane multiple)
+                keys = jax.random.split(jax.random.PRNGKey(1), 2)
+                L = config.num_cross_layers
+                w = jax.random.normal(keys[0], (L, d, d), jnp.float32) / d**0.5
+                b = jnp.zeros((L, d), jnp.float32)
+                layers = [{"w": w[i], "b": b[i]} for i in range(L)]
+            x0 = jax.random.normal(
+                jax.random.PRNGKey(2), (scale.pallas_rows, d), jnp.float32
+            ).astype(cd)
+
+            fused = jax.jit(
+                lambda x: fused_cross_apply(x, w, b, compute_dtype=cd, interpret=interpret)
+            )
+            ref = jax.jit(lambda x: cross_apply(layers, x, cd))
+            got = np.asarray(fused(x0), np.float32)
+            want = np.asarray(ref(x0), np.float32)
+            denom = max(float(np.max(np.abs(want))), 1.0)
+            entry["max_rel_err"] = round(float(np.max(np.abs(got - want))) / denom, 6)
+            # Both apply x -> x of the same shape/dtype, so they chain on
+            # device directly (values may saturate over the loop; TPU
+            # arithmetic speed is value-independent). Interpret mode
+            # (CPU smoke) gets tiny loops: it is orders slower.
+            est, tgt = (200, 0.12) if scale.tpu else (4, 0.005)
+            entry["pallas_us"] = round(device_loop_step_s(fused, x0, est, tgt) * 1e6, 1)
+            entry["xla_us"] = round(device_loop_step_s(ref, x0, est, tgt) * 1e6, 1)
+            entry["speedup"] = round(entry["xla_us"] / entry["pallas_us"], 2)
+            if d == config.num_fields * config.embed_dim:
+                # Serve with the kernel only when it wins at the flagship
+                # width AND matches numerically (never on the CPU smoke:
+                # interpret mode proves lowering of nothing).
+                enable = (
+                    scale.tpu
+                    and entry["speedup"] > 1.0
+                    and entry["max_rel_err"] < 1e-2
+                )
+        except Exception as exc:  # noqa: BLE001 — record, keep benching on XLA
+            entry["error"] = f"{type(exc).__name__}: {exc}"[:500]
+        block[f"d{d}"] = entry
+    block["enabled_for_serving"] = enable
+    return block, enable
+
+
+def device_decomposition(batcher, servable, scale: Scale, rtt_floor_ms, device: str) -> dict:
+    """VERDICT r2 task 2: the denominator every tuning argument needs —
+    pure device step time per bucket (through the SAME jitted entry the
+    batcher serves with, so pack/unpack compression is included), implied
+    device-limited QPS, transfer bytes, rough MFU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tf_serving_tpu.ops.transfer import pack_host
+    from distributed_tf_serving_tpu.serving.batcher import prepare_inputs
+
+    fn, spec = batcher.jit_entry(servable)
+    steps: dict[str, float] = {}
+    bytes_per_batch: dict[str, int] = {}
+    best_qps = 0.0
+    for bucket in scale.timed_buckets:
+        arrays = batcher.warmup_arrays(servable, bucket)
+        rng = np.random.RandomState(3)
+        arrays["feat_ids"] = rng.randint(  # realistic gather addresses
+            0, 1 << 40, size=arrays["feat_ids"].shape
+        ).astype(np.int64)
+        packed = prepare_inputs(servable.model, arrays)
+        if spec:
+            packed = pack_host(packed, spec)
+        dev = {k: jax.device_put(v) for k, v in packed.items()}
+        jax.block_until_ready(dev)
+
+        # Chain batches on device: each iteration's feat_wts is nudged by a
+        # value-dependent epsilon so the loop body has a true sequential
+        # data dependence (XLA cannot hoist the forward out of the loop);
+        # *0 would constant-fold, min()*1e-30 cannot.
+        carry_key = next(
+            (k for k, v in dev.items() if jnp.issubdtype(v.dtype, jnp.floating)),
+            None,
+        )
+
+        def step(batch):
+            out = fn(servable.params, batch)
+            score = next(iter(out.values()))
+            eps = jnp.min(score) * 1e-30
+            return {
+                k: (v + eps.astype(v.dtype) if k == carry_key else v)
+                for k, v in batch.items()
+            }
+
+        est, tgt = (100, 0.12) if scale.tpu else (6, 0.01)
+        step_s = device_loop_step_s(step, dev, est, tgt)
+        steps[str(bucket)] = round(step_s * 1e6, 1)
+        bytes_per_batch[str(bucket)] = sum(v.nbytes for v in packed.values())
+        best_qps = max(best_qps, (bucket / CANDIDATES) / step_s)
+    block = {
+        "device_step_us": steps,
+        "transfer_bytes_per_batch": bytes_per_batch,
+        "device_limited_qps": round(best_qps, 1),
+        "rtt_floor_ms": None if rtt_floor_ms is None else round(rtt_floor_ms, 2),
+    }
+    peak = peak_flops_for(device)
+    if peak and steps:
+        top = max(scale.timed_buckets)
+        flops = flops_per_example(servable.model.config) * top
+        block["mfu"] = round(flops / (steps[str(top)] / 1e6) / peak, 4)
+        block["assumed_peak_flops"] = peak
+    return block
+
+
+async def overload_probe(client_cls, port: str, batcher, scale: Scale, payload) -> dict:
+    """VERDICT r2 task 8: drive past queue capacity on the real stack and
+    record shedding. Capacity is squeezed for the probe, then restored."""
+    from distributed_tf_serving_tpu.client import PredictClientError
+
+    old_capacity = batcher.queue_capacity_candidates
+    batcher.queue_capacity_candidates = max(2 * batcher.buckets[-1], CANDIDATES)
+    counts = {"sent": 0, "ok": 0, "shed": 0, "unavailable": 0, "other": 0}
+    try:
+        async with client_cls([f"127.0.0.1:{port}"], "DCN", channels_per_host=6) as client:
+            import asyncio
+
+            async def one():
+                counts["sent"] += 1
+                try:
+                    await client.predict(payload)
+                    counts["ok"] += 1
+                except PredictClientError as e:
+                    code = getattr(e.code, "name", str(e.code))
+                    if code == "RESOURCE_EXHAUSTED":
+                        counts["shed"] += 1
+                    elif code == "UNAVAILABLE":
+                        counts["unavailable"] += 1
+                    else:
+                        counts["other"] += 1
+
+            for _ in range(3):  # three waves so shedding, not warm caches, decides
+                await asyncio.gather(*(one() for _ in range(scale.overload_tasks)))
+    finally:
+        batcher.queue_capacity_candidates = old_capacity
+    counts["shed_rate"] = round(counts["shed"] / max(counts["sent"], 1), 3)
+    counts["queue_capacity_candidates"] = 2 * batcher.buckets[-1]
+    return counts
 
 
 def child_main() -> None:
     import asyncio
+    import dataclasses
 
     stage = "jax_init"
     try:
         log(stage, "importing jax + framework")
         import jax
+        import numpy as np
 
         if os.environ.get("JAX_PLATFORMS") == "cpu":
             jax.config.update("jax_platforms", "cpu")
@@ -196,94 +484,164 @@ def child_main() -> None:
             make_payload,
             run_closed_loop,
         )
-        from distributed_tf_serving_tpu.models import ServableRegistry
+        from distributed_tf_serving_tpu.models import (
+            ModelConfig,
+            Servable,
+            ServableRegistry,
+            ctr_signatures,
+        )
         from distributed_tf_serving_tpu.serving import DynamicBatcher, PredictionServiceImpl
-        from distributed_tf_serving_tpu.serving.server import create_server, load_demo_servable
+        from distributed_tf_serving_tpu.serving.server import create_server
         from distributed_tf_serving_tpu.utils.tracing import request_trace
 
         device = str(jax.devices()[0])
-        log(stage, f"device={device}")
+        platform = jax.devices()[0].platform
+        scale = Scale(platform)
+        log(stage, f"device={device} platform={platform} tpu_scale={scale.tpu}")
 
         stage = "rtt_floor"
         rtt_floor_ms = measure_rtt_floor()
-        log(stage, f"rtt_floor={rtt_floor_ms:.2f}ms")
+        log(stage, f"rtt_floor={rtt_floor_ms and round(rtt_floor_ms, 2)}ms")
+
+        stage = "train"
+        config = ModelConfig(
+            name="DCN",
+            num_fields=NUM_FIELDS,
+            vocab_size=scale.vocab_size,
+            embed_dim=scale.embed_dim,
+            mlp_dims=scale.mlp_dims,
+            num_cross_layers=3,
+            cross_full_matrix=True,
+        )
+        log(stage, f"{scale.train_steps} steps x {scale.train_batch} on-device")
+        model, params, train_block = train_on_chip(scale, config)
+        log(stage, f"loss={train_block['loss']} auc={train_block['auc']} "
+                   f"({train_block['examples_per_s']:.0f} ex/s)")
+
+        stage = "pallas"
+        pallas_block, use_pallas = pallas_probe(scale, config, params["cross"])
+        log(stage, json.dumps(pallas_block))
+        if use_pallas:
+            # Same trained params; the serving apply path switches to the
+            # fused kernel (models/dcn.py gates on config.use_pallas_cross).
+            from distributed_tf_serving_tpu.models import build_model
+
+            config = dataclasses.replace(config, use_pallas_cross=True)
+            model = build_model("dcn_v2", config)
+            log(stage, "fused cross kernel ENABLED for serving")
 
         stage = "model_build"
         registry = ServableRegistry()
         batcher = DynamicBatcher(
-            buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096, 8192),
+            buckets=scale.buckets,
             max_wait_us=2000,
-            completion_workers=8,
+            completion_workers=12,
         ).start()
         impl = PredictionServiceImpl(registry, batcher)
-        servable = load_demo_servable(
-            registry,
-            kind="dcn_v2",
-            name="DCN",
-            num_fields=NUM_FIELDS,
-            vocab_size=1 << 20,
-            embed_dim=16,
-            mlp_dims=(256, 128, 64),
-            num_cross_layers=3,
+        servable = Servable(
+            name="DCN", version=1, model=model, params=params,
+            signatures=ctr_signatures(config.num_fields),
         )
+        registry.load(servable)
 
         stage = "warmup_compile"
-        for b in (1024, 2048, 4096, 8192):
+        for b in scale.timed_buckets:
             t0 = time.perf_counter()
             batcher.warmup(servable, buckets=(b,))
             log(stage, f"bucket={b} compiled in {time.perf_counter() - t0:.1f}s")
 
+        stage = "device_decomposition"
+        device_block = device_decomposition(batcher, servable, scale, rtt_floor_ms, device)
+        log(stage, json.dumps(device_block))
+
         stage = "server_start"
-        server, port = create_server(impl, "127.0.0.1:0", max_workers=CONCURRENCY + 8)
+        # Handler threads block on batcher futures, so the pool must cover
+        # the full client concurrency: fewer threads than clients caps the
+        # batcher's queue depth and starves coalescing (r3 run #3: 24
+        # workers for 64 clients cost 30% QPS).
+        server, port = create_server(impl, "127.0.0.1:0", max_workers=scale.concurrency + 8)
         server.start()
         payload = make_payload(candidates=CANDIDATES, num_fields=NUM_FIELDS)
         request_trace.reset()  # warmup compiles out of the phase means
 
-        stage = "load_loop"
-        log(stage, f"concurrency={CONCURRENCY} x {REQUESTS_PER_WORKER} requests")
-
-        # In-process asyncio load loop: this rig is a single CPU core
+        # In-process asyncio load loops: this rig is a single CPU core
         # (nproc=1), so the one-event-loop client beats multiprocess
         # generators (run_closed_loop_mp is for multi-core hosts).
-        async def go():
+        async def loop(pool=None, rpw=scale.requests_per_worker):
             async with ShardedPredictClient(
                 [f"127.0.0.1:{port}"], "DCN", channels_per_host=6
             ) as client:
                 return await run_closed_loop(
                     client,
                     payload,
-                    concurrency=CONCURRENCY,
-                    requests_per_worker=REQUESTS_PER_WORKER,
+                    concurrency=scale.concurrency,
+                    requests_per_worker=rpw,
                     sort_scores=True,
                     warmup_requests=5,
+                    payload_pool=pool,
                 )
 
-        report = asyncio.run(go())
+        stage = "load_loop_repeated"
+        log(stage, f"concurrency={scale.concurrency} x {scale.requests_per_worker}")
+        report = asyncio.run(loop())
+        s = report.summary()
+        stats_rep = dataclasses.replace(batcher.stats)  # snapshot
+        phases = {
+            name: snap["mean_us"] for name, snap in request_trace.snapshot().items()
+        }
+        request_trace.reset()  # per-loop phases: unique traffic differs
+
+        stage = "load_loop_unique"
+        log(stage, f"pool={scale.unique_pool} x {scale.unique_requests_per_worker}/worker")
+        pool = [
+            make_payload(candidates=CANDIDATES, num_fields=NUM_FIELDS, seed=100 + i)
+            for i in range(scale.unique_pool)
+        ]
+        report_u = asyncio.run(loop(pool=pool, rpw=scale.unique_requests_per_worker))
+        s_u = report_u.summary()
+        phases_unique = {
+            name: snap["mean_us"] for name, snap in request_trace.snapshot().items()
+        }
+
+        stage = "overload"
+        overload_block = asyncio.run(
+            overload_probe(ShardedPredictClient, port, batcher, scale, payload)
+        )
+        log(stage, json.dumps(overload_block))
+
         server.stop(0)
         batcher.stop()
 
         stage = "report"
-        s = report.summary()
         bs = batcher.stats
-        phases = {
-            name: snap["mean_us"]
-            for name, snap in request_trace.snapshot().items()
-        }
+        qps = s["qps"]
+        dev_qps = device_block.get("device_limited_qps") or 0.0
         line = {
             "metric": "ctr_qps_per_chip_1k",
-            "value": round(s["qps"], 1),
+            "value": round(qps, 1),
             "unit": "qps",
-            "vs_baseline": round(s["qps"] / TARGET_QPS, 3),
+            "vs_baseline": round(qps / TARGET_QPS, 3),
             "p50_ms": round(s["p50_ms"], 3),
             "p99_ms": round(s["p99_ms"], 3),
             "mean_ms": round(s["mean_ms"], 3),
             "candidates_per_s": round(s["candidates_per_s"], 0),
             "requests": s["requests"],
-            "concurrency": CONCURRENCY,
-            "batch_occupancy": round(bs.mean_occupancy, 3),
-            "requests_per_batch": round(bs.mean_requests_per_batch, 2),
-            "rtt_floor_ms": round(rtt_floor_ms, 2),
+            "wall_s": round(s["wall_s"], 1),
+            "concurrency": scale.concurrency,
+            "qps_repeated": round(qps, 1),
+            "qps_unique": round(s_u["qps"], 1),
+            "p50_ms_unique": round(s_u["p50_ms"], 3),
+            "batch_occupancy": round(stats_rep.mean_occupancy, 3),
+            "requests_per_batch": round(stats_rep.mean_requests_per_batch, 2),
+            "fill_waits": bs.fill_waits,
+            "achieved_fraction_of_device_limit": round(qps / dev_qps, 3) if dev_qps else None,
+            "rtt_floor_ms": None if rtt_floor_ms is None else round(rtt_floor_ms, 2),
+            "train": train_block,
+            "pallas": pallas_block,
+            "device_decomposition": device_block,
+            "overload": overload_block,
             "phases_us": phases,
+            "phases_us_unique": phases_unique,
             "device": device,
         }
         print(json.dumps(line), flush=True)

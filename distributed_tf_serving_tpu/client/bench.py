@@ -78,21 +78,32 @@ async def run_closed_loop(
     requests_per_worker: int = 1000,
     sort_scores: bool = True,
     warmup_requests: int = 3,
+    payload_pool: list[dict[str, np.ndarray]] | None = None,
 ) -> BenchReport:
+    """payload_pool, when given, varies the request bytes: worker w's i-th
+    request sends pool[(w + i*concurrency) % len(pool)] so concurrent
+    requests differ AND batch compositions churn — the anti-flattering mode
+    for content-addressed caches (the reference's own methodology re-sends
+    ONE payload, DCNClient.java:208-210; both numbers are reported)."""
     for _ in range(warmup_requests):
         await client.predict(payload, sort_scores=sort_scores)
 
     latencies: list[float] = []
 
-    async def worker():
-        for _ in range(requests_per_worker):
+    async def worker(w: int):
+        for i in range(requests_per_worker):
+            p = (
+                payload_pool[(w + i * concurrency) % len(payload_pool)]
+                if payload_pool
+                else payload
+            )
             t0 = time.perf_counter()
-            scores = await client.predict(payload, sort_scores=sort_scores)
+            scores = await client.predict(p, sort_scores=sort_scores)
             latencies.append((time.perf_counter() - t0) * 1e3)
-            assert scores.shape[0] == payload["feat_ids"].shape[0]
+            assert scores.shape[0] == p["feat_ids"].shape[0]
 
     t0 = time.perf_counter()
-    await asyncio.gather(*(worker() for _ in range(concurrency)))
+    await asyncio.gather(*(worker(w) for w in range(concurrency)))
     wall = time.perf_counter() - t0
     return BenchReport(
         latencies_ms=np.asarray(latencies),

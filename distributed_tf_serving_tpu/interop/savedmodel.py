@@ -351,6 +351,140 @@ def map_variables(
     return _unflatten_like(target_params, flat_out)
 
 
+def infer_generic_architecture(
+    variables: dict[str, np.ndarray],
+    signatures: dict | None,
+    config: ModelConfig,
+) -> tuple[ModelConfig, dict[str, str]]:
+    """Classify a non-zoo export as "embedding bag -> dense chain -> logit"
+    and derive the generic family's config + an EXPLICIT variable mapping
+    from the export's own shapes (VERDICT r2 item 7: the best-effort
+    fallback at the import boundary). Raises SavedModelImportError with the
+    structural reason when the export is not that shape — the caller folds
+    it into the actionable rejection.
+
+    Inference rules:
+    - the embedding table is the 2-D variable classified `embedding` by
+      name (falling back to the largest-rows 2-D variable); its shape gives
+      (vocab_size, embed_dim);
+    - num_fields comes from the serving_default `feat_ids` spec when the
+      export declares it, else the caller's config;
+    - the dense chain is recovered by shape-chaining: kernels must form one
+      sequence in_0=F*D -> ... -> out_n=1 using EVERY non-embedding 2-D
+      variable exactly once (depth-first over same-in-dim alternatives), so
+      no weight is silently dropped; each kernel's bias binds by sibling
+      name (kernel->bias) or uniquely by shape.
+    """
+    variables = {
+        _clean_name(k): np.asarray(v)
+        for k, v in variables.items()
+        if not _is_bookkeeping(_clean_name(k))
+    }
+
+    num_fields = config.num_fields
+    sig = (signatures or {}).get("serving_default")
+    if sig is not None:
+        for spec in sig.inputs:
+            if spec.name == "feat_ids" and spec.shape and len(spec.shape) == 2:
+                if spec.shape[1]:
+                    num_fields = int(spec.shape[1])
+
+    two_d = {k: v for k, v in variables.items() if v.ndim == 2}
+    one_d = {k: v for k, v in variables.items() if v.ndim == 1}
+    other = {k: v for k, v in variables.items() if v.ndim not in (1, 2)}
+    if other:
+        raise SavedModelImportError(
+            f"generic fallback handles only matrix/vector variables; found "
+            f"{ {k: v.shape for k, v in other.items()} }"
+        )
+    if not two_d:
+        raise SavedModelImportError("generic fallback found no 2-D variables at all")
+
+    emb_named = [k for k in two_d if _role(k, _VAR_ROLE_PATTERNS) == "embedding"]
+    if len(emb_named) == 1:
+        emb_name = emb_named[0]
+    elif len(emb_named) > 1:
+        raise SavedModelImportError(
+            f"generic fallback found several embedding-like tables "
+            f"{sorted(emb_named)}; cannot pick one"
+        )
+    else:
+        emb_name = max(two_d, key=lambda k: two_d[k].shape[0])
+    vocab_size, embed_dim = map(int, two_d[emb_name].shape)
+    d0 = num_fields * embed_dim
+    kernels = {k: v for k, v in two_d.items() if k != emb_name}
+
+    # Depth-first shape-chaining: one ordering that consumes every kernel.
+    def chain(cur_dim: int, remaining: frozenset) -> list[str] | None:
+        if not remaining:
+            return []
+        for k in sorted(remaining, key=_natural_key):
+            rows, cols = kernels[k].shape
+            if rows != cur_dim:
+                continue
+            if not remaining - {k} and cols != 1:
+                continue  # the last kernel must emit the logit
+            rest = chain(cols, remaining - {k})
+            if rest is not None:
+                return [k] + rest
+        return None
+
+    order = chain(d0, frozenset(kernels))
+    if order is None:
+        raise SavedModelImportError(
+            f"dense kernels { {k: v.shape for k, v in kernels.items()} } do not "
+            f"chain from F*D={d0} (num_fields={num_fields} x embed_dim="
+            f"{embed_dim}) down to a 1-wide logit using every kernel"
+        )
+
+    def bias_for(kernel_name: str, width: int, used: set) -> str:
+        sibling = re.sub(r"kernel|weights?$", "bias", kernel_name)
+        if sibling != kernel_name and sibling in one_d and sibling not in used:
+            return sibling
+        by_shape = [
+            k for k, v in one_d.items() if v.shape == (width,) and k not in used
+        ]
+        if len(by_shape) == 1:
+            return by_shape[0]
+        raise SavedModelImportError(
+            f"no unambiguous bias of width {width} for kernel {kernel_name!r}; "
+            f"candidates: {by_shape}"
+        )
+
+    mapping: dict[str, str] = {"embedding": emb_name}
+    used_biases: set[str] = set()
+    mlp_dims = []
+    for i, k in enumerate(order):
+        width = int(kernels[k].shape[1])
+        b = bias_for(k, width, used_biases)
+        used_biases.add(b)
+        if i < len(order) - 1:
+            mapping[f"mlp/{i}/w"] = k
+            mapping[f"mlp/{i}/b"] = b
+            mlp_dims.append(width)
+        else:
+            mapping["out/w"] = k
+            mapping["out/b"] = b
+    unused = set(one_d) - used_biases
+    if unused:
+        raise SavedModelImportError(
+            f"generic fallback would leave vector variables unbound: "
+            f"{ {k: one_d[k].shape for k in sorted(unused)} } (batch-norm "
+            "stats or non-bias vectors are outside the embed+MLP shape)"
+        )
+
+    import dataclasses as dc
+
+    generic_config = dc.replace(
+        config,
+        num_fields=num_fields,
+        vocab_size=vocab_size,
+        embed_dim=embed_dim,
+        mlp_dims=tuple(mlp_dims),
+    )
+    return generic_config, mapping
+
+
 def _check_signature_aliases(signatures, kind: str, config: ModelConfig) -> None:
     """The imported signature is the client-facing contract, but the zoo
     forward consumes fixed keys; an alias mismatch would import cleanly and
@@ -445,6 +579,7 @@ def import_savedmodel(
     mapping: dict[str, str] | None = None,
     variables_npz=None,
     python: str = sys.executable,
+    fallback: bool = True,
 ) -> Servable:
     """SavedModel directory -> registry-ready Servable.
 
@@ -452,6 +587,15 @@ def import_savedmodel(
     graph itself is not replayed — the zoo's jitted forward IS the TPU
     program; SURVEY.md §7 design stance). `variables_npz` reuses an
     already-extracted dump and skips the TF subprocess.
+
+    The import boundary (VERDICT r2 item 7): when the export's weights do
+    not bind to the requested family and `fallback` is on, the importer
+    tries the `generic` embed+MLP family with the architecture inferred
+    from the export's own shapes; when that fails too, the error names the
+    supported families and both failure reasons — an actionable rejection,
+    not silence. Exports beyond "weights onto a native forward" (custom
+    GraphDef ops) are out of scope by design; the reference delegated that
+    to tensorflow_model_server's graph executor (meta_graph.proto:31-87).
     """
     import jax
 
@@ -479,7 +623,37 @@ def import_savedmodel(
 
     model = build_model(kind, config)
     template = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(0)))
-    params = map_variables(variables, template, mapping)
+    try:
+        params = map_variables(variables, template, mapping)
+    except SavedModelImportError as exc:
+        if not fallback or mapping or kind == "generic":
+            raise
+        try:
+            generic_config, generic_mapping = infer_generic_architecture(
+                variables, signatures, config
+            )
+            model = build_model("generic", generic_config)
+            template = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(0)))
+            params = map_variables(variables, template, generic_mapping)
+        except SavedModelImportError as exc2:
+            from ..models.base import model_kinds
+
+            raise SavedModelImportError(
+                f"export at {saved_model_dir} matches no native family.\n"
+                f"- as requested kind {kind!r}: {exc}\n"
+                f"- as the generic embed+MLP fallback: {exc2}\n"
+                f"Supported families: {sorted(model_kinds())}. Re-export in "
+                "one of these architectures, or pass an explicit "
+                "{param-path: variable-name} mapping; arbitrary GraphDef "
+                "execution is outside this framework's import boundary "
+                "(SURVEY.md §7)."
+            ) from exc
+        log.warning(
+            "export did not bind to %r (%s); serving via the generic "
+            "embed+MLP fallback: num_fields=%d embed_dim=%d mlp_dims=%s",
+            kind, exc, generic_config.num_fields, generic_config.embed_dim,
+            generic_config.mlp_dims,
+        )
     return Servable(
         name=name, version=version, model=model, params=params, signatures=signatures
     )

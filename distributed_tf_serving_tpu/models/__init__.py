@@ -20,7 +20,7 @@ from .registry import (
 )
 
 # Import model modules for their registration side effects.
-from . import dcn, deepfm, dlrm, two_tower, wide_deep  # noqa: E402,F401
+from . import dcn, deepfm, dlrm, generic, two_tower, wide_deep  # noqa: E402,F401
 
 __all__ = [
     "Batch",

@@ -12,8 +12,13 @@ TPU-first design:
 - **Request coalescing.** Concurrent small requests targeting the same
   (servable, signature) are concatenated along the candidate axis into one
   device call, then split back — amortizing dispatch overhead exactly like
-  TF-Serving's BatchingSession. A request never waits more than
-  `max_wait_us`; the first item in a batch pays at most that.
+  TF-Serving's BatchingSession. At low load a request waits at most
+  `max_wait_us` before dispatch; under sustained load the window is
+  *pipeline-aware*: while >= `pipeline_depth` batches are already in
+  flight, dispatching another partial batch would only queue behind device
+  work, so the batcher keeps filling past the deadline for free — latency
+  is unchanged (the dispatch would have waited anyway) and occupancy rises
+  toward full buckets.
 - **Host-side id folding.** Wire ids are int64 (DCNClient.java:98-102) but
   jax runs x64-disabled; ids are folded into the vocab with int64 numpy on
   the host (exact `mod`, not truncation) before device transfer, which also
@@ -83,22 +88,43 @@ def fold_ids_host(ids: np.ndarray, vocab_size: int) -> np.ndarray:
     return np.remainder(ids, np.int64(vocab_size)).astype(np.int32)
 
 
+def _immutably_backed(arr: np.ndarray) -> bool:
+    """True only when the array's ULTIMATE buffer is a `bytes` object —
+    the one backing genuinely immutable to every party (the serving path's
+    np.frombuffer(proto.tensor_content) views). writeable=False alone is
+    NOT enough: a frozen view over a writable base (broadcast_to,
+    setflags(write=False)) can still see its bytes change under it, and
+    even a read-only memoryview does not freeze its underlying bytearray/
+    mmap — its owner can keep writing through the original object."""
+    a = arr
+    while isinstance(a.base, np.ndarray):
+        a = a.base
+    b = a.base
+    if isinstance(b, memoryview):
+        b = b.obj
+    return isinstance(b, bytes)
+
+
 def prepare_inputs(model: Model, arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
     """Host-side normalization before padding/transfer.
 
-    Every output array is OWNED (never aliases the caller's buffer): submit()
-    returns before the batch is padded/uploaded, so an aliased input would
-    let a caller mutating its array after submit() race the async device
+    Every output array is OWNED or IMMUTABLE (never writable-aliased to the
+    caller): submit() returns before the batch is padded/uploaded, so a
+    caller mutating its array after submit() would race the async device
     transfer — and poison the content-addressed DeviceInputCache digest
     (round-1 advisor finding). fold/astype copy as a side effect; the
-    passthrough branch copies explicitly (~20 us per 1k x 43 float32 batch,
-    noise next to decode)."""
+    passthrough branch skips the copy only for arrays whose backing buffer
+    is itself immutable — the serving hot path's arrays are np.frombuffer
+    views over protobuf bytes, which NOBODY can mutate (~50 us per 1k x 43
+    request back on the 1-core host); anything else is copied."""
     out = {}
     for key, arr in arrays.items():
         if key == "feat_ids":
             out[key] = fold_ids_host(arr, model.config.vocab_size)
         elif arr.dtype == np.float64:
             out[key] = arr.astype(np.float32)
+        elif _immutably_backed(arr):
+            out[key] = arr
         else:
             out[key] = arr.copy()
     return out
@@ -156,17 +182,37 @@ class DeviceInputCache:
             ).digest()
         return (name, arr.shape, arr.dtype.str, digest)
 
-    def get_or_put(self, name: str, arr: np.ndarray) -> jax.Array | np.ndarray:
+    def get_or_put(
+        self,
+        name: str,
+        arr: np.ndarray,
+        pack: Callable[[np.ndarray], np.ndarray] | None = None,
+        pack_tag: str = "",
+    ) -> jax.Array | np.ndarray:
+        """Device array for `arr`'s content, uploading (after `pack`, when
+        given) only on miss. The digest keys on the PRE-pack bytes so a hit
+        skips the transfer-compression work too — under repeated traffic
+        pack_host was the batcher thread's single largest CPU cost, charged
+        even when the upload itself was skipped (round-3 profiling). `pack`
+        must be pure (same bytes in => same bytes out) and `pack_tag` must
+        identify the transform: the stored value is POST-pack, so the same
+        raw bytes packed differently (one servable u24-packs ids, another
+        does not) must occupy distinct entries or a hit would hand one
+        servable the other's packed layout."""
         if self.bypassed:
-            return arr  # plain path: jit moves it, no digest charged
-        key = self._key(name, arr)
+            return pack(arr) if pack is not None else arr  # plain jit path
+        key = (pack_tag, *self._key(name, arr))
         with self._lock:
             cached = self._lru.get(key)
             if cached is not None:
                 self._lru.move_to_end(key)
                 self.hits += 1
-                self.bytes_skipped += arr.nbytes
+                # The avoided upload is the PACKED size (the cached device
+                # array), not the raw digest input.
+                self.bytes_skipped += cached.nbytes
                 return cached
+        if pack is not None:
+            arr = pack(arr)
         device_arr = jax.device_put(arr)  # async; the executable waits, not us
         with self._lock:
             self._lru[key] = device_arr
@@ -208,6 +254,9 @@ class BatcherStats:
     candidates: int = 0
     padded_candidates: int = 0
     max_queue_depth: int = 0
+    # Times coalescing waited past max_wait because the dispatch pipeline
+    # was saturated (the wait was latency-free; see _coalesce_next).
+    fill_waits: int = 0
 
     @property
     def mean_occupancy(self) -> float:
@@ -237,6 +286,7 @@ class DynamicBatcher:
         input_cache_entries: int = 64,
         queue_capacity_candidates: int | None = None,
         breaker_timeout_s: float | None = 90.0,
+        pipeline_depth: int = 2,
     ):
         self.compress_transfer = compress_transfer
         # Content-addressed device-resident inputs (only meaningful for the
@@ -270,6 +320,12 @@ class DynamicBatcher:
         # steady-state batch but below the 120s RPC deadline; first compiles
         # belong in warmup(), not live traffic.
         self.breaker_timeout_s = breaker_timeout_s
+        # Coalescing keeps filling past max_wait while this many batches are
+        # in flight: one executing on device plus one queued behind it means
+        # an extra dispatch cannot start sooner anyway, so waiting is free.
+        # Depth 1 would serialize dispatch against readback (killing the
+        # pipeline at low load); below 2 is therefore clamped.
+        self.pipeline_depth = max(pipeline_depth, 2)
         self._items: "deque[_WorkItem]" = deque()
         self._cv = threading.Condition()
         self._queued_candidates = 0
@@ -440,6 +496,13 @@ class DynamicBatcher:
         for fut in futures:
             fut.result(timeout=600)
 
+    def jit_entry(self, servable: Servable) -> tuple[Callable, dict[str, str]]:
+        """The (jitted fn, transfer spec) this batcher serves `servable`
+        with — public so measurement harnesses (bench.py's device-limited
+        decomposition) can time the EXACT serving executable, warm caches
+        included, instead of compiling a lookalike."""
+        return self._jit_for(servable)
+
     # ------------------------------------------------------------- internals
 
     def _jit_for(self, servable: Servable) -> tuple[Callable, dict[str, str]]:
@@ -461,9 +524,19 @@ class DynamicBatcher:
         if self._run_fn is not None:
             return self._run_fn(servable, arrays)
         fn, spec = self._jit_for(servable)
-        packed = pack_host(arrays, spec) if spec else arrays
         if self.input_cache is not None:
-            packed = {k: self.input_cache.get_or_put(k, v) for k, v in packed.items()}
+            # Digest BEFORE packing: a content hit skips both the upload
+            # and the pack (u24/bf16) work.
+            inputs = {
+                k: self.input_cache.get_or_put(
+                    k, v,
+                    pack=(lambda a, _k=k: pack_host({_k: a}, spec)[_k]) if spec else None,
+                    pack_tag=spec.get(k, "") if spec else "",
+                )
+                for k, v in arrays.items()
+            }
+            return fn(servable.params, inputs)
+        packed = pack_host(arrays, spec) if spec else arrays
         return fn(servable.params, packed)
 
     def _take(self) -> _WorkItem | None:
@@ -482,16 +555,37 @@ class DynamicBatcher:
                 self._cv.wait()
 
     def _coalesce_next(self, item: _WorkItem, total: int, deadline: float) -> _WorkItem | None:
-        """Next same-target item within the deadline, or None. The head item
-        stays put when it doesn't match — deque order is preserved (the old
-        SimpleQueue requeue pushed it to the BACK, reordering traffic)."""
+        """Next same-target item within the (pipeline-extended) window, or
+        None. The head item stays put when it doesn't match — deque order is
+        preserved (the old SimpleQueue requeue pushed it to the BACK,
+        reordering traffic).
+
+        Past `deadline` the wait continues only while the dispatch pipeline
+        is saturated (>= pipeline_depth batches in flight and none wedged):
+        the next dispatch would queue behind device work regardless, so the
+        extra fill time costs no latency. Completion of any in-flight batch
+        notifies this wait, ending the free-ride the moment dispatch could
+        actually start."""
+        free_ride_counted = False
         with self._cv:
             while True:
                 while not self._items:
-                    timeout = deadline - time.perf_counter()
-                    if timeout <= 0 or self._stopping:
+                    now = time.perf_counter()
+                    if self._stopping:
                         return None
-                    self._cv.wait(timeout)
+                    if now < deadline:
+                        self._cv.wait(deadline - now)
+                        continue
+                    if len(self._inflight) < self.pipeline_depth or self._wedged_for(now):
+                        return None
+                    # Free-riding the busy pipeline; a completion notifies.
+                    # Bounded wait: the wedge clock advances with wall time
+                    # alone, so never sleep unboundedly on the condition.
+                    # Counted once per episode, not per poll iteration.
+                    if not free_ride_counted:
+                        self.stats.fill_waits += 1
+                        free_ride_counted = True
+                    self._cv.wait(0.005)
                 nxt = self._items[0]
                 if nxt.future.cancelled():
                     self._items.popleft()
@@ -588,6 +682,13 @@ class DynamicBatcher:
                 batch_id = self._inflight_seq
                 if not all(it.warmup for it in group):
                     self._inflight[batch_id] = time.perf_counter()
+                # Wedge accounting moves from "dispatching" to "in flight"
+                # atomically. Clearing only in the finally below would leave
+                # a window where the completer has already resolved this
+                # batch's futures while _dispatching_since still shows the
+                # dispatch start — a submit racing that window would read a
+                # long-finished dispatch as a wedged device.
+                self._dispatching_since = None
             self._completers.submit(self._complete, batch_id, group, fetch)
         except Exception as exc:  # propagate to every waiter, keep serving
             for it in group:
@@ -619,6 +720,9 @@ class DynamicBatcher:
                     it.future.set_exception(exc)
         finally:
             # The breaker closes itself here: once the stuck (or healthy)
-            # readback finishes, the wedge condition clears with it.
+            # readback finishes, the wedge condition clears with it — and
+            # any coalescer free-riding the busy pipeline is woken, since
+            # dispatch capacity just opened up.
             with self._cv:
                 self._inflight.pop(batch_id, None)
+                self._cv.notify_all()
