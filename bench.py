@@ -174,7 +174,11 @@ class Scale:
 
     def __init__(self, platform: str):
         self.tpu = platform != "cpu"
-        self.concurrency = 64 if self.tpu else 8
+        # Env override for load-shape experiments (default is the shipped
+        # operating point).
+        self.concurrency = int(
+            os.environ.get("DTS_BENCH_CONCURRENCY", 64 if self.tpu else 8)
+        )
         self.requests_per_worker = 250 if self.tpu else 4  # 16k sustained on TPU
         self.unique_requests_per_worker = 60 if self.tpu else 3
         self.unique_pool = 128 if self.tpu else 8

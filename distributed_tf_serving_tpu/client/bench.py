@@ -81,10 +81,14 @@ async def run_closed_loop(
     payload_pool: list[dict[str, np.ndarray]] | None = None,
 ) -> BenchReport:
     """payload_pool, when given, varies the request bytes: worker w's i-th
-    request sends pool[(w + i*concurrency) % len(pool)] so concurrent
-    requests differ AND batch compositions churn — the anti-flattering mode
-    for content-addressed caches (the reference's own methodology re-sends
-    ONE payload, DCNClient.java:208-210; both numbers are reported)."""
+    request sends pool[(w + i*STRIDE) % len(pool)] with STRIDE=73 (odd, so
+    coprime to power-of-two pools): every worker cycles the FULL pool,
+    concurrent workers hold distinct payloads, and batch compositions churn
+    — the anti-flattering mode for content-addressed caches (the
+    reference's own methodology re-sends ONE payload,
+    DCNClient.java:208-210; both numbers are reported). A stride of
+    `concurrency` would degenerate to period len(pool)/gcd and re-send a
+    couple of payloads per worker."""
     for _ in range(warmup_requests):
         await client.predict(payload, sort_scores=sort_scores)
 
@@ -93,7 +97,7 @@ async def run_closed_loop(
     async def worker(w: int):
         for i in range(requests_per_worker):
             p = (
-                payload_pool[(w + i * concurrency) % len(payload_pool)]
+                payload_pool[(w + i * 73) % len(payload_pool)]
                 if payload_pool
                 else payload
             )

@@ -166,13 +166,17 @@ def from_ndarray(
     *,
     dtype_enum: int | None = None,
     use_tensor_content: bool = True,
+    out: fw.TensorProto | None = None,
 ) -> fw.TensorProto:
     """Encode a numpy array as a TensorProto.
 
     use_tensor_content=True emits the raw-bytes fast path; False emits the
     per-dtype repeated fields (what grpc-java clients typically build).
     dtype_enum overrides the inferred DataType (needed for quantized dtypes,
-    which share numpy layouts with plain integers).
+    which share numpy layouts with plain integers). `out` fills an existing
+    (empty) message in place — e.g. a request's map entry — skipping the
+    CopyFrom of the encoded bytes (one fewer half-MB copy per request on
+    the serving hot path).
     """
     arr = np.asarray(arr)
     if not arr.flags.c_contiguous:
@@ -181,7 +185,9 @@ def from_ndarray(
         arr = np.ascontiguousarray(arr)
 
     if arr.dtype == object or arr.dtype.kind in ("S", "U"):
-        tp = fw.TensorProto(dtype=DataType.DT_STRING, tensor_shape=shape_to_proto(arr.shape))
+        tp = out if out is not None else fw.TensorProto()
+        tp.dtype = DataType.DT_STRING
+        tp.tensor_shape.CopyFrom(shape_to_proto(arr.shape))
         for v in arr.ravel():
             tp.string_val.append(v.encode() if isinstance(v, str) else bytes(v))
         return tp
@@ -191,7 +197,9 @@ def from_ndarray(
     if np_dtype != arr.dtype:
         raise CodecError(f"array dtype {arr.dtype} does not match {DataType.Name(dt)}")
 
-    tp = fw.TensorProto(dtype=dt, tensor_shape=shape_to_proto(arr.shape))
+    tp = out if out is not None else fw.TensorProto()
+    tp.dtype = dt
+    tp.tensor_shape.CopyFrom(shape_to_proto(arr.shape))
     if use_tensor_content:
         tp.tensor_content = arr.astype(np_dtype.newbyteorder("<"), copy=False).tobytes()
         return tp

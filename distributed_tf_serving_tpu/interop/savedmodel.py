@@ -415,18 +415,30 @@ def infer_generic_architecture(
     kernels = {k: v for k, v in two_d.items() if k != emb_name}
 
     # Depth-first shape-chaining: one ordering that consumes every kernel.
+    # Branching is bounded: same-shape kernels are interchangeable, so each
+    # level tries ONE candidate per distinct shape (natural-name order
+    # within a shape keeps stacked layers stable), and dead (cur_dim,
+    # remaining) states are memoized — without this, a dozen uniform-width
+    # kernels with no valid chain would backtrack factorially.
+    dead: set[tuple[int, frozenset]] = set()
+
     def chain(cur_dim: int, remaining: frozenset) -> list[str] | None:
         if not remaining:
             return []
+        if (cur_dim, remaining) in dead:
+            return None
+        tried_shapes = set()
         for k in sorted(remaining, key=_natural_key):
             rows, cols = kernels[k].shape
-            if rows != cur_dim:
+            if rows != cur_dim or (rows, cols) in tried_shapes:
                 continue
+            tried_shapes.add((rows, cols))
             if not remaining - {k} and cols != 1:
                 continue  # the last kernel must emit the logit
             rest = chain(cols, remaining - {k})
             if rest is not None:
                 return [k] + rest
+        dead.add((cur_dim, remaining))
         return None
 
     order = chain(d0, frozenset(kernels))

@@ -160,6 +160,13 @@ class MultiHostRunner:
         # broadcasts, or the slice desynchronizes into a silent hang.
         self._lock = threading.Lock()
         self.version: int | None = None
+        # Constructor params are placed HERE, not lazily: host-numpy params
+        # fed to the jitted step would be re-uploaded on EVERY call (there
+        # is no host-array transfer cache), and construction is the one
+        # protocol point every process reaches together, so the
+        # cross-process device_put cannot interleave with later
+        # collectives. place_loaded=False callers own placement entirely.
+        self.params = self._place(self.params)
 
         def run(params, batch):
             batch = {
@@ -320,7 +327,15 @@ class MultiHostRunner:
                 if k not in arrays:
                     padded[k] = tmpl  # optional input (e.g. dense): zeros
                     continue
-                arr = np.asarray(arrays[k], dtype=tmpl.dtype)
+                arr = np.asarray(arrays[k])
+                if arr.shape == tmpl.shape and arr.dtype == tmpl.dtype:
+                    # Already bucket-shaped — the recommended setup
+                    # (DynamicBatcher with buckets=runner.buckets) pads
+                    # before run_fn, so this is every steady-state call;
+                    # re-padding would copy MBs per dispatch for nothing.
+                    padded[k] = arr
+                    continue
+                arr = arr.astype(tmpl.dtype, copy=False)
                 buf = np.zeros_like(tmpl)
                 buf[:n] = arr
                 padded[k] = buf

@@ -215,8 +215,10 @@ class PredictionServiceImpl:
                 tp.tensor_content for tp in request.inputs.values()
             )
             for name in out_names:
-                resp.outputs[name].CopyFrom(
-                    codec.from_ndarray(outputs[name], use_tensor_content=mirror_content)
+                codec.from_ndarray(
+                    outputs[name],
+                    use_tensor_content=mirror_content,
+                    out=resp.outputs[name],
                 )
         return resp
 

@@ -45,11 +45,22 @@ def save_servable(path, servable: Servable, kind: str) -> None:
     (path / MANIFEST).write_text(json.dumps(manifest, indent=2))
 
 
-def load_servable(path, mesh=None, tensor_parallel: bool = False) -> Servable:
+def load_servable(
+    path, mesh=None, tensor_parallel: bool = False, host: bool = False
+) -> Servable:
     """Reconstruct a Servable; with a mesh, params restore pre-placed
     (vocab tables over the model axis; dense weights model-axis split too
     when tensor_parallel) instead of replicated — restoring straight into
-    the serving layout avoids a second full-tree resharding pass."""
+    the serving layout avoids a second full-tree resharding pass.
+
+    host=True restores plain numpy arrays with NO device placement — the
+    mode multi-process serving needs: under jax.distributed, a device
+    restore demands explicit cross-process shardings orbax cannot infer
+    from a single-process checkpoint, whereas every process can read the
+    full tree to host and let the caller place it at a protocol-aligned
+    point (parallel/multihost.py MultiHostRunner._place)."""
+    import numpy as np
+
     path = pathlib.Path(path)
     manifest = json.loads((path / MANIFEST).read_text())
     config = ModelConfig(**{**manifest["config"], "mlp_dims": tuple(manifest["config"]["mlp_dims"]),
@@ -57,17 +68,43 @@ def load_servable(path, mesh=None, tensor_parallel: bool = False) -> Servable:
     model = build_model(manifest["kind"], config)
 
     target = jax.eval_shape(model.init, jax.random.PRNGKey(0))
-    if mesh is not None:
-        from ..parallel.sharding import param_shardings
-
-        shardings = param_shardings(target, mesh, tensor_parallel)
-        target = jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            target,
-            shardings,
+    if host:
+        if mesh is not None:
+            raise ValueError("host=True restores unplaced arrays; mesh is exclusive")
+        # A host restore is a purely LOCAL read, so it must opt out of
+        # orbax's cross-process barrier: under jax.distributed the default
+        # Checkpointer syncs every process on restore, and the multihost
+        # serving protocol restores at different protocol points on leader
+        # (before the RELOAD broadcast) vs followers (after) — the barrier
+        # would interleave with the runner's own collectives and deadlock
+        # the slice (observed: leader in orbax sync_global_processes,
+        # follower in the header broadcast).
+        local_only = ocp.options.MultiprocessingOptions(
+            primary_host=jax.process_index(),
+            active_processes={jax.process_index()},
+            barrier_sync_key_prefix=f"dts_local_{jax.process_index()}",
         )
-    with ocp.StandardCheckpointer() as ckptr:
-        params = ckptr.restore((path / PARAMS_DIR).absolute(), target)
+        with ocp.Checkpointer(
+            ocp.PyTreeCheckpointHandler(), multiprocessing_options=local_only
+        ) as ckptr:
+            params = ckptr.restore(
+                (path / PARAMS_DIR).absolute(),
+                restore_args=jax.tree.map(
+                    lambda _: ocp.RestoreArgs(restore_type=np.ndarray), target
+                ),
+            )
+    else:
+        if mesh is not None:
+            from ..parallel.sharding import param_shardings
+
+            shardings = param_shardings(target, mesh, tensor_parallel)
+            target = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+                target,
+                shardings,
+            )
+        with ocp.StandardCheckpointer() as ckptr:
+            params = ckptr.restore((path / PARAMS_DIR).absolute(), target)
 
     dense = config.num_dense_features if manifest["kind"] == "dlrm" else None
     return Servable(

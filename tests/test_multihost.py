@@ -254,7 +254,19 @@ def _run_two_process(worker_src: str, timeout_s: int = 240):
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail(f"multihost workers hung; partial output: {outs}")
+        # Collect whatever the killed workers managed to print — a hang
+        # report without the workers' own output is undebuggable.
+        dumps = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=10)
+            except Exception:  # noqa: BLE001
+                out = "<unreadable>"
+            dumps.append(out)
+        pytest.fail(
+            "multihost workers hung; outputs:\n"
+            + "\n====\n".join(d[-2000:] for d in dumps)
+        )
     return procs, outs
 
 
@@ -355,6 +367,198 @@ def test_watcher_loader_hot_swaps_runner(tmp_path):
     got = runner.lead(batch)
     want = np.asarray(model.apply(v2.params, batch)["prediction_node"])
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+_SERVER_WORKER = textwrap.dedent(
+    """
+    import os, sys, pathlib, tempfile
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    base = pathlib.Path(os.environ["MH_BASE_PATH"])
+
+    from distributed_tf_serving_tpu.models import ModelConfig, build_model
+    from distributed_tf_serving_tpu.serving.multihost_server import build_multihost_stack
+
+    cfg = ModelConfig(
+        num_fields=8, vocab_size=512, embed_dim=4, mlp_dims=(16,),
+        num_cross_layers=1, compute_dtype="float32",
+    )
+    # NOTE: no jax computation before build_multihost_stack —
+    # jax.distributed.initialize must run first. Version 1 was written by
+    # the pytest parent process; model building here only creates closures.
+    # Further versions are written by SPAWNED writer subprocesses (the env
+    # var MH_WRITER script): orbax save inside this jax.distributed process
+    # would barrier on all processes and deadlock the slice — production
+    # checkpoints come from a trainer job OUTSIDE the serving slice too.
+    model = build_model("dcn_v2", cfg)
+
+    import subprocess
+    def write_version(version, seed):
+        subprocess.run(
+            [sys.executable, os.environ["MH_WRITER"], str(base), str(version), str(seed)],
+            check=True, capture_output=True, timeout=120,
+        )
+
+    runner, registry, batcher, impl, watcher = build_multihost_stack(
+        base, f"127.0.0.1:{port}", 2, pid,
+        model_kind="dcn_v2", buckets=(16, 32),
+        poll_interval_s=3600,
+    )
+
+    if pid != 0:
+        runner.follow()
+        assert runner.version == 2, f"follower ended on version {runner.version}"
+        print("FOLLOWER_DONE")
+        sys.exit(0)
+
+    from distributed_tf_serving_tpu.client import predict_sync
+    from distributed_tf_serving_tpu.serving.server import create_server
+
+    assert runner.version == 1 and registry.models()["DCN"] == [1]
+    server, gport = create_server(impl, "127.0.0.1:0")
+    server.start()
+
+    rng = np.random.RandomState(3)
+    arrays = {
+        "feat_ids": rng.randint(0, 1 << 40, size=(10, cfg.num_fields)).astype(np.int64),
+        "feat_wts": rng.rand(10, cfg.num_fields).astype(np.float32),
+    }
+    from distributed_tf_serving_tpu.serving.batcher import prepare_inputs
+    def golden(seed):  # versions are seeded model.init trees (deterministic)
+        params = model.init(jax.random.PRNGKey(seed))
+        return np.asarray(model.apply(params, prepare_inputs(model, dict(arrays)))["prediction_node"])
+
+    got1 = predict_sync(f"127.0.0.1:{gport}", arrays)["prediction_node"]
+    np.testing.assert_allclose(got1, golden(1), rtol=1e-5)
+
+    write_version(2, seed=9)
+    watcher.poll_once()  # leader load -> slice-wide RELOAD broadcast
+    got2 = predict_sync(f"127.0.0.1:{gport}", arrays)["prediction_node"]
+    np.testing.assert_allclose(got2, golden(9), rtol=1e-5)
+    assert not np.allclose(got2, got1), "scores unchanged after hot swap"
+    assert registry.models()["DCN"] == [1, 2] and runner.version == 2
+
+    watcher.stop(); server.stop(0); batcher.stop(); runner.shutdown()
+    print("MULTIHOST_SERVER_OK")
+    """
+)
+
+
+@pytest.mark.slow
+def test_multihost_server_stack_hot_swap_over_socket(tmp_path):
+    """The operable entry point (serving/multihost_server.py): leader +
+    follower build the real stack from a shared version base path, serve
+    over a live gRPC socket, and a watcher poll hot-swaps the whole slice."""
+    base = tmp_path / "models"
+    base.mkdir()
+    # Version writer runs in ITS OWN process (also spawned by the leader
+    # mid-test for v2): orbax save inside a jax.distributed process would
+    # barrier on the whole slice. Same config/seeds as the worker script.
+    writer = tmp_path / "write_version.py"
+    writer.write_text(textwrap.dedent(
+        """
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distributed_tf_serving_tpu.models import (
+            ModelConfig, Servable, build_model, ctr_signatures,
+        )
+        from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+        base, version, seed = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+        cfg = ModelConfig(
+            num_fields=8, vocab_size=512, embed_dim=4, mlp_dims=(16,),
+            num_cross_layers=1, compute_dtype="float32",
+        )
+        model = build_model("dcn_v2", cfg)
+        sv = Servable(name="DCN", version=version, model=model,
+                      params=model.init(jax.random.PRNGKey(seed)),
+                      signatures=ctr_signatures(cfg.num_fields))
+        save_servable(f"{base}/{version}", sv, kind="dcn_v2")
+        """
+    ))
+    subprocess.run(
+        [sys.executable, str(writer), str(base), "1", "1"],
+        check=True, capture_output=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep))},
+    )
+
+    os.environ["MH_BASE_PATH"] = str(base)
+    os.environ["MH_WRITER"] = str(writer)
+    try:
+        procs, outs = _run_two_process(_SERVER_WORKER)
+    finally:
+        os.environ.pop("MH_BASE_PATH", None)
+        os.environ.pop("MH_WRITER", None)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    assert "MULTIHOST_SERVER_OK" in outs[0]
+    assert "FOLLOWER_DONE" in outs[1]
+
+
+def test_multihost_stack_dlrm_carries_dense_features(tmp_path):
+    """Templates are signature-driven: DLRM's dense_features must cross the
+    broadcast (not be silently zero-substituted), and architecture comes
+    from the checkpoint manifest, not flags (single-process stack)."""
+    import jax
+
+    from distributed_tf_serving_tpu.models import (
+        ModelConfig, Servable, build_model, ctr_signatures,
+    )
+    from distributed_tf_serving_tpu.serving.batcher import prepare_inputs
+    from distributed_tf_serving_tpu.serving.multihost_server import build_multihost_stack
+    from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+    cfg = ModelConfig(
+        name="DLRM", num_fields=6, vocab_size=512, embed_dim=4,
+        bottom_mlp_dims=(8, 4), mlp_dims=(16,), num_dense_features=5,
+        compute_dtype="float32",
+    )
+    model = build_model("dlrm", cfg)
+    sv = Servable(
+        name="DLRM", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(cfg.num_fields, with_dense=cfg.num_dense_features),
+    )
+    base = tmp_path / "models"
+    save_servable(base / "1", sv, kind="dlrm")
+
+    runner, registry, batcher, impl, watcher = build_multihost_stack(
+        base, None, 1, 0, model_name="DLRM", buckets=(16,), poll_interval_s=3600,
+    )
+    try:
+        assert "dense_features" in runner._keys  # signature-driven template
+        assert registry.models()["DLRM"] == [1]
+
+        rng = np.random.RandomState(4)
+        arrays = {
+            "feat_ids": rng.randint(0, 1 << 40, size=(9, cfg.num_fields)).astype(np.int64),
+            "feat_wts": rng.rand(9, cfg.num_fields).astype(np.float32),
+            "dense_features": rng.rand(9, cfg.num_dense_features).astype(np.float32),
+        }
+        got = batcher.submit(sv, dict(arrays)).result(timeout=120)["prediction_node"]
+        prepared = prepare_inputs(model, dict(arrays))
+        want = np.asarray(model.apply(sv.params, prepared)["prediction_node"])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        # and the dense input actually mattered (zeros would score differently)
+        zeroed = dict(prepared)
+        zeroed["dense_features"] = np.zeros_like(prepared["dense_features"])
+        assert not np.allclose(
+            want, np.asarray(model.apply(sv.params, zeroed)["prediction_node"])
+        )
+    finally:
+        watcher.stop()
+        batcher.stop()
 
 
 @pytest.mark.slow
