@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import math
 import multiprocessing as mp
 import queue
 import time
@@ -93,11 +94,19 @@ async def run_closed_loop(
         await client.predict(payload, sort_scores=sort_scores)
 
     latencies: list[float] = []
+    # Stride must be coprime to the pool size for EVERY worker to cycle the
+    # FULL pool (73 alone would degenerate for pools of length 73k).
+    stride = 1
+    if payload_pool:
+        stride = next(
+            s for s in range(73, 73 + len(payload_pool) + 1)
+            if math.gcd(s, len(payload_pool)) == 1
+        )
 
     async def worker(w: int):
         for i in range(requests_per_worker):
             p = (
-                payload_pool[(w + i * 73) % len(payload_pool)]
+                payload_pool[(w + i * stride) % len(payload_pool)]
                 if payload_pool
                 else payload
             )

@@ -71,5 +71,8 @@ def partition_flat(flat: Sequence, parts: int, num_fields: int) -> list[Sequence
 
 def merge_host_order(parts: list[np.ndarray]) -> np.ndarray:
     """Concatenate per-shard results in shard (host) order — the merge
-    semantics of DCNClient.java:161-164."""
+    semantics of DCNClient.java:161-164. A single shard passes through
+    (the single-backend hot path re-copies nothing)."""
+    if len(parts) == 1:
+        return np.asarray(parts[0])
     return np.concatenate(list(parts), axis=0)

@@ -185,7 +185,10 @@ class PredictionServiceImpl:
                     "INVALID_ARGUMENT",
                     f"output_filter names unknown tensors {missing}; have {sig_outputs}",
                 )
-            out_names = list(request.output_filter)
+            # Deduplicate (order-preserving): the in-place repeated-field
+            # encode APPENDS, so a duplicated filter name would otherwise
+            # emit doubled float_val lists against a single-n shape.
+            out_names = list(dict.fromkeys(request.output_filter))
         else:
             out_names = sig_outputs
         with request_trace.span("predict.execute"):

@@ -85,6 +85,7 @@ class Trainer:
         learning_rate: float = 1e-3,
         seed: int = 0,
         tensor_parallel: bool = False,
+        stream_config: SyntheticCTRConfig | None = None,
     ):
         self.model = model
         self.mesh = mesh
@@ -96,11 +97,16 @@ class Trainer:
         self.state = TrainState(params=params, opt_state=opt_state, step=jnp.asarray(0))
         self.step_fn = make_train_step(model, self.optimizer)
         self._eval_apply = jax.jit(model.apply)  # compiled once, reused per eval
+        # stream_config sets the data's difficulty: id catalog density
+        # decides how many noisy Bernoulli views each embedding row gets per
+        # epoch-equivalent (short bench runs want a denser catalog — see
+        # bench.py train_on_chip). The default keeps the catalog within the
+        # vocab so folding is injective and every id's embedding can learn
+        # its teacher weight.
         self.stream = SyntheticCTRStream(
-            SyntheticCTRConfig(
+            stream_config
+            or SyntheticCTRConfig(
                 num_fields=model.config.num_fields,
-                # Keep the catalog within the vocab so folding is injective
-                # and every id's embedding can learn its teacher weight.
                 id_space=min(1 << 18, model.config.vocab_size),
                 seed=seed,
             )
@@ -133,8 +139,17 @@ class Trainer:
             **{k: float(v) for k, v in metrics.items()},
         }
 
-    def eval_auc(self, batches: int = 8, batch_size: int = 1024, offset: int = 1_000_000) -> float:
-        scores, labels = [], []
+    def eval_auc(
+        self,
+        batches: int = 8,
+        batch_size: int = 1024,
+        offset: int = 1_000_000,
+        with_bayes: bool = False,
+    ):
+        """Held-out AUC (indices disjoint from training). with_bayes=True
+        also returns the teacher's own AUC on the same rows — the Bayes
+        ceiling the model number should be read against."""
+        scores, labels, teacher = [], [], []
         apply = self._eval_apply
         for i in range(batches):
             raw = self.stream.batch(batch_size, offset + i)
@@ -142,7 +157,13 @@ class Trainer:
             out = apply(self.state.params, {k: batch[k] for k in ("feat_ids", "feat_wts")})
             scores.append(np.asarray(out["prediction_node"]))
             labels.append(raw["labels"])
-        return auc(np.concatenate(labels), np.concatenate(scores))
+            if with_bayes:
+                teacher.append(self.stream._teacher_score(raw["feat_ids"], raw["feat_wts"]))
+        labels = np.concatenate(labels)
+        model_auc = auc(labels, np.concatenate(scores))
+        if with_bayes:
+            return model_auc, auc(labels, np.concatenate(teacher))
+        return model_auc
 
 
 def main(argv=None) -> None:
@@ -168,6 +189,10 @@ def main(argv=None) -> None:
     parser.add_argument("--mesh-devices", type=int, default=0,
                         help=">0: shard training over the first n devices")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--id-space", type=int, default=0,
+                        help="synthetic catalog size (0 = min(2^18, vocab)); "
+                        "denser catalogs give each embedding row more views "
+                        "per step — see bench.py train_on_chip")
     args = parser.parse_args(argv)
 
     config = ModelConfig(
@@ -180,7 +205,20 @@ def main(argv=None) -> None:
 
         mesh = make_mesh(args.mesh_devices)
     model = build_model(args.kind, config)
-    trainer = Trainer(model, mesh=mesh, learning_rate=args.learning_rate, seed=args.seed)
+    stream_config = None
+    if args.id_space:
+        # Clamp to the vocab: past it the fold stops being injective and
+        # colliding ids carry contradictory labels (silent AUC damage).
+        id_space = min(args.id_space, args.vocab_size)
+        if id_space != args.id_space:
+            print(f"--id-space {args.id_space} clamped to vocab size {id_space}")
+        stream_config = SyntheticCTRConfig(
+            num_fields=args.num_fields, id_space=id_space, seed=args.seed
+        )
+    trainer = Trainer(
+        model, mesh=mesh, learning_rate=args.learning_rate, seed=args.seed,
+        stream_config=stream_config,
+    )
     metrics = trainer.fit(args.steps, batch_size=args.batch_size, log_every=max(args.steps // 10, 1))
     auc_val = trainer.eval_auc()
     servable = Servable(
