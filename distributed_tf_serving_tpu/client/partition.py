@@ -71,8 +71,11 @@ def partition_flat(flat: Sequence, parts: int, num_fields: int) -> list[Sequence
 
 def merge_host_order(parts: list[np.ndarray]) -> np.ndarray:
     """Concatenate per-shard results in shard (host) order — the merge
-    semantics of DCNClient.java:161-164. A single shard passes through
-    (the single-backend hot path re-copies nothing)."""
+    semantics of DCNClient.java:161-164. A single WRITABLE shard passes
+    through uncopied; read-only shards (codec's zero-copy frombuffer views
+    over response bytes) are copied so callers always get the owned,
+    writable array this function has always returned."""
     if len(parts) == 1:
-        return np.asarray(parts[0])
+        p = np.asarray(parts[0])
+        return p if p.flags.writeable else p.copy()
     return np.concatenate(list(parts), axis=0)

@@ -102,13 +102,15 @@ def main(argv=None) -> None:
         line = {
             "family": kind,
             "batch": n,
-            "device_step_us": round(step_s * 1e6, 1),
-            "examples_per_s": round(n / step_s, 0),
-            "qps_1k_equiv": round(n / 1000 / step_s, 1),
+            # None = degenerate reading (relay flap spanned the min-of-2
+            # walls); recorded as null rather than crashing the sweep.
+            "device_step_us": None if step_s is None else round(step_s * 1e6, 1),
+            "examples_per_s": None if step_s is None else round(n / step_s, 0),
+            "qps_1k_equiv": None if step_s is None else round(n / 1000 / step_s, 1),
             "setup_s": round(time.perf_counter() - t0, 1),
         }
         peak = peak_flops_for(device)
-        if peak and kind == "dcn_v2":
+        if peak and kind == "dcn_v2" and step_s:
             line["mfu"] = round(flops_per_example(config) * n / step_s / peak, 4)
         results.append(line)
         print(json.dumps(line), flush=True)
