@@ -486,8 +486,10 @@ async def overload_probe(client_cls, port: str, batcher, scale: Scale, payload) 
     old_capacity = batcher.queue_capacity_candidates
     # One max-size bucket of queued work: a 128-way burst of 1k-candidate
     # requests must overrun it decisively (a looser squeeze made the shed
-    # rate drift with drain-speed variance across runs, 1%-6%).
-    batcher.queue_capacity_candidates = max(batcher.buckets[-1], CANDIDATES)
+    # rate drift with drain-speed variance across runs, 1%-6%). Computed
+    # ONCE so the applied and reported values cannot desync.
+    probe_capacity = max(batcher.buckets[-1], CANDIDATES)
+    batcher.queue_capacity_candidates = probe_capacity
     counts = {"sent": 0, "ok": 0, "shed": 0, "unavailable": 0, "other": 0}
     try:
         async with client_cls([f"127.0.0.1:{port}"], "DCN", channels_per_host=6) as client:
@@ -512,7 +514,7 @@ async def overload_probe(client_cls, port: str, batcher, scale: Scale, payload) 
     finally:
         batcher.queue_capacity_candidates = old_capacity
     counts["shed_rate"] = round(counts["shed"] / max(counts["sent"], 1), 3)
-    counts["queue_capacity_candidates"] = max(batcher.buckets[-1], CANDIDATES)
+    counts["queue_capacity_candidates"] = probe_capacity
     return counts
 
 

@@ -36,6 +36,12 @@ class PredictClientError(RuntimeError):
         self.code = code
 
 
+# Failures worth rerouting to another backend: the host is down/slow/
+# shedding. Deterministic request errors (INVALID_ARGUMENT, NOT_FOUND)
+# would fail identically everywhere and never retry.
+_FAILOVER_CODES = frozenset({"UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED"})
+
+
 def build_predict_request(
     arrays: dict[str, np.ndarray],
     model_name: str,
@@ -73,6 +79,7 @@ class ShardedPredictClient:
         use_tensor_content: bool = True,
         channels_per_host: int = 1,
         full_async: bool = True,
+        failover_attempts: int = 0,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -88,6 +95,13 @@ class ShardedPredictClient:
         # legacy mode's *scheduling* without replicating its out-of-order
         # merge laxity (merge order stays pinned either way).
         self.full_async = full_async
+        # Beyond the reference (whose async mode let a dead host kill the
+        # load thread, DCNClient.java:158-159): a shard whose home backend
+        # fails with a reroutable status retries on the next host(s), up
+        # to this many extra attempts. Results stay keyed by SHARD index,
+        # so the host-order merge semantics are untouched. 0 = reference
+        # fail-fast behavior.
+        self.failover_attempts = max(0, failover_attempts)
         # Long-lived plaintext channels per host, created once and shared
         # (DCNClient.java:118-125). channels_per_host > 1 stripes requests
         # over several HTTP/2 connections — one connection's flow-control
@@ -121,15 +135,29 @@ class ShardedPredictClient:
             output_filter=(self.output_key,),
             use_tensor_content=self.use_tensor_content,
         )
-        stubs = self._stubs[i]
-        # rr advances once per logical request (not per shard), so shard i of
-        # request r lands on channel (r + i) % k: consecutive requests stripe
-        # every host's channels even when the shard count divides k.
-        try:
-            resp = await stubs[(rr + i) % len(stubs)].Predict(req, timeout=self.timeout_s)
-        except grpc.aio.AioRpcError as e:
-            raise PredictClientError(self.hosts[i], e.code(), e.details()) from e
-        return codec.to_ndarray(resp.outputs[self.output_key])
+        for attempt in range(self.failover_attempts + 1):
+            host_idx = (i + attempt) % len(self.hosts)
+            stubs = self._stubs[host_idx]
+            # rr advances once per logical request (not per shard), so shard
+            # i of request r lands on channel (r + i) % k: consecutive
+            # requests stripe every host's channels even when the shard
+            # count divides k.
+            try:
+                resp = await stubs[(rr + i) % len(stubs)].Predict(
+                    req, timeout=self.timeout_s
+                )
+            except grpc.aio.AioRpcError as e:
+                code_name = getattr(e.code(), "name", str(e.code()))
+                if (
+                    attempt < self.failover_attempts
+                    and code_name in _FAILOVER_CODES
+                ):
+                    continue  # reroute this shard to the next host
+                raise PredictClientError(
+                    self.hosts[host_idx], e.code(), e.details()
+                ) from e
+            return codec.to_ndarray(resp.outputs[self.output_key])
+        raise AssertionError("unreachable: loop always returns or raises")
 
     async def predict(
         self, arrays: dict[str, np.ndarray], sort_scores: bool = False
@@ -165,6 +193,7 @@ def client_from_config(cfg) -> ShardedPredictClient:
         timeout_s=cfg.timeout_s,
         use_tensor_content=cfg.use_tensor_content,
         full_async=cfg.full_async_mode,
+        failover_attempts=cfg.failover_attempts,
     )
 
 

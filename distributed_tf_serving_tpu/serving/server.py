@@ -157,6 +157,8 @@ def build_stack(
         max_wait_us=cfg.max_wait_us,
         compress_transfer=cfg.compress_transfer,
         run_fn=run_fn,
+        pipeline_depth=cfg.pipeline_depth,
+        queue_capacity_candidates=cfg.queue_capacity_candidates,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
 

@@ -29,6 +29,14 @@ class ServerConfig:
     max_wait_us: int = 200
     compress_transfer: bool = True
     warmup: bool = True
+    # Coalescing keeps filling past max_wait while this many batches are in
+    # flight (latency-free: the dispatch would queue behind device work
+    # anyway — serving/batcher.py pipeline-aware fill; min 2).
+    pipeline_depth: int = 2
+    # Admission bound in queued candidates (None = 16 max-size batches);
+    # past it requests shed with RESOURCE_EXHAUSTED instead of queueing
+    # beyond any deadline.
+    queue_capacity_candidates: int | None = None
     # mesh: 0 = single device; >0 = shard over first n devices
     mesh_devices: int = 0
     model_parallel: int = 1
@@ -55,6 +63,10 @@ class ClientConfig:
     sort_scores: bool = True  # the ranking sort, DCNClient.java:195
     timeout_s: float = 10.0
     use_tensor_content: bool = True
+    # Beyond the reference: reroute a failed shard to the next host(s) on
+    # UNAVAILABLE/DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED, up to this many
+    # extra attempts (0 = the reference's fail-fast behavior).
+    failover_attempts: int = 0
 
 
 def _model_config_cls():

@@ -416,3 +416,81 @@ def test_closed_loop_mp_smoke(three_backends):
     s = report.summary()
     assert s["requests"] == 4
     assert s["qps"] > 0 and s["p99_ms"] >= s["p50_ms"] > 0
+
+
+def test_fanout_failover_reroutes_dead_shard(three_backends):
+    """Beyond the reference (whose async mode let a dead host kill the load
+    thread, DCNClient.java:158-159): with failover_attempts, the shard whose
+    home backend is dead reroutes to the next host — scores AND merge order
+    must equal the all-healthy fan-out."""
+    servable = _servable(version=1, seed=0)
+    arrays = _arrays(n=9, seed=21)
+    want = _golden(servable, arrays)
+
+    hosts = ["127.0.0.1:1"] + list(three_backends[:2])  # shard 0's home is dead
+
+    async def go():
+        async with ShardedPredictClient(
+            hosts, "DCN", timeout_s=2.0, failover_attempts=1
+        ) as client:
+            return await client.predict(arrays)
+
+    merged = asyncio.run(go())
+    np.testing.assert_allclose(merged, want, rtol=1e-6)
+
+
+def test_fanout_failover_does_not_retry_deterministic_errors():
+    """INVALID_ARGUMENT/NOT_FOUND would fail identically on every backend:
+    failover must raise immediately, not burn attempts — pinned by the
+    server's own RPC counter (exactly ONE Predict arrives despite
+    failover_attempts=2)."""
+    from distributed_tf_serving_tpu.client import PredictClientError
+    from distributed_tf_serving_tpu.utils.metrics import ServerMetrics
+
+    registry = ServableRegistry()
+    registry.load(_servable(version=1, seed=0))
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    metrics = ServerMetrics()
+    server, port = create_server(
+        PredictionServiceImpl(registry, batcher), "127.0.0.1:0", metrics=metrics
+    )
+    server.start()
+    try:
+        host = f"127.0.0.1:{port}"
+
+        async def go():
+            async with ShardedPredictClient(
+                [host], "NOSUCH", timeout_s=2.0, failover_attempts=2
+            ) as client:
+                await client.predict(_arrays(n=9))
+
+        with pytest.raises(PredictClientError) as ei:
+            asyncio.run(go())
+        assert getattr(ei.value.code, "name", "") == "NOT_FOUND"
+        assert ei.value.host == host
+        snap = metrics.snapshot()["rpcs"]["Predict"]
+        assert snap["errors"] + snap["ok"] == 1  # no attempts were burned
+    finally:
+        server.stop(0)
+        batcher.stop()
+
+
+def test_fanout_failover_exhaustion_raises_last_host():
+    """All candidate hosts dead: the raised error stays typed and names the
+    LAST host tried. full_async=False makes shard 0's error surface
+    deterministically (no gather race): home dead[0], reroutes to dead[1]
+    then dead[2] with failover_attempts=2."""
+    from distributed_tf_serving_tpu.client import PredictClientError
+
+    dead = ["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"]
+
+    async def go():
+        async with ShardedPredictClient(
+            dead, "DCN", timeout_s=2.0, failover_attempts=2, full_async=False
+        ) as client:
+            await client.predict(_arrays(n=9))
+
+    with pytest.raises(PredictClientError) as ei:
+        asyncio.run(go())
+    assert ei.value.host == dead[2]
+    assert getattr(ei.value.code, "name", "") == "UNAVAILABLE"
