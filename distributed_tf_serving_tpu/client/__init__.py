@@ -3,6 +3,7 @@
 from .bench import BenchReport, make_payload, run_closed_loop, run_closed_loop_mp
 from .client import (
     PredictClientError,
+    PreparedRequest,
     ShardedPredictClient,
     build_predict_request,
     client_from_config,
@@ -19,6 +20,7 @@ from .partition import (
 __all__ = [
     "ShardedPredictClient",
     "PredictClientError",
+    "PreparedRequest",
     "build_predict_request",
     "client_from_config",
     "predict_sync",

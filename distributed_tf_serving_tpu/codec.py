@@ -74,6 +74,14 @@ _NP_TO_DT: dict[np.dtype, int] = {
 }
 
 
+# Little-endian (wire byte order) dtype per DataType, precomputed: dtype
+# object construction per call is measurable at 500 QPS, and on LE hosts the
+# post-frombuffer astype is a no-op against these.
+_DTYPES_LE: dict[int, np.dtype] = {
+    dt: np_dtype.newbyteorder("<") for dt, (np_dtype, _f) in _DTYPES.items()
+}
+
+
 def dtype_to_numpy(dt: int) -> np.dtype:
     if dt not in _DTYPES:
         raise CodecError(f"unsupported DataType: {DataType.Name(dt) if dt in DataType.values() else dt}")
@@ -127,8 +135,12 @@ def to_ndarray(tp: fw.TensorProto) -> np.ndarray:
             f"unsupported DataType: {DataType.Name(dt) if dt in DataType.values() else dt}"
         )
 
-    if tp.tensor_content:
-        buf = np.frombuffer(tp.tensor_content, dtype=np_dtype.newbyteorder("<"))
+    # Bind ONCE: every upb bytes-field access copies the payload (~9 us per
+    # half-MB on this rig); the frombuffer view below aliases this specific
+    # bytes object, keeping the decode zero-copy end to end.
+    content = tp.tensor_content
+    if content:
+        buf = np.frombuffer(content, dtype=_DTYPES_LE[dt])
         if buf.size != n:
             raise CodecError(
                 f"tensor_content holds {buf.size} {np_dtype} elements, shape {dims} needs {n}"
@@ -201,7 +213,7 @@ def from_ndarray(
     tp.dtype = dt
     tp.tensor_shape.CopyFrom(shape_to_proto(arr.shape))
     if use_tensor_content:
-        tp.tensor_content = arr.astype(np_dtype.newbyteorder("<"), copy=False).tobytes()
+        tp.tensor_content = arr.astype(_DTYPES_LE[dt], copy=False).tobytes()
         return tp
 
     flat = arr.ravel()

@@ -11,6 +11,7 @@ stored SignatureDefs (get_model_metadata.proto:15-30).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import threading
 
 import jax.numpy as jnp
@@ -65,6 +66,17 @@ class Signature:
     inputs: tuple[TensorSpec, ...]
     outputs: tuple[TensorSpec, ...]
     method_name: str = PREDICT_METHOD
+
+    # cached_property writes the instance __dict__ directly, which frozen
+    # dataclasses permit: rebuilding these per request showed up in the
+    # round-3 serving profile.
+    @functools.cached_property
+    def input_specs(self) -> dict[str, TensorSpec]:
+        return {s.name: s for s in self.inputs}
+
+    @functools.cached_property
+    def output_names(self) -> list[str]:
+        return [s.name for s in self.outputs]
 
     def to_signature_def(self) -> mg.SignatureDef:
         sd = mg.SignatureDef(method_name=self.method_name)

@@ -47,6 +47,16 @@ class PredictionServiceStub:
                     response_deserializer=resp_cls.FromString,
                 ),
             )
+        # Raw-bytes variant of the hot RPC: callers that hold an already
+        # serialized PredictRequest (client.PreparedRequest) skip the
+        # per-call SerializeToString — the wire bytes are identical, grpc
+        # passes a bytes request through untouched when the serializer is
+        # None.
+        self.PredictRaw = channel.unary_unary(
+            f"/{SERVICE_NAME}/Predict",
+            request_serializer=None,
+            response_deserializer=_METHODS["Predict"][1].FromString,
+        )
 
 
 class PredictionServiceServicer:

@@ -105,7 +105,9 @@ def _immutably_backed(arr: np.ndarray) -> bool:
     return isinstance(b, bytes)
 
 
-def prepare_inputs(model: Model, arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+def prepare_inputs(
+    model: Model, arrays: dict[str, np.ndarray], fold_ids: bool = True
+) -> dict[str, np.ndarray]:
     """Host-side normalization before padding/transfer.
 
     Every output array is OWNED or IMMUTABLE (never writable-aliased to the
@@ -116,10 +118,20 @@ def prepare_inputs(model: Model, arrays: dict[str, np.ndarray]) -> dict[str, np.
     passthrough branch skips the copy only for arrays whose backing buffer
     is itself immutable — the serving hot path's arrays are np.frombuffer
     views over protobuf bytes, which NOBODY can mutate (~50 us per 1k x 43
-    request back on the 1-core host); anything else is copied."""
+    request back on the 1-core host); anything else is copied.
+
+    fold_ids=False defers the vocab fold to batch time (_execute folds the
+    whole padded batch in ONE native call): per-request folding charged
+    ~130 us of ctypes+alloc overhead per 1k-candidate request to the RPC
+    thread/event loop — at 500 QPS that is ~7% of the single-core budget —
+    while the batched fold costs the batcher thread ~150 us per 8k batch,
+    GIL released. Callers that apply the model directly on the returned
+    arrays (tests, measurement harnesses) keep the folding default: unfolded
+    int64 would be silently int32-cast by device_put under x64-disabled
+    JAX and re-fold into garbage for ids past 2^31."""
     out = {}
     for key, arr in arrays.items():
-        if key == "feat_ids":
+        if key == "feat_ids" and fold_ids:
             out[key] = fold_ids_host(arr, model.config.vocab_size)
         elif arr.dtype == np.float64:
             out[key] = arr.astype(np.float32)
@@ -443,7 +455,7 @@ class DynamicBatcher:
         try:
             item = _WorkItem(
                 servable=servable,
-                arrays=prepare_inputs(servable.model, arrays),
+                arrays=prepare_inputs(servable.model, arrays, fold_ids=False),
                 n=n,
                 future=fut,
                 enqueue_t=time.perf_counter(),
@@ -521,23 +533,34 @@ class DynamicBatcher:
         return entry
 
     def _execute(self, servable: Servable, arrays: dict[str, np.ndarray]):
+        ids = arrays.get("feat_ids")
+        if ids is not None and ids.dtype == np.int64:
+            # Deferred per-request fold (prepare_inputs fold_ids=False):
+            # one native fold over the whole padded batch. Runs BEFORE the
+            # content digest, so cache keys are over the same folded bytes
+            # as the eager-fold path produced.
+            arrays = dict(arrays)
+            arrays["feat_ids"] = fold_ids_host(ids, servable.model.config.vocab_size)
         if self._run_fn is not None:
             return self._run_fn(servable, arrays)
         fn, spec = self._jit_for(servable)
         if self.input_cache is not None:
             # Digest BEFORE packing: a content hit skips both the upload
             # and the pack (u24/bf16) work.
-            inputs = {
-                k: self.input_cache.get_or_put(
-                    k, v,
-                    pack=(lambda a, _k=k: pack_host({_k: a}, spec)[_k]) if spec else None,
-                    pack_tag=spec.get(k, "") if spec else "",
-                )
-                for k, v in arrays.items()
-            }
-            return fn(servable.params, inputs)
+            with request_trace.span("batch.cache"):
+                inputs = {
+                    k: self.input_cache.get_or_put(
+                        k, v,
+                        pack=(lambda a, _k=k: pack_host({_k: a}, spec)[_k]) if spec else None,
+                        pack_tag=spec.get(k, "") if spec else "",
+                    )
+                    for k, v in arrays.items()
+                }
+            with request_trace.span("batch.jitcall"):
+                return fn(servable.params, inputs)
         packed = pack_host(arrays, spec) if spec else arrays
-        return fn(servable.params, packed)
+        with request_trace.span("batch.jitcall"):
+            return fn(servable.params, packed)
 
     def _take(self) -> _WorkItem | None:
         """Pop the next live queued item, blocking; None on shutdown after
@@ -642,7 +665,12 @@ class DynamicBatcher:
                         batched[k] = parts[0]
                         continue
                     # Single allocation + one copy per part (no concat temporaries).
-                    out = np.empty((bucket,) + parts[0].shape[1:], parts[0].dtype)
+                    # Mixed dtypes (an int64 wire request coalesced with a
+                    # pre-folded int32 direct submit) widen, never wrap.
+                    dt = parts[0].dtype
+                    if any(p.dtype != dt for p in parts):
+                        dt = np.result_type(*(p.dtype for p in parts))
+                    out = np.empty((bucket,) + parts[0].shape[1:], dt)
                     off = 0
                     for p in parts:
                         out[off : off + p.shape[0]] = p

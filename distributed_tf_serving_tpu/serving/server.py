@@ -26,6 +26,7 @@ import grpc
 import jax
 
 from ..models import ModelConfig, Servable, ServableRegistry, build_model, ctr_signatures
+from ..client.client import LARGE_MESSAGE_CHANNEL_OPTIONS
 from ..proto import add_PredictionServiceServicer_to_server
 from ..utils.config import ServerConfig, load_config
 from ..utils.metrics import ServerMetrics
@@ -87,12 +88,81 @@ def create_server(
     """Build (not start) a server; returns (server, bound_port)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="rpc"),
-        options=[
-            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
-            ("grpc.max_send_message_length", 64 * 1024 * 1024),
-        ],
+        options=list(LARGE_MESSAGE_CHANNEL_OPTIONS),
     )
     servicer = GrpcPredictionService(impl, metrics)
+    add_PredictionServiceServicer_to_server(servicer, server)
+    port = server.add_insecure_port(address)
+    if port == 0:
+        raise RuntimeError(f"could not bind {address}")
+    return server, port
+
+
+class AioGrpcPredictionService:
+    """grpc.aio servicer adapter: one event-loop thread carries every
+    in-flight RPC instead of a handler thread each.
+
+    On a single-core serving host the thread-per-RPC model's GIL hand-offs
+    and context switches are a first-order cost (round-3 load experiment:
+    ~15% of achievable QPS at 64-way concurrency); the coroutine model keeps
+    the hot Predict path on one thread and awaits the batcher future. The
+    non-hot RPCs run their (cheap, synchronous) impl bodies inline on the
+    loop — their device work still rides the batcher queue asynchronously
+    only for Predict; Classify/Regress/MultiInference block the loop for
+    their batch, so coroutine servers are for Predict-dominant deployments
+    (the reference's entire workload is Predict, DCNClient.java:111-112).
+    """
+
+    def __init__(self, impl: PredictionServiceImpl, metrics: ServerMetrics | None = None):
+        self.impl = impl
+        self.metrics = metrics or ServerMetrics()
+
+    async def _call(self, name: str, fn, request, context):
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            resp = fn(request)
+            if hasattr(resp, "__await__"):
+                resp = await resp
+            ok = True
+            return resp
+        except ServiceError as e:
+            await context.abort(_status(e.code), str(e))
+        except grpc.aio.AbortError:
+            raise
+        except Exception as e:  # internal bug: surface as INTERNAL, keep serving
+            log.exception("internal error serving %s", name)
+            await context.abort(grpc.StatusCode.INTERNAL, f"internal error: {e}")
+        finally:
+            self.metrics.observe(name, time.perf_counter() - t0, ok)
+
+    async def Predict(self, request, context):
+        return await self._call("Predict", self.impl.predict_async, request, context)
+
+    async def Classify(self, request, context):
+        return await self._call("Classify", self.impl.classify, request, context)
+
+    async def Regress(self, request, context):
+        return await self._call("Regress", self.impl.regress, request, context)
+
+    async def MultiInference(self, request, context):
+        return await self._call("MultiInference", self.impl.multi_inference, request, context)
+
+    async def GetModelMetadata(self, request, context):
+        return await self._call("GetModelMetadata", self.impl.get_model_metadata, request, context)
+
+
+def create_server_async(
+    impl: PredictionServiceImpl,
+    address: str = "127.0.0.1:0",
+    metrics: ServerMetrics | None = None,
+) -> tuple["grpc.aio.Server", int]:
+    """Build (not start) a grpc.aio server; returns (server, bound_port).
+    Must be called from (or started on) the event loop that will own it."""
+    server = grpc.aio.server(
+        options=list(LARGE_MESSAGE_CHANNEL_OPTIONS),
+    )
+    servicer = AioGrpcPredictionService(impl, metrics)
     add_PredictionServiceServicer_to_server(servicer, server)
     port = server.add_insecure_port(address)
     if port == 0:

@@ -494,3 +494,125 @@ def test_fanout_failover_exhaustion_raises_last_host():
         asyncio.run(go())
     assert ei.value.host == dead[2]
     assert getattr(ei.value.code, "name", "") == "UNAVAILABLE"
+
+
+# ------------------------------------- aio server + prepared-request client
+
+
+def test_aio_server_prepared_and_plain_paths_match_golden():
+    """The coroutine server (create_server_async) + the prepared-bytes client
+    path must produce byte-identical scores to the threaded server + per-call
+    build path — same wire protocol, different machinery on both ends."""
+    from distributed_tf_serving_tpu.serving.server import create_server_async
+
+    registry = ServableRegistry()
+    servable = _servable(version=1, seed=0)
+    registry.load(servable)
+    batcher = DynamicBatcher(buckets=(32, 128), max_wait_us=0).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    arrays = _arrays(n=10, seed=21)
+    want = _golden(servable, arrays)
+
+    async def go():
+        server, port = create_server_async(impl, "127.0.0.1:0")
+        await server.start()
+        try:
+            async with ShardedPredictClient([f"127.0.0.1:{port}"], "DCN") as client:
+                plain = await client.predict(arrays)
+                prep = client.prepare(arrays)
+                prepared = await client.predict_prepared(prep)
+                prepared_sorted = await client.predict_prepared(prep, sort_scores=True)
+                return plain, prepared, prepared_sorted
+        finally:
+            await server.stop(0)
+
+    plain, prepared, prepared_sorted = asyncio.run(go())
+    np.testing.assert_allclose(plain, want, rtol=1e-6)
+    # Identical wire bytes through the identical server path: bitwise equal.
+    np.testing.assert_array_equal(prepared, plain)
+    np.testing.assert_array_equal(prepared_sorted, np.sort(plain))
+    batcher.stop()
+
+
+def test_aio_server_error_codes():
+    """ServiceError mapping must survive the coroutine adapter: unknown model
+    -> NOT_FOUND, malformed tensor -> INVALID_ARGUMENT."""
+    from distributed_tf_serving_tpu.serving.server import create_server_async
+
+    registry = ServableRegistry()
+    registry.load(_servable(version=1, seed=0))
+    batcher = DynamicBatcher(buckets=(32, 128), max_wait_us=0).start()
+    impl = PredictionServiceImpl(registry, batcher)
+
+    async def go():
+        import grpc.aio
+
+        server, port = create_server_async(impl, "127.0.0.1:0")
+        await server.start()
+        codes = []
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                from distributed_tf_serving_tpu.proto import PredictionServiceStub
+
+                stub = PredictionServiceStub(ch)
+                for req in (
+                    build_predict_request(_arrays(), "NOPE"),
+                    _bad_count_request(),
+                ):
+                    try:
+                        await stub.Predict(req, timeout=10)
+                        codes.append(None)
+                    except grpc.aio.AioRpcError as e:
+                        codes.append(e.code())
+        finally:
+            await server.stop(0)
+        return codes
+
+    def _bad_count_request():
+        bad = build_predict_request(_arrays(), "DCN", use_tensor_content=False)
+        bad.inputs["feat_ids"].int64_val.append(0)
+        return bad
+
+    codes = asyncio.run(go())
+    assert codes == [grpc.StatusCode.NOT_FOUND, grpc.StatusCode.INVALID_ARGUMENT]
+    batcher.stop()
+
+
+def test_prepared_request_against_threaded_server(three_backends):
+    """predict_prepared shards/merges exactly like predict() on a 3-host
+    fan-out (host-order merge parity), against the classic threaded server."""
+    servable = _servable(version=1, seed=0)
+    arrays = _arrays(n=10, seed=31)
+    want = _golden(servable, arrays)
+
+    async def go():
+        async with ShardedPredictClient(three_backends, "DCN") as client:
+            prep = client.prepare(arrays)
+            assert len(prep.shard_blobs) == 3 and prep.candidates == 10
+            return await client.predict_prepared(prep)
+
+    np.testing.assert_allclose(asyncio.run(go()), want, rtol=1e-6)
+
+
+def test_closed_loop_prepared_mode(three_backends):
+    payload = make_payload(candidates=30, num_fields=CFG.num_fields)
+
+    async def go():
+        async with ShardedPredictClient(three_backends, "DCN") as client:
+            return await run_closed_loop(
+                client, payload, concurrency=2, requests_per_worker=3,
+                warmup_requests=1, prepared=True,
+            )
+
+    report = asyncio.run(go())
+    assert report.requests == 6
+
+    async def prepared_pool_rejected():
+        async with ShardedPredictClient(three_backends, "DCN") as client:
+            await run_closed_loop(
+                client, payload, concurrency=1, requests_per_worker=1,
+                payload_pool=[payload], prepared=True,
+            )
+
+    with pytest.raises(ValueError):
+        asyncio.run(prepared_pool_rejected())
