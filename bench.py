@@ -674,26 +674,27 @@ def child_main() -> None:
                                f"concurrency={scale.concurrency} x "
                                f"{scale.requests_per_worker} (prepared wire bytes)")
                     before = dataclasses.replace(batcher.stats)
+                    request_trace.reset()  # phases are per-window, like stats
                     report_w = await loop(prepared=True)
+                    phases_w = {
+                        name: snap["mean_us"]
+                        for name, snap in request_trace.snapshot().items()
+                    }
                     windows.append(
-                        (cap, report_w, stats_delta(before, batcher.stats))
+                        (cap, report_w, stats_delta(before, batcher.stats), phases_w)
                     )
                     log(stage, f"window {w + 1} qps={report_w.summary()['qps']:.1f}")
                 res["windows_qps"] = [
                     {"batch_cap": cap, "qps": round(r.summary()["qps"], 1)}
-                    for cap, r, _st in windows
+                    for cap, r, _st, _ph in windows
                 ]
-                best_cap, res["report"], res["stats_rep"] = max(
+                best_cap, res["report"], res["stats_rep"], res["phases"] = max(
                     windows, key=lambda cr: cr[1].summary()["qps"]
                 )
                 res["best_batch_cap"] = best_cap
                 # Unique-traffic and overload phases run at the 8192 cap (the
                 # healthy-tunnel operating point).
                 batcher.max_batch_candidates = min(8192, batcher.buckets[-1])
-                res["phases"] = {
-                    name: snap["mean_us"]
-                    for name, snap in request_trace.snapshot().items()
-                }
                 request_trace.reset()  # per-loop phases: unique traffic differs
 
                 stage = "load_loop_unique"

@@ -26,7 +26,12 @@ import numpy as np
 
 from .. import codec
 from ..proto import serving_apis_pb2 as apis
-from ..proto.service_grpc import PredictionServiceStub
+# LARGE_MESSAGE_CHANNEL_OPTIONS re-exported: transport tuning lives with
+# the grpc wiring, but callers historically reach it through the client.
+from ..proto.service_grpc import (  # noqa: F401
+    LARGE_MESSAGE_CHANNEL_OPTIONS,
+    PredictionServiceStub,
+)
 from .partition import merge_host_order, shard_candidates
 
 
@@ -35,18 +40,6 @@ class PredictClientError(RuntimeError):
         super().__init__(f"Predict to {host} failed: {code} {details}")
         self.host = host
         self.code = code
-
-
-# Channel tuning for half-MB-per-request traffic. A 516 KB message spans 32
-# default-size (16 KB) HTTP/2 data frames, each with its own framing and
-# flow-control bookkeeping; one big frame cuts that to a single pass. The
-# same options are applied server-side (serving/server.py).
-LARGE_MESSAGE_CHANNEL_OPTIONS = (
-    ("grpc.max_receive_message_length", 64 * 1024 * 1024),
-    ("grpc.max_send_message_length", 64 * 1024 * 1024),
-    ("grpc.http2.max_frame_size", 1 * 1024 * 1024),
-    ("grpc.optimization_target", "throughput"),
-)
 
 
 @dataclasses.dataclass
@@ -212,7 +205,16 @@ class ShardedPredictClient:
         elif self.full_async:
             results = await asyncio.gather(*shard_coros)
         else:
-            results = [await c for c in shard_coros]
+            results = []
+            try:
+                for c in shard_coros:
+                    results.append(await c)
+            except BaseException:
+                # Close the not-yet-awaited tail so an early shard failure
+                # never leaves "coroutine was never awaited" warnings.
+                for c in shard_coros[len(results) + 1:]:
+                    c.close()
+                raise
         merged = merge_host_order(list(results))
         if sort_scores:
             merged = np.sort(merged)

@@ -1,0 +1,735 @@
+"""GraphDef executor: run arbitrary TF inference graphs natively in JAX.
+
+`tensorflow_model_server` executes whatever graph the SavedModel carries
+(reference surface: meta_graph.proto:31-87, graph.proto:14 — the repo vendors
+the IDL; this module supplies the execution semantics). The zoo importer
+(interop/savedmodel.py) binds weights onto a known architecture; this
+executor removes that boundary for exports whose architecture is NOT in the
+zoo: the exported GraphDef (main graph + FunctionDefLibrary) is interpreted
+node by node into a pure-JAX callable, then jitted per padded bucket like
+any zoo model — batching, versioning, and the wire protocol are unchanged.
+
+Scope (documented, enforced):
+- Inference dataflow ops (the table below: ~60 ops covering dense/embedding
+  CTR-style exports: MatMul/BiasAdd/activations/Gather/Reshape/Concat/
+  reductions/elementwise/StridedSlice/Select/Cast/Einsum/...).
+- TF2 function calls (PartitionedCall/StatefulPartitionedCall and direct
+  function-name ops) with captured variable handles, recursively.
+- Variables via VarHandleOp/ReadVariableOp (TF2) or VariableV2/Identity
+  (TF1), bound by shared_name / node name to extracted checkpoint values.
+- NOT supported (explicit UnsupportedOpError naming the node): control flow
+  (If/While/case), TensorList/TensorArray, stateful mutation
+  (AssignVariableOp in the serving path), sparse ops, string processing,
+  hash tables. These do not appear in dense CTR inference exports; an
+  export that needs them must be served by its original runtime.
+
+Numerics: executed under jax.enable_x64 when the graph carries int64/f64
+tensors (TF semantics are x64-native; silently downcasting hashed int64
+feature ids would corrupt embedding lookups past 2^31). The Model is marked
+needs_x64 so the batcher jits and calls it inside the context, and
+folds_ids_on_host=False so raw ids reach the graph unmodified.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import codec
+from ..models.base import Model, ModelConfig
+
+log = logging.getLogger("dts_tpu.graph_exec")
+
+
+class UnsupportedOpError(RuntimeError):
+    """The graph uses an op outside the executor's documented scope."""
+
+
+class GraphExecError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class VarRef:
+    """A resource handle flowing through the graph: resolves to params[key]
+    at ReadVariableOp / ResourceGather sites."""
+
+    key: str
+
+
+def _attr(node, name, default=None):
+    if name in node.attr:
+        return node.attr[name]
+    return default
+
+
+def _np_dtype(dt_enum: int) -> np.dtype:
+    return codec.dtype_to_numpy(dt_enum)
+
+
+def _const_value(node) -> np.ndarray:
+    tp = node.attr["value"].tensor
+    try:
+        return codec.to_ndarray(tp)
+    except codec.CodecError as exc:
+        # Consts may omit trailing repeated values (all-equal broadcast
+        # trimming, legal on the TF side); codec validates counts strictly,
+        # so repeat the last value out to the declared shape. ONLY for
+        # dtypes whose repeated field we know — fabricating zeros for an
+        # unhandled dtype would silently corrupt inference.
+        dims = tuple(d.size for d in tp.tensor_shape.dim)
+        np_dtype = _np_dtype(tp.dtype)
+        field = {
+            1: tp.float_val, 2: tp.double_val, 3: tp.int_val, 9: tp.int64_val,
+            10: tp.bool_val,
+        }.get(tp.dtype)
+        vals = np.asarray(list(field) if field is not None else [], np_dtype)
+        n = int(np.prod(dims)) if dims else 1
+        if field is None or vals.size == 0 or vals.size > n:
+            raise UnsupportedOpError(
+                f"Const node {node.name!r}: cannot decode dtype "
+                f"{tp.dtype} payload ({exc})"
+            ) from exc
+        if vals.size < n:
+            vals = np.concatenate([vals, np.repeat(vals[-1], n - vals.size)])
+        return vals.reshape(dims)
+
+
+def _concrete(x, what: str) -> np.ndarray:
+    """Require a trace-time-constant value (slice bounds, axes, shapes)."""
+    try:
+        return np.asarray(x)
+    except Exception as exc:  # jax tracers refuse __array__
+        raise UnsupportedOpError(
+            f"{what} must be a graph constant (got a traced value); dynamic "
+            "shapes/indices are outside the executor's scope"
+        ) from exc
+
+
+# --------------------------------------------------------------- op table
+# Each entry: fn(node, inputs) -> tuple of outputs. `inputs` are jnp arrays,
+# numpy constants, or VarRef. Single-output ops return a 1-tuple.
+
+
+def _reduce(name):
+    def run(node, inputs, xp):
+        x, axes = inputs[0], _concrete(inputs[1], "reduction axes")
+        keep = bool(_attr(node, "keep_dims").b) if _attr(node, "keep_dims") else False
+        # TF: an EMPTY reduction_indices tensor is a no-op (numpy agrees
+        # via axis=()); reduce-over-all is always an explicit Range.
+        axes_t = tuple(int(a) for a in np.atleast_1d(axes))
+        return (getattr(xp, name)(x, axis=axes_t, keepdims=keep),)
+
+    return run
+
+
+def _binop(name):
+    return lambda node, inputs, xp: (getattr(xp, name)(inputs[0], inputs[1]),)
+
+
+def _binfn(fn):
+    """Binary op given as an explicit callable (jnp-only semantics)."""
+    return lambda node, inputs, xp: (fn(inputs[0], inputs[1]),)
+
+
+def _unop(name):
+    return lambda node, inputs, xp: (getattr(xp, name)(inputs[0]),)
+
+
+def _unfn(fn):
+    """Unary op with jnp-only implementation (activations): fine staged —
+    activation outputs never legally feed shape positions."""
+    return lambda node, inputs, xp: (fn(inputs[0]),)
+
+
+def _matmul(node, inputs, xp):
+    a, b = inputs
+    ta = bool(_attr(node, "transpose_a").b) if _attr(node, "transpose_a") else False
+    tb = bool(_attr(node, "transpose_b").b) if _attr(node, "transpose_b") else False
+    a = a.T if ta else a
+    b = b.T if tb else b
+    return (xp.matmul(a, b),)
+
+
+def _batch_matmul(node, inputs, xp):
+    a, b = inputs
+    ta = bool(_attr(node, "adj_x").b) if _attr(node, "adj_x") else False
+    tb = bool(_attr(node, "adj_y").b) if _attr(node, "adj_y") else False
+    if ta:
+        a = xp.swapaxes(a, -1, -2)
+    if tb:
+        b = xp.swapaxes(b, -1, -2)
+    return (xp.matmul(a, b),)
+
+
+def _bias_add(node, inputs, xp):
+    x, b = inputs
+    fmt = _attr(node, "data_format")
+    if fmt is not None and fmt.s and fmt.s.decode() == "NCHW":
+        shape = [1] * x.ndim
+        shape[1] = b.shape[0]
+        return (x + b.reshape(shape),)
+    return (x + b,)
+
+
+def _cast(node, inputs, xp):
+    return (inputs[0].astype(_np_dtype(node.attr["DstT"].type)),)
+
+
+def _reshape(node, inputs, xp):
+    shape = [int(s) for s in _concrete(inputs[1], "Reshape shape")]
+    return (xp.reshape(inputs[0], shape),)
+
+
+def _concat_v2(node, inputs, xp):
+    axis = int(_concrete(inputs[-1], "ConcatV2 axis"))
+    return (xp.concatenate(inputs[:-1], axis=axis),)
+
+
+def _pack(node, inputs, xp):
+    axis = int(_attr(node, "axis").i) if _attr(node, "axis") else 0
+    return (xp.stack(inputs, axis=axis),)
+
+
+def _unpack(node, inputs, xp):
+    axis = int(_attr(node, "axis").i) if _attr(node, "axis") else 0
+    num = int(node.attr["num"].i)
+    parts = xp.split(inputs[0], num, axis=axis)
+    return tuple(xp.squeeze(p, axis=axis) for p in parts)
+
+
+def _expand_dims(node, inputs, xp):
+    return (xp.expand_dims(inputs[0], int(_concrete(inputs[1], "ExpandDims axis"))),)
+
+
+def _squeeze(node, inputs, xp):
+    dims = _attr(node, "squeeze_dims")
+    axes = tuple(int(i) for i in dims.list.i) if dims and dims.list.i else None
+    return (xp.squeeze(inputs[0], axis=axes),)
+
+
+def _transpose(node, inputs, xp):
+    perm = [int(p) for p in _concrete(inputs[1], "Transpose perm")]
+    return (xp.transpose(inputs[0], perm),)
+
+
+def _gather_v2(node, inputs, xp):
+    params, indices = inputs[0], inputs[1]
+    axis = int(_concrete(inputs[2], "GatherV2 axis")) if len(inputs) > 2 else 0
+    bd = _attr(node, "batch_dims")
+    batch_dims = int(bd.i) if bd else 0
+    if not batch_dims:
+        return (xp.take(params, indices, axis=axis),)
+    if batch_dims != axis:
+        raise UnsupportedOpError(
+            f"node {node.name!r}: GatherV2 with batch_dims={batch_dims} != "
+            f"axis={axis} not supported"
+        )
+    if indices.ndim == params.ndim:
+        return (xp.take_along_axis(params, indices, axis=axis),)
+    if indices.ndim == axis + 1 and params.ndim == axis + 2:
+        # The common batched embedding select: params [..B, N, D],
+        # indices [..B, K] -> [..B, K, D]; take_along_axis broadcasts the
+        # trailing unit dim over D.
+        out = xp.take_along_axis(params, indices[..., None], axis=axis)
+        return (out,)
+    raise UnsupportedOpError(
+        f"node {node.name!r}: GatherV2 batch_dims={batch_dims} with "
+        f"params rank {params.ndim} / indices rank {indices.ndim} not supported"
+    )
+
+
+def _resource_gather(node, inputs, params):
+    ref, indices = inputs[0], inputs[1]
+    if not isinstance(ref, VarRef):
+        raise GraphExecError("ResourceGather expects a variable handle input")
+    bd = _attr(node, "batch_dims")
+    if bd and bd.i:
+        raise UnsupportedOpError("ResourceGather with batch_dims not supported")
+    return (jnp.take(params[ref.key], indices, axis=0),)
+
+
+def _strided_slice(node, inputs, xp):
+    x = inputs[0]
+    begin = [int(v) for v in _concrete(inputs[1], "StridedSlice begin")]
+    end = [int(v) for v in _concrete(inputs[2], "StridedSlice end")]
+    strides = [int(v) for v in _concrete(inputs[3], "StridedSlice strides")]
+    bm = int(_attr(node, "begin_mask").i) if _attr(node, "begin_mask") else 0
+    em = int(_attr(node, "end_mask").i) if _attr(node, "end_mask") else 0
+    ellipsis = int(_attr(node, "ellipsis_mask").i) if _attr(node, "ellipsis_mask") else 0
+    new_axis = int(_attr(node, "new_axis_mask").i) if _attr(node, "new_axis_mask") else 0
+    shrink = int(_attr(node, "shrink_axis_mask").i) if _attr(node, "shrink_axis_mask") else 0
+
+    ndim = x.ndim
+    nspec = len(begin)
+    # Dims of x consumed by the spec = every entry that is neither a
+    # new-axis insertion nor the ellipsis itself; the ellipsis expands to
+    # however many full slices are left over (possibly zero).
+    consumed = sum(
+        1 for d in range(nspec)
+        if not (new_axis & (1 << d)) and not (ellipsis & (1 << d))
+    )
+    idx = []
+    for spec_dim in range(nspec):
+        bit = 1 << spec_dim
+        if ellipsis & bit:
+            idx.extend([slice(None)] * (ndim - consumed))
+            continue
+        if new_axis & bit:
+            idx.append(None)
+            continue
+        if shrink & bit:
+            idx.append(begin[spec_dim])
+            continue
+        b = None if bm & bit else begin[spec_dim]
+        e = None if em & bit else end[spec_dim]
+        s = strides[spec_dim]
+        idx.append(slice(b, e, s))
+    return (x[tuple(idx)],)
+
+
+def _slice(node, inputs, xp):
+    x = inputs[0]
+    begin = [int(v) for v in _concrete(inputs[1], "Slice begin")]
+    size = [int(v) for v in _concrete(inputs[2], "Slice size")]
+    idx = tuple(
+        slice(b, None if s == -1 else b + s) for b, s in zip(begin, size)
+    )
+    return (x[idx],)
+
+
+def _shape(node, inputs, xp):
+    out_type = _attr(node, "out_type")
+    dt = _np_dtype(out_type.type) if out_type else np.int32
+    return (np.asarray(inputs[0].shape, dt),)
+
+
+def _fill(node, inputs, xp):
+    dims = [int(d) for d in _concrete(inputs[0], "Fill dims")]
+    return (xp.full(dims, inputs[1]),)
+
+
+def _range(node, inputs, xp):
+    s, l, d = (_concrete(v, "Range input") for v in inputs)
+    return (np.arange(int(s), int(l), int(d), dtype=np.asarray(s).dtype),)
+
+
+def _softmax(node, inputs, xp):
+    return (jax.nn.softmax(inputs[0], axis=-1),)
+
+
+def _select(node, inputs, xp):
+    return (xp.where(inputs[0], inputs[1], inputs[2]),)
+
+
+def _clip(node, inputs, xp):
+    return (xp.clip(inputs[0], inputs[1], inputs[2]),)
+
+
+def _leaky_relu(node, inputs, xp):
+    alpha = _attr(node, "alpha")
+    return (jax.nn.leaky_relu(inputs[0], alpha.f if alpha else 0.2),)
+
+
+def _einsum(node, inputs, xp):
+    eq = node.attr["equation"].s.decode()
+    return (xp.einsum(eq, *inputs),)
+
+
+def _argmax(node, inputs, xp):
+    axis = int(_concrete(inputs[1], "ArgMax axis")) if len(inputs) > 1 else 0
+    ot = _attr(node, "output_type")
+    dt = _np_dtype(ot.type) if ot else np.int64
+    return (xp.argmax(inputs[0], axis=axis).astype(dt),)
+
+
+def _argmin(node, inputs, xp):
+    axis = int(_concrete(inputs[1], "ArgMin axis")) if len(inputs) > 1 else 0
+    ot = _attr(node, "output_type")
+    dt = _np_dtype(ot.type) if ot else np.int64
+    return (xp.argmin(inputs[0], axis=axis).astype(dt),)
+
+
+def _tile(node, inputs, xp):
+    reps = [int(r) for r in _concrete(inputs[1], "Tile multiples")]
+    return (xp.tile(inputs[0], reps),)
+
+
+def _top_k(node, inputs, xp):
+    k = int(_concrete(inputs[1], "TopKV2 k")) if len(inputs) > 1 else int(node.attr["k"].i)
+    vals, idxs = jax.lax.top_k(inputs[0], k)
+    return (vals, idxs.astype(np.int32))
+
+
+def _one_hot(node, inputs, xp):
+    depth = int(_concrete(inputs[1], "OneHot depth"))
+    on, off = inputs[2], inputs[3]
+    ax = _attr(node, "axis")
+    axis = int(ax.i) if ax else -1
+    hot = jax.nn.one_hot(inputs[0], depth, axis=axis, dtype=jnp.result_type(on))
+    return (hot * on + (1 - hot) * off,)
+
+
+_OPS = {
+    "MatMul": _matmul,
+    "BatchMatMul": _batch_matmul,
+    "BatchMatMulV2": _batch_matmul,
+    "BatchMatMulV3": _batch_matmul,
+    "BiasAdd": _bias_add,
+    "Add": _binop("add"),
+    "AddV2": _binop("add"),
+    "AddN": lambda node, inputs, xp: (sum(inputs[1:], inputs[0]),),
+    "Sub": _binop("subtract"),
+    "Mul": _binop("multiply"),
+    "RealDiv": _binop("divide"),
+    "Div": _binop("divide"),
+    "DivNoNan": _binfn(lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b))),
+    "FloorDiv": _binop("floor_divide"),
+    "FloorMod": _binop("mod"),
+    "Mod": _binop("mod"),
+    "Maximum": _binop("maximum"),
+    "Minimum": _binop("minimum"),
+    "Pow": _binop("power"),
+    "SquaredDifference": _binfn(lambda a, b: jnp.square(a - b)),
+    "Relu": _unfn(jax.nn.relu),
+    "Relu6": _unfn(jax.nn.relu6),
+    "LeakyRelu": _leaky_relu,
+    "Elu": _unfn(jax.nn.elu),
+    "Selu": _unfn(jax.nn.selu),
+    "Gelu": _unfn(jax.nn.gelu),
+    "Sigmoid": _unfn(jax.nn.sigmoid),
+    "Tanh": _unop("tanh"),
+    "Softplus": _unfn(jax.nn.softplus),
+    "Softsign": _unfn(jax.nn.soft_sign),
+    "Exp": _unop("exp"),
+    "Log": _unop("log"),
+    "Log1p": _unop("log1p"),
+    "Sqrt": _unop("sqrt"),
+    "Rsqrt": _unfn(lambda x: 1.0 / jnp.sqrt(x)),
+    "Square": _unop("square"),
+    "Abs": _unop("abs"),
+    "Neg": _unop("negative"),
+    "Sign": _unop("sign"),
+    "Erf": _unfn(jax.scipy.special.erf),
+    "Floor": _unop("floor"),
+    "Ceil": _unop("ceil"),
+    "Round": _unop("round"),
+    "Softmax": _softmax,
+    "LogSoftmax": lambda node, inputs, xp: (jax.nn.log_softmax(inputs[0], axis=-1),),
+    "Cast": _cast,
+    "Identity": lambda node, inputs, xp: (inputs[0],),
+    "StopGradient": lambda node, inputs, xp: (inputs[0],),
+    "PreventGradient": lambda node, inputs, xp: (inputs[0],),
+    "CheckNumerics": lambda node, inputs, xp: (inputs[0],),
+    "Snapshot": lambda node, inputs, xp: (inputs[0],),
+    "EnsureShape": lambda node, inputs, xp: (inputs[0],),
+    "IdentityN": lambda node, inputs, xp: tuple(inputs),
+    "Reshape": _reshape,
+    "ExpandDims": _expand_dims,
+    "Squeeze": _squeeze,
+    "Transpose": _transpose,
+    "ConcatV2": _concat_v2,
+    "Pack": _pack,
+    "Unpack": _unpack,
+    "StridedSlice": _strided_slice,
+    "Slice": _slice,
+    "Tile": _tile,
+    "Fill": _fill,
+    "ZerosLike": _unop("zeros_like"),
+    "OnesLike": _unop("ones_like"),
+    "Shape": _shape,
+    "Rank": lambda node, inputs, xp: (np.asarray(inputs[0].ndim, np.int32),),
+    "Size": lambda node, inputs, xp: (np.asarray(inputs[0].size, np.int32),),
+    "Range": _range,
+    "GatherV2": _gather_v2,
+    "Gather": lambda node, inputs, xp: (xp.take(inputs[0], inputs[1], axis=0),),
+    "Sum": _reduce("sum"),
+    "Mean": _reduce("mean"),
+    "Max": _reduce("max"),
+    "Min": _reduce("min"),
+    "Prod": _reduce("prod"),
+    "Any": _reduce("any"),
+    "All": _reduce("all"),
+    "ArgMax": _argmax,
+    "ArgMin": _argmin,
+    "Equal": _binop("equal"),
+    "NotEqual": _binop("not_equal"),
+    "Greater": _binop("greater"),
+    "GreaterEqual": _binop("greater_equal"),
+    "Less": _binop("less"),
+    "LessEqual": _binop("less_equal"),
+    "LogicalAnd": _binop("logical_and"),
+    "LogicalOr": _binop("logical_or"),
+    "LogicalNot": _unop("logical_not"),
+    "Select": _select,
+    "SelectV2": _select,
+    "Where": lambda node, inputs, xp: (_fail_where(),),
+    "ClipByValue": _clip,
+    "Einsum": _einsum,
+    "TopKV2": _top_k,
+    "OneHot": _one_hot,
+    "L2Loss": _unfn(lambda x: 0.5 * jnp.sum(jnp.square(x))),
+    "Rint": _unop("rint"),
+    "Cumsum": lambda node, inputs, xp: (
+        xp.cumsum(inputs[0], axis=int(_concrete(inputs[1], "Cumsum axis"))),
+    ),
+}
+
+_CALL_OPS = ("PartitionedCall", "StatefulPartitionedCall")
+
+
+def _fail_where():
+    raise UnsupportedOpError(
+        "Where (dynamic-shape output) is outside the executor's scope"
+    )
+
+
+class _FunctionLibrary:
+    def __init__(self, graph_def):
+        self.functions = {f.signature.name: f for f in graph_def.library.function}
+
+
+class _GraphEval:
+    """Evaluates the main GraphDef. Tensor refs: 'node', 'node:k', '^ctrl'."""
+
+    def __init__(self, nodes, lib, params, feeds):
+        self.nodes = nodes
+        self.lib = lib
+        self.params = params
+        self.feeds = feeds  # placeholder node name -> value
+        self.memo: dict[str, tuple] = {}
+
+    def tensor(self, ref: str):
+        if ref.startswith("^"):
+            return None
+        name, _, idx = ref.partition(":")
+        return self.node_outputs(name)[int(idx) if idx else 0]
+
+    def node_outputs(self, name: str) -> tuple:
+        if name in self.memo:
+            return self.memo[name]
+        node = self.nodes.get(name)
+        if node is None:
+            raise GraphExecError(f"graph references unknown node {name!r}")
+        out = _eval_node(node, self, self.lib, self.params)
+        self.memo[name] = out
+        return out
+
+
+class _FuncEval:
+    """Evaluates a FunctionDef body. Tensor refs: 'arg' (function input) or
+    'node:out_arg_name:k' (flat index k — valid for single-tensor output
+    args, which covers every op in the table)."""
+
+    def __init__(self, fdef, args, lib, params):
+        self.fdef = fdef
+        self.lib = lib
+        self.params = params
+        self.nodes = {n.name: n for n in fdef.node_def}
+        self.args = {
+            a.name: v for a, v in zip(fdef.signature.input_arg, args)
+        }
+        self.memo: dict[str, tuple] = {}
+
+    def tensor(self, ref: str):
+        if ref.startswith("^"):
+            return None
+        parts = ref.split(":")
+        if len(parts) == 1:
+            if parts[0] in self.args:
+                return self.args[parts[0]]
+            # A nullary node referenced bare (Const inside a function).
+            return self.node_outputs(parts[0])[0]
+        if len(parts) == 2:
+            # 'arg:0' style for function inputs.
+            if parts[0] in self.args:
+                return self.args[parts[0]]
+            return self.node_outputs(parts[0])[int(parts[1])]
+        name, _out_arg, idx = parts[0], parts[1], parts[2]
+        return self.node_outputs(name)[int(idx)]
+
+    def node_outputs(self, name: str) -> tuple:
+        if name in self.memo:
+            return self.memo[name]
+        node = self.nodes.get(name)
+        if node is None:
+            raise GraphExecError(
+                f"function {self.fdef.signature.name!r} references unknown node {name!r}"
+            )
+        out = _eval_node(node, self, self.lib, self.params)
+        self.memo[name] = out
+        return out
+
+    def results(self) -> tuple:
+        return tuple(
+            self.tensor(self.fdef.ret[o.name]) for o in self.fdef.signature.output_arg
+        )
+
+
+def _eval_node(node, env, lib, params) -> tuple:
+    op = node.op
+    if op == "Placeholder" or op == "PlaceholderWithDefault":
+        feeds = getattr(env, "feeds", None)
+        if feeds is not None and node.name in feeds:
+            return (feeds[node.name],)
+        if op == "PlaceholderWithDefault":
+            return (env.tensor(node.input[0]),)
+        raise GraphExecError(f"placeholder {node.name!r} was not fed")
+    if op == "Const":
+        return (_const_value(node),)
+    if op == "NoOp":
+        return ()
+    if op in ("VarHandleOp", "VariableV2", "VarIsInitializedOp"):
+        if op == "VarIsInitializedOp":
+            return (np.asarray(True),)
+        shared = _attr(node, "shared_name")
+        key = shared.s.decode() if shared is not None and shared.s else node.name
+        if key not in params and node.name in params:
+            key = node.name
+        return (VarRef(key),)
+    if op == "ReadVariableOp":
+        ref = env.tensor(node.input[0])
+        if not isinstance(ref, VarRef):
+            raise GraphExecError(f"{node.name}: ReadVariableOp on a non-handle input")
+        if ref.key not in params:
+            raise GraphExecError(
+                f"{node.name}: variable {ref.key!r} not found in extracted "
+                f"checkpoint values (have {sorted(params)[:8]}...)"
+            )
+        return (params[ref.key],)
+    if op == "ResourceGather":
+        inputs = [env.tensor(i) for i in node.input if not i.startswith("^")]
+        return _resource_gather(node, inputs, params)
+    if op in ("AssignVariableOp", "AssignAddVariableOp"):
+        raise UnsupportedOpError(
+            f"{node.name}: stateful variable mutation ({op}) in a serving "
+            "graph is outside the executor's scope"
+        )
+    if op in _CALL_OPS:
+        fname = node.attr["f"].func.name
+        return _call_function(fname, node, env, lib, params)
+    if op in lib.functions:
+        return _call_function(op, node, env, lib, params)
+    fn = _OPS.get(op)
+    if fn is None:
+        raise UnsupportedOpError(
+            f"node {node.name!r}: op {op!r} is outside the executor's scope "
+            "(see graph_exec.py module docstring for the supported set)"
+        )
+    inputs = [env.tensor(i) for i in node.input if not i.startswith("^")]
+    # Constant folding: inside a jit trace, jnp ops stage EVERYTHING (even
+    # all-constant inputs become tracers), which would destroy the
+    # concreteness that shape-arithmetic subgraphs (tf.shape -> Pack ->
+    # Reshape) require. When no input is traced, evaluate the node with
+    # numpy so its output stays a compile-time constant — exactly TF's own
+    # constant-folding behavior.
+    static = not any(isinstance(v, jax.core.Tracer) for v in inputs)
+    try:
+        return fn(node, inputs, np if static else jnp)
+    except (UnsupportedOpError, GraphExecError):
+        raise
+    except Exception as exc:  # name the node: anonymous shape errors are undebuggable
+        raise GraphExecError(
+            f"node {node.name!r} (op {op}): {type(exc).__name__}: {exc}"
+        ) from exc
+
+
+def _call_function(fname, node, env, lib, params) -> tuple:
+    fdef = lib.functions.get(fname)
+    if fdef is None:
+        raise GraphExecError(f"{node.name}: unknown function {fname!r}")
+    args = [env.tensor(i) for i in node.input if not i.startswith("^")]
+    want = len(fdef.signature.input_arg)
+    if len(args) != want:
+        raise GraphExecError(
+            f"{node.name}: function {fname!r} takes {want} args, got {len(args)}"
+        )
+    return _FuncEval(fdef, args, lib, params).results()
+
+
+# ------------------------------------------------------------- public API
+
+
+class GraphExecutor:
+    """Callable built from a MetaGraphDef signature: feeds placeholders,
+    walks the graph, returns the signature's outputs keyed by alias."""
+
+    def __init__(self, meta_graph, signature_name: str = "serving_default"):
+        if signature_name not in meta_graph.signature_def:
+            raise GraphExecError(
+                f"signature {signature_name!r} not in export; have "
+                f"{sorted(meta_graph.signature_def)}"
+            )
+        sig = meta_graph.signature_def[signature_name]
+        self.graph_def = meta_graph.graph_def
+        self.nodes = {n.name: n for n in self.graph_def.node}
+        self.lib = _FunctionLibrary(self.graph_def)
+        # alias -> (node_name, output_index)
+        def split(tname):
+            name, _, idx = tname.partition(":")
+            return name, int(idx) if idx else 0
+
+        self.input_nodes = {a: split(i.name)[0] for a, i in sig.inputs.items()}
+        self.outputs = {a: split(i.name) for a, i in sig.outputs.items()}
+        self.input_dtypes = {a: i.dtype for a, i in sig.inputs.items()}
+
+    def needs_x64(self, variables) -> bool:
+        wide = (9, 2)  # DT_INT64, DT_DOUBLE
+        if any(dt in wide for dt in self.input_dtypes.values()):
+            return True
+        return any(v.dtype in (np.int64, np.float64) for v in variables.values())
+
+    def __call__(self, params: dict[str, np.ndarray], batch: dict) -> dict:
+        feeds = {}
+        for alias, node_name in self.input_nodes.items():
+            if alias in batch:
+                feeds[node_name] = batch[alias]
+        ev = _GraphEval(self.nodes, self.lib, params, feeds)
+        return {
+            alias: ev.node_outputs(name)[idx]
+            for alias, (name, idx) in self.outputs.items()
+        }
+
+
+def graph_model(
+    meta_graph,
+    variables: dict[str, np.ndarray],
+    signature_name: str = "serving_default",
+    name: str = "imported",
+) -> tuple[Model, dict[str, np.ndarray]]:
+    """Build a servable Model executing the export's own graph.
+
+    Returns (model, params). params is the variables dict itself — the
+    model's pytree is flat {variable_key: array}."""
+    ex = GraphExecutor(meta_graph, signature_name)
+    sig = meta_graph.signature_def[signature_name]
+
+    # num_fields from the first 2-D int input when present (diagnostics and
+    # the Example decode path); fall back to the default.
+    num_fields = 0
+    for info in sig.inputs.values():
+        dims = [d.size for d in info.tensor_shape.dim]
+        if len(dims) == 2 and dims[1] > 0:
+            num_fields = int(dims[1])
+            break
+    config = ModelConfig(name=name, num_fields=num_fields or 43)
+
+    def init(rng):
+        raise GraphExecError(
+            "graph-executor models carry imported variables; init() is not "
+            "available (no architecture to initialize)"
+        )
+
+    model = Model(
+        config=config,
+        init=init,
+        apply=ex,
+        wts_in_compute_dtype=False,
+        folds_ids_on_host=False,
+        needs_x64=ex.needs_x64(variables),
+    )
+    return model, dict(variables)

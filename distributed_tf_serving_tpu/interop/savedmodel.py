@@ -154,6 +154,53 @@ def extract_variables(saved_model_dir, out_npz, python: str = sys.executable) ->
     return out_npz
 
 
+# Graph-executor binding needs variables keyed by the serving graph's
+# VarHandleOp shared_name (what ReadVariableOp resolves), not by checkpoint
+# object paths; tf.saved_model.load restores variables under exactly those
+# names (verified against tf 2.21 exports), so the loaded signature graph is
+# the authoritative name source.
+_EXTRACT_GRAPH_SCRIPT = textwrap.dedent(
+    """
+    import sys
+    import numpy as np
+    import tensorflow as tf
+
+    src, out, sig_name = sys.argv[1], sys.argv[2], sys.argv[3]
+    obj = tf.saved_model.load(src)
+    f = obj.signatures[sig_name] if sig_name in obj.signatures else (
+        next(iter(obj.signatures.values()))
+    )
+    arrays = {}
+    for v in f.graph.variables:
+        arrays[v.name.split(":")[0]] = v.numpy()
+    np.savez(out, **arrays)
+    print(f"extracted {len(arrays)} graph variables")
+    """
+)
+
+
+def extract_graph_variables(
+    saved_model_dir, out_npz, signature_name: str = "serving_default",
+    python: str = sys.executable,
+) -> pathlib.Path:
+    """Dump the serving signature's variables keyed by shared_name (the
+    graph-executor binding) via a TensorFlow subprocess."""
+    out_npz = pathlib.Path(out_npz)
+    proc = subprocess.run(
+        [python, "-c", _EXTRACT_GRAPH_SCRIPT, str(saved_model_dir), str(out_npz),
+         signature_name],
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    if proc.returncode != 0:
+        raise SavedModelImportError(
+            f"graph-variable extraction failed (is tensorflow importable by "
+            f"{python}?):\n{proc.stderr.strip()[-2000:]}"
+        )
+    return out_npz
+
+
 def _clean_name(name: str) -> str:
     return name[: -len(_ATTR_SUFFIX)] if name.endswith(_ATTR_SUFFIX) else name
 
@@ -582,6 +629,90 @@ def _npz_cache_fresh(saved_model_dir, npz_path) -> bool:
 # ----------------------------------------------------------------- import
 
 
+def _graph_servable(
+    saved_model_dir, meta_graph, signatures, name, version, python
+) -> Servable:
+    """Servable executing the export's own GraphDef (interop/graph_exec.py).
+
+    Variables are extracted keyed by VarHandleOp shared_name (a separate
+    cache from the object-path npz used for zoo binding), and the executor
+    is validated with an EAGER two-row dry run at import time — an
+    unsupported op fails the load with its node name, never a live request.
+    """
+    from .graph_exec import graph_model
+
+    # ONE signature choice threaded through extraction, executor build, and
+    # the dry-run probe (they could otherwise disagree on a multi-signature
+    # export, or fail outright on an export without 'serving_default').
+    if "serving_default" in meta_graph.signature_def:
+        sig_name = "serving_default"
+    else:
+        served = [
+            k for k in meta_graph.signature_def
+            if not k.startswith("__")  # skip __saved_model_init_op etc.
+        ]
+        if not served:
+            raise SavedModelImportError(
+                f"{saved_model_dir} exports no servable signatures"
+            )
+        sig_name = sorted(served)[0]
+
+    cache = _default_npz_cache_path(saved_model_dir)
+    cache = cache.with_name(cache.stem + "-graph.npz")
+    if _npz_cache_fresh(saved_model_dir, cache):
+        log.info("reusing extracted graph-variables cache %s", cache)
+    else:
+        extract_graph_variables(
+            saved_model_dir, cache, signature_name=sig_name, python=python
+        )
+    with np.load(cache) as npz:
+        variables = {k: npz[k] for k in npz.files}
+
+    model, params = graph_model(
+        meta_graph, variables, signature_name=sig_name, name=name
+    )
+
+    import contextlib
+
+    import jax
+
+    from .. import codec as _codec
+
+    sig = signatures[sig_name] if sig_name in signatures else (
+        next(iter(signatures.values()))
+    )
+    # Placeholder shape attrs fill in what the SignatureDef leaves unknown:
+    # skipping an unknown-rank input would leave its placeholder unfed and
+    # fail the probe for an export the serving path handles fine.
+    pnodes = {n.name: n for n in meta_graph.graph_def.node}
+    probe = {}
+    for spec in sig.inputs:
+        shape = spec.shape
+        if shape is None:
+            node = pnodes.get(model.apply.input_nodes.get(spec.name, ""))
+            if node is not None and "shape" in node.attr and not (
+                node.attr["shape"].shape.unknown_rank
+            ):
+                shape = tuple(
+                    None if d.size < 0 else d.size
+                    for d in node.attr["shape"].shape.dim
+                )
+            else:
+                shape = (None,)  # last resort: a flat 1-D probe
+        dims = (2,) + tuple(d or 1 for d in shape[1:]) if shape else (2,)
+        probe[spec.name] = np.zeros(dims, _codec.dtype_to_numpy(spec.dtype))
+    ctx = jax.enable_x64() if model.needs_x64 else contextlib.nullcontext()
+    with ctx:
+        outputs = model.apply(params, probe)  # eager: no compile cost
+    log.info(
+        "graph executor serves %s: %d variables, outputs %s",
+        saved_model_dir, len(params), sorted(outputs),
+    )
+    return Servable(
+        name=name, version=version, model=model, params=params, signatures=signatures
+    )
+
+
 def import_savedmodel(
     saved_model_dir,
     kind: str,
@@ -613,6 +744,13 @@ def import_savedmodel(
 
     meta_graph = serve_meta_graph(read_saved_model(saved_model_dir))
     signatures = signatures_from_meta_graph(meta_graph)
+    if kind == "graph":
+        # Explicit graph-executor serving: run the export's own GraphDef
+        # (interop/graph_exec.py) instead of binding weights onto a zoo
+        # family.
+        return _graph_servable(
+            saved_model_dir, meta_graph, signatures, name, version, python
+        )
     _check_signature_aliases(signatures, kind, config)
 
     if variables_npz is None:
@@ -648,18 +786,32 @@ def import_savedmodel(
             template = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(0)))
             params = map_variables(variables, template, generic_mapping)
         except SavedModelImportError as exc2:
-            from ..models.base import model_kinds
+            # Last resort: execute the export's own graph. Slower than a
+            # zoo forward (no host fold / transfer compression, x64 ids)
+            # but serves ANY architecture within the executor's op set.
+            try:
+                servable = _graph_servable(
+                    saved_model_dir, meta_graph, signatures, name, version, python
+                )
+            except Exception as exc3:  # noqa: BLE001 — fold into the ranked error
+                from ..models.base import model_kinds
 
-            raise SavedModelImportError(
-                f"export at {saved_model_dir} matches no native family.\n"
-                f"- as requested kind {kind!r}: {exc}\n"
-                f"- as the generic embed+MLP fallback: {exc2}\n"
-                f"Supported families: {sorted(model_kinds())}. Re-export in "
-                "one of these architectures, or pass an explicit "
-                "{param-path: variable-name} mapping; arbitrary GraphDef "
-                "execution is outside this framework's import boundary "
-                "(SURVEY.md §7)."
-            ) from exc
+                raise SavedModelImportError(
+                    f"export at {saved_model_dir} could not be served.\n"
+                    f"- as requested kind {kind!r}: {exc}\n"
+                    f"- as the generic embed+MLP fallback: {exc2}\n"
+                    f"- via the GraphDef executor: {exc3}\n"
+                    f"Native families: {sorted(model_kinds())}. Re-export in "
+                    "one of these architectures, pass an explicit "
+                    "{param-path: variable-name} mapping, or keep the "
+                    "export's graph inside the executor's documented op set "
+                    "(interop/graph_exec.py)."
+                ) from exc
+            log.warning(
+                "export did not bind to %r (%s) nor the generic fallback "
+                "(%s); serving via the GraphDef executor", kind, exc, exc2,
+            )
+            return servable
         log.warning(
             "export did not bind to %r (%s); serving via the generic "
             "embed+MLP fallback: num_fields=%d embed_dim=%d mlp_dims=%s",

@@ -83,6 +83,14 @@ class Model:
     init: Callable[[jax.Array], Params]
     apply: Callable[[Params, Batch], dict[str, jax.Array]]
     wts_in_compute_dtype: bool = True
+    # False for graph-executor models (interop/graph_exec.py): the imported
+    # graph consumes RAW int64 ids (its own hashing/mod/lookup semantics),
+    # so the batcher must not vocab-fold them on host.
+    folds_ids_on_host: bool = True
+    # True when the model's graph carries int64/float64 tensors that JAX's
+    # default 32-bit canonicalization would silently corrupt; the batcher
+    # traces AND calls such models inside jax.enable_x64().
+    needs_x64: bool = False
 
 
 # ---------------------------------------------------------------------------

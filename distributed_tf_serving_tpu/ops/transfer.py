@@ -35,7 +35,9 @@ def transfer_spec(model: Model) -> dict[str, str]:
     """Per-input packing spec for a model; keys absent = pass-through."""
     config = model.config
     spec: dict[str, str] = {}
-    if config.vocab_size <= U24_MAX:
+    if config.vocab_size <= U24_MAX and model.folds_ids_on_host:
+        # u24 presumes host-folded int32 ids; graph-executor models ship
+        # raw int64 ids to the device untouched.
         spec["feat_ids"] = "u24"
     if config.compute_dtype == "bfloat16" and model.wts_in_compute_dtype:
         spec["feat_wts"] = "bf16"

@@ -17,6 +17,18 @@ from . import serving_apis_pb2 as apis
 
 SERVICE_NAME = "tensorflow.serving.PredictionService"
 
+# Channel/server tuning for half-MB-per-request traffic, shared by the
+# client (client/client.py) and both server factories (serving/server.py).
+# A 516 KB message spans 32 default-size (16 KB) HTTP/2 data frames, each
+# with its own framing and flow-control bookkeeping; one big frame cuts
+# that to a single pass.
+LARGE_MESSAGE_CHANNEL_OPTIONS = (
+    ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+    ("grpc.max_send_message_length", 64 * 1024 * 1024),
+    ("grpc.http2.max_frame_size", 1 * 1024 * 1024),
+    ("grpc.optimization_target", "throughput"),
+)
+
 # method name -> (request class, response class); order matches the reference
 # service definition.
 _METHODS = {

@@ -32,6 +32,7 @@ thread — one stream of dispatches, no device-side contention.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import threading
@@ -50,6 +51,9 @@ from ..ops.transfer import pack_host, transfer_spec, unpack_device
 from ..utils.tracing import request_trace
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# Reusable (stateless) no-op context for the non-x64 hot path.
+_NULL_CTX = contextlib.nullcontext()
 
 
 class BatchTooLargeError(ValueError):
@@ -131,9 +135,12 @@ def prepare_inputs(
     JAX and re-fold into garbage for ids past 2^31."""
     out = {}
     for key, arr in arrays.items():
-        if key == "feat_ids" and fold_ids:
+        if key == "feat_ids" and fold_ids and model.folds_ids_on_host:
             out[key] = fold_ids_host(arr, model.config.vocab_size)
-        elif arr.dtype == np.float64:
+        elif arr.dtype == np.float64 and not model.needs_x64:
+            # Convenience downcast for the 32-bit zoo path only: an x64
+            # model (graph executor with DT_DOUBLE inputs) must see the
+            # doubles it was exported with.
             out[key] = arr.astype(np.float32)
         elif _immutably_backed(arr):
             out[key] = arr
@@ -528,13 +535,24 @@ class DynamicBatcher:
                 fn = jax.jit(lambda params, packed: apply(params, unpack_device(packed, spec)))
             else:
                 fn = jax.jit(apply)
+            if servable.model.needs_x64:
+                # Trace AND call inside enable_x64: graph-executor models
+                # (interop/graph_exec.py) carry int64 feature ids that the
+                # default 32-bit canonicalization would silently truncate at
+                # the jit boundary — before the graph's own hashing/mod runs.
+                base = fn
+
+                def fn(params, batch, _base=base):
+                    with jax.enable_x64():
+                        return _base(params, batch)
+
             entry = (fn, spec)
             self._jitted[servable] = entry
         return entry
 
     def _execute(self, servable: Servable, arrays: dict[str, np.ndarray]):
         ids = arrays.get("feat_ids")
-        if ids is not None and ids.dtype == np.int64:
+        if ids is not None and ids.dtype == np.int64 and servable.model.folds_ids_on_host:
             # Deferred per-request fold (prepare_inputs fold_ids=False):
             # one native fold over the whole padded batch. Runs BEFORE the
             # content digest, so cache keys are over the same folded bytes
@@ -544,23 +562,28 @@ class DynamicBatcher:
         if self._run_fn is not None:
             return self._run_fn(servable, arrays)
         fn, spec = self._jit_for(servable)
-        if self.input_cache is not None:
-            # Digest BEFORE packing: a content hit skips both the upload
-            # and the pack (u24/bf16) work.
-            with request_trace.span("batch.cache"):
-                inputs = {
-                    k: self.input_cache.get_or_put(
-                        k, v,
-                        pack=(lambda a, _k=k: pack_host({_k: a}, spec)[_k]) if spec else None,
-                        pack_tag=spec.get(k, "") if spec else "",
-                    )
-                    for k, v in arrays.items()
-                }
+        # x64 models need the context around the UPLOADS too: device_put
+        # (inside the input cache) canonicalizes, and an int64 batch put
+        # outside the context reaches the x64-traced executable as int32.
+        ctx = jax.enable_x64() if servable.model.needs_x64 else _NULL_CTX
+        with ctx:
+            if self.input_cache is not None:
+                # Digest BEFORE packing: a content hit skips both the upload
+                # and the pack (u24/bf16) work.
+                with request_trace.span("batch.cache"):
+                    inputs = {
+                        k: self.input_cache.get_or_put(
+                            k, v,
+                            pack=(lambda a, _k=k: pack_host({_k: a}, spec)[_k]) if spec else None,
+                            pack_tag=spec.get(k, "") if spec else "",
+                        )
+                        for k, v in arrays.items()
+                    }
+                with request_trace.span("batch.jitcall"):
+                    return fn(servable.params, inputs)
+            packed = pack_host(arrays, spec) if spec else arrays
             with request_trace.span("batch.jitcall"):
-                return fn(servable.params, inputs)
-        packed = pack_host(arrays, spec) if spec else arrays
-        with request_trace.span("batch.jitcall"):
-            return fn(servable.params, packed)
+                return fn(servable.params, packed)
 
     def _take(self) -> _WorkItem | None:
         """Pop the next live queued item, blocking; None on shutdown after

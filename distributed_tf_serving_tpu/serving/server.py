@@ -26,7 +26,7 @@ import grpc
 import jax
 
 from ..models import ModelConfig, Servable, ServableRegistry, build_model, ctr_signatures
-from ..client.client import LARGE_MESSAGE_CHANNEL_OPTIONS
+from ..proto.service_grpc import LARGE_MESSAGE_CHANNEL_OPTIONS
 from ..proto import add_PredictionServiceServicer_to_server
 from ..utils.config import ServerConfig, load_config
 from ..utils.metrics import ServerMetrics

@@ -137,6 +137,32 @@ class PredictionServiceImpl:
             raise ServiceError("INVALID_ARGUMENT", "empty candidate batch")
         return arrays
 
+    # Bounded wait: a wedged batcher must not permanently consume an RPC
+    # handler thread / event-loop slot (first compile of a large bucket
+    # through a remote-compile path can legitimately take tens of seconds).
+    _BATCH_DEADLINE_S = 120.0
+
+    @staticmethod
+    def _translate_batcher_error(exc: Exception, fut) -> ServiceError:
+        """ONE mapping from batcher failures to RPC status for both the
+        threaded (_run) and coroutine (_run_async) paths — they must never
+        return different codes for the same failure. Re-raises anything
+        that is not a batcher failure."""
+        if isinstance(exc, (BatchTooLargeError, QueueOverloadError)):
+            return ServiceError("RESOURCE_EXHAUSTED", str(exc))
+        if isinstance(exc, DeviceWedgedError):
+            return ServiceError("UNAVAILABLE", str(exc))
+        if isinstance(exc, TimeoutError):
+            # Withdraw the work: a cancelled item is skipped by the batcher,
+            # so an abandoned deadline never turns into a zombie dispatch
+            # that delays everyone behind it.
+            if fut is not None:
+                fut.cancel()
+            return ServiceError("DEADLINE_EXCEEDED", "batch execution timed out")
+        if isinstance(exc, RuntimeError):
+            return ServiceError("UNAVAILABLE", str(exc))
+        raise exc
+
     def _run(
         self,
         servable: Servable,
@@ -145,26 +171,10 @@ class PredictionServiceImpl:
     ) -> dict[str, np.ndarray]:
         fut = None
         try:
-            # Bounded wait: a wedged batcher must not permanently consume an
-            # RPC handler thread (first compile of a large bucket through a
-            # remote-compile path can legitimately take tens of seconds).
             fut = self.batcher.submit(servable, arrays, output_keys=output_keys)
-            return fut.result(timeout=120.0)
-        except BatchTooLargeError as e:
-            raise ServiceError("RESOURCE_EXHAUSTED", str(e)) from e
-        except QueueOverloadError as e:
-            raise ServiceError("RESOURCE_EXHAUSTED", str(e)) from e
-        except DeviceWedgedError as e:
-            raise ServiceError("UNAVAILABLE", str(e)) from e
-        except TimeoutError as e:
-            # Withdraw the work: a cancelled item is skipped by the batcher,
-            # so an abandoned deadline never turns into a zombie dispatch
-            # that delays everyone behind it.
-            if fut is not None:
-                fut.cancel()
-            raise ServiceError("DEADLINE_EXCEEDED", "batch execution timed out") from e
-        except RuntimeError as e:
-            raise ServiceError("UNAVAILABLE", str(e)) from e
+            return fut.result(timeout=self._BATCH_DEADLINE_S)
+        except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
+            raise self._translate_batcher_error(e, fut) from e
 
     async def _run_async(
         self,
@@ -183,19 +193,11 @@ class PredictionServiceImpl:
         fut = None
         try:
             fut = self.batcher.submit(servable, arrays, output_keys=output_keys)
-            return await asyncio.wait_for(asyncio.wrap_future(fut), timeout=120.0)
-        except BatchTooLargeError as e:
-            raise ServiceError("RESOURCE_EXHAUSTED", str(e)) from e
-        except QueueOverloadError as e:
-            raise ServiceError("RESOURCE_EXHAUSTED", str(e)) from e
-        except DeviceWedgedError as e:
-            raise ServiceError("UNAVAILABLE", str(e)) from e
-        except (TimeoutError, asyncio.TimeoutError) as e:
-            if fut is not None:
-                fut.cancel()
-            raise ServiceError("DEADLINE_EXCEEDED", "batch execution timed out") from e
-        except RuntimeError as e:
-            raise ServiceError("UNAVAILABLE", str(e)) from e
+            return await asyncio.wait_for(
+                asyncio.wrap_future(fut), timeout=self._BATCH_DEADLINE_S
+            )
+        except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
+            raise self._translate_batcher_error(e, fut) from e
 
     def _predict_prepare(self, request: apis.PredictRequest):
         """Shared front half of Predict: resolution, decode/validation,

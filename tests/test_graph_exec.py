@@ -1,0 +1,258 @@
+"""GraphDef-executor tests (C13: arbitrary-export execution).
+
+Real `tf.saved_model.save` exports are built in TensorFlow subprocesses (TF
+must never be imported in this process — its generated protos collide with
+the vendored bindings in the descriptor pool), then served natively by
+interop/graph_exec.py: eager parity vs TF's own forward, the full
+gRPC-serving path with int64 ids past 2^31 (the x64 jit path), the
+zoo -> generic -> graph fallback chain, and the documented unsupported-op
+boundary.
+"""
+
+import asyncio
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_tf_serving_tpu.client import ShardedPredictClient
+from distributed_tf_serving_tpu.interop.graph_exec import (
+    GraphExecutor,
+    UnsupportedOpError,
+    graph_model,
+)
+from distributed_tf_serving_tpu.interop.savedmodel import (
+    import_savedmodel,
+    read_saved_model,
+    serve_meta_graph,
+)
+from distributed_tf_serving_tpu.models import ModelConfig, ServableRegistry
+from distributed_tf_serving_tpu.serving import DynamicBatcher, PredictionServiceImpl
+from distributed_tf_serving_tpu.serving.server import create_server
+
+F = 6  # fields
+
+# An architecture deliberately OUTSIDE the zoo and the generic embed+MLP
+# fallback: field-attention pooling (softmax over a learned field score),
+# an einsum bilinear term, a residual tanh block, and a clipped output.
+_EXPORT_EXOTIC = f"""
+import sys
+import numpy as np
+import tensorflow as tf
+
+out = sys.argv[1]
+F = {F}
+D = 8
+rng = np.random.RandomState(11)
+
+
+class Exotic(tf.Module):
+    def __init__(self):
+        super().__init__()
+        self.emb = tf.Variable(rng.randn(997, D).astype(np.float32), name="emb")
+        self.attn = tf.Variable(rng.randn(D, 1).astype(np.float32), name="attn")
+        self.bilinear = tf.Variable(rng.randn(D, D).astype(np.float32) / 8.0, name="bilinear")
+        self.w1 = tf.Variable(rng.randn(D, D).astype(np.float32) / 4.0, name="w1")
+        self.b1 = tf.Variable(np.zeros(D, np.float32), name="b1")
+        self.w2 = tf.Variable(rng.randn(2 * D, 1).astype(np.float32) / 4.0, name="w2")
+
+    @tf.function(input_signature=[
+        tf.TensorSpec([None, F], tf.int64, name="feat_ids"),
+        tf.TensorSpec([None, F], tf.float32, name="feat_wts"),
+    ])
+    def __call__(self, feat_ids, feat_wts):
+        e = tf.gather(self.emb, tf.math.floormod(feat_ids, 997))     # [n,F,D]
+        e = e * feat_wts[..., None]
+        scores = tf.squeeze(tf.einsum("nfd,dk->nfk", e, self.attn), -1)  # [n,F]
+        alpha = tf.nn.softmax(scores, axis=-1)                       # [n,F]
+        pooled = tf.reduce_sum(e * alpha[..., None], axis=1)         # [n,D]
+        bil = tf.einsum("nd,de,ne->n", pooled, self.bilinear, pooled)
+        h = tf.nn.tanh(tf.matmul(pooled, self.w1) + self.b1) + pooled
+        feats = tf.concat([h, pooled], axis=-1)
+        logit = tf.squeeze(tf.matmul(feats, self.w2), -1) + bil
+        p = tf.clip_by_value(tf.sigmoid(logit), 1e-6, 1.0 - 1e-6)
+        return {{"prediction_node": p}}
+
+
+m = Exotic()
+tf.saved_model.save(m, out, signatures={{"serving_default": m.__call__}})
+"""
+
+_GOLDEN = """
+import sys, json
+import numpy as np
+import tensorflow as tf
+
+src, seed, n, F = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+rng = np.random.RandomState(seed)
+ids = rng.randint(0, 1 << 40, size=(n, F)).astype(np.int64)
+wts = rng.rand(n, F).astype(np.float32)
+f = tf.saved_model.load(src).signatures["serving_default"]
+out = f(feat_ids=tf.constant(ids), feat_wts=tf.constant(wts))
+print(json.dumps([float(x) for x in out["prediction_node"].numpy()]))
+"""
+
+
+def _payload(n, seed):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, 1 << 40, size=(n, F)).astype(np.int64),
+        "feat_wts": rng.rand(n, F).astype(np.float32),
+    }
+
+
+def _tf_golden(export_dir, seed, n):
+    r = subprocess.run(
+        [sys.executable, "-c", _GOLDEN, str(export_dir), str(seed), str(n), str(F)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    return np.asarray(json.loads(r.stdout.strip().splitlines()[-1]), np.float32)
+
+
+@pytest.fixture(scope="module")
+def exotic_export(tmp_path_factory):
+    out = tmp_path_factory.mktemp("sm") / "exotic"
+    r = subprocess.run(
+        [sys.executable, "-c", _EXPORT_EXOTIC, str(out)],
+        capture_output=True, text=True, timeout=600,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"tensorflow export unavailable: {r.stderr[-800:]}")
+    return out
+
+
+def test_graph_executor_matches_tf_forward(exotic_export):
+    sv = import_savedmodel(
+        exotic_export, "graph", ModelConfig(name="EX", num_fields=F), name="EX"
+    )
+    assert sv.model.needs_x64 and not sv.model.folds_ids_on_host
+    arrays = _payload(12, seed=5)
+    with jax.enable_x64():
+        out = sv.model.apply(sv.params, arrays)
+    got = np.asarray(out["prediction_node"], np.float32)
+    want = _tf_golden(exotic_export, seed=5, n=12)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_graph_servable_over_wire_preserves_int64(exotic_export):
+    """Full stack: batcher pad (no fold), x64 jit, gRPC round trip. Ids are
+    drawn past 2^31 so any silent int32 truncation would shift embedding
+    rows and break parity with TF's forward."""
+    sv = import_savedmodel(
+        exotic_export, "graph", ModelConfig(name="EX", num_fields=F), name="EX"
+    )
+    registry = ServableRegistry()
+    registry.load(sv)
+    batcher = DynamicBatcher(buckets=(32, 64), max_wait_us=0).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    server, port = create_server(impl, "127.0.0.1:0")
+    server.start()
+    try:
+        arrays = _payload(10, seed=9)
+
+        async def go():
+            async with ShardedPredictClient([f"127.0.0.1:{port}"], "EX") as client:
+                return await client.predict(arrays)
+
+        got = asyncio.run(go())
+        want = _tf_golden(exotic_export, seed=9, n=10)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+    finally:
+        server.stop(0)
+        batcher.stop()
+
+
+def test_fallback_chain_lands_on_graph_executor(exotic_export, caplog):
+    """kind=dcn_v2 cannot bind the exotic export, the generic embed+MLP
+    fallback cannot either; the importer must land on the graph executor
+    (not an error) and serve correct scores."""
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="dts_tpu.interop"):
+        sv = import_savedmodel(
+            exotic_export, "dcn_v2",
+            ModelConfig(name="EX", num_fields=F, vocab_size=997, embed_dim=8),
+            name="EX",
+        )
+    assert not sv.model.folds_ids_on_host  # graph executor, not a zoo family
+    arrays = _payload(6, seed=13)
+    with jax.enable_x64():
+        got = np.asarray(sv.model.apply(sv.params, arrays)["prediction_node"], np.float32)
+    np.testing.assert_allclose(got, _tf_golden(exotic_export, seed=13, n=6),
+                               rtol=2e-5, atol=1e-6)
+    assert any("GraphDef executor" in r.message for r in caplog.records)
+
+
+def test_unsupported_op_is_named():
+    """A graph using control flow must fail at import with the node name
+    and op, per the documented executor boundary."""
+    from distributed_tf_serving_tpu.proto import tf_meta_graph_pb2 as mg
+
+    meta = mg.MetaGraphDef()
+    sig = meta.signature_def["serving_default"]
+    sig.inputs["x"].name = "x:0"
+    sig.inputs["x"].dtype = 1
+    sig.outputs["y"].name = "loop:0"
+    sig.outputs["y"].dtype = 1
+    n = meta.graph_def.node.add()
+    n.name = "x"
+    n.op = "Placeholder"
+    n = meta.graph_def.node.add()
+    n.name = "loop"
+    n.op = "While"
+    n.input.append("x")
+
+    model, params = graph_model(meta, {}, name="bad")
+    with pytest.raises(UnsupportedOpError, match="loop.*While|While.*loop"):
+        model.apply(params, {"x": np.ones((2,), np.float32)})
+
+
+def test_executor_rejects_unknown_signature(exotic_export):
+    meta = serve_meta_graph(read_saved_model(exotic_export))
+    with pytest.raises(Exception, match="nope"):
+        GraphExecutor(meta, "nope")
+
+
+_EXPORT_CUSTOM_SIG = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+out = sys.argv[1]
+rng = np.random.RandomState(3)
+
+
+class Tiny(tf.Module):
+    def __init__(self):
+        super().__init__()
+        self.w = tf.Variable(rng.randn(4, 1).astype(np.float32), name="w")
+
+    @tf.function(input_signature=[tf.TensorSpec([None, 4], tf.float32, name="x")])
+    def score(self, x):
+        return {"prediction_node": tf.squeeze(tf.sigmoid(tf.matmul(x, self.w)), -1)}
+
+
+m = Tiny()
+tf.saved_model.save(m, out, signatures={"score": m.score})
+"""
+
+
+def test_graph_import_without_serving_default(tmp_path):
+    """An export whose only signature has a custom name must thread that ONE
+    name through extraction, executor build, and the dry-run probe."""
+    out = tmp_path / "custom_sig"
+    r = subprocess.run(
+        [sys.executable, "-c", _EXPORT_CUSTOM_SIG, str(out)],
+        capture_output=True, text=True, timeout=600,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"tensorflow export unavailable: {r.stderr[-800:]}")
+    sv = import_savedmodel(out, "graph", ModelConfig(name="T", num_fields=4), name="T")
+    x = np.random.RandomState(1).rand(5, 4).astype(np.float32)
+    got = np.asarray(sv.model.apply(sv.params, {"x": x})["prediction_node"])
+    assert got.shape == (5,) and np.all((got > 0) & (got < 1))

@@ -82,9 +82,12 @@ def main() -> None:
     results = []
 
     async def sweep(port: int):
+        import dataclasses
+
         for conc in concs:
             # Size each point to ~10 s assuming ~500 qps upper bound.
             rpw = max(2, int((10.0 * 550) / conc)) if tpu else 3
+            before = dataclasses.replace(batcher.stats)
             async with ShardedPredictClient(
                 [f"127.0.0.1:{port}"], "DCN", channels_per_host=channels
             ) as client:
@@ -97,6 +100,12 @@ def main() -> None:
                 cpu1, wall1 = time.process_time(), time.perf_counter()
             s = report.summary()
             stats = batcher.stats
+            # Per-point counters (lifetime cumulative would blend the
+            # previous concurrency points into every later one).
+            d_req = stats.requests - before.requests
+            d_batches = stats.batches - before.batches
+            d_cand = stats.candidates - before.candidates
+            d_padded = stats.padded_candidates - before.padded_candidates
             point = {
                 "server": "aio" if use_aio else "threads",
                 "concurrency": conc,
@@ -106,8 +115,8 @@ def main() -> None:
                 "requests": s["requests"],
                 "wall_s": round(s["wall_s"], 1),
                 "cpu_util": round((cpu1 - cpu0) / (wall1 - wall0), 3),
-                "requests_per_batch": round(stats.mean_requests_per_batch, 2),
-                "occupancy": round(stats.mean_occupancy, 3),
+                "requests_per_batch": round(d_req / d_batches, 2) if d_batches else 0.0,
+                "occupancy": round(d_cand / d_padded, 3) if d_padded else 0.0,
             }
             point["phases_us"] = {
                 name: snap["mean_us"]
