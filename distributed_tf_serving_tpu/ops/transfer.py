@@ -85,3 +85,90 @@ def unpack_device(packed: dict[str, jnp.ndarray], spec: dict[str, str]) -> dict[
         else:
             out[key] = arr  # bf16 weights feed the model directly
     return out
+
+
+# ------------------------------------------------- combined single buffer
+#
+# Beyond shrinking bytes, the number of host->device TRANSFERS matters: on
+# a relay-tunnel rig every device_put is a round trip, and even on PCIe
+# each transfer has fixed submit cost. The combined path concatenates every
+# (already spec-packed) input's bytes into ONE uint8 buffer — one upload
+# per batch — and splits it back inside the jitted executable with static
+# slices + bitcasts (free: fuses with the consumers).
+
+
+def combined_supported(arrays: dict[str, np.ndarray]) -> bool:
+    """True when every array can be reconstructed by the device-side
+    bitcast: fixed-width numerics up to 4 bytes. Excluded (these pin the
+    per-key fallback in the batcher): bool (bitcast_convert_type rejects
+    it), 8-byte dtypes (x32 canonicalization makes the 8-trailing-bytes
+    bitcast unsatisfiable — the per-key path's device_put downcast is the
+    documented behavior for those), strings/objects."""
+    return all(
+        a.dtype.kind in "iuf" and a.dtype.itemsize in (1, 2, 4)
+        for a in arrays.values()
+    )
+
+
+def combined_layout(arrays: dict[str, np.ndarray], spec: dict[str, str]) -> tuple:
+    """Pure-metadata layout for the combined buffer: a hashable tuple of
+    per-input entries (key, kind, trailing_shape, per_candidate_bytes,
+    packed_dtype_str), key-sorted. Static under jit (rides static_argnums)
+    and computable WITHOUT packing — the content cache derives its key from
+    the raw arrays plus this layout, so a hit skips the pack entirely."""
+    layout = []
+    for key in sorted(arrays):
+        arr = arrays[key]
+        kind = spec.get(key, "raw")
+        trailing = tuple(int(t) for t in arr.shape[1:])
+        inner = int(np.prod(trailing)) if trailing else 1
+        if kind == "u24":
+            layout.append((key, "u24", trailing, inner * 3, "u24"))
+        elif kind == "bf16":
+            layout.append((key, "raw", trailing, inner * 2, "bfloat16"))
+        else:
+            layout.append(
+                (key, "raw", trailing, inner * arr.dtype.itemsize, arr.dtype.name)
+            )
+    return tuple(layout)
+
+
+def pack_host_combined(
+    arrays: dict[str, np.ndarray], spec: dict[str, str]
+) -> np.ndarray:
+    """Spec-pack each input, then concatenate the raw bytes into one uint8
+    buffer (same sorted key order as combined_layout)."""
+    packed = pack_host(arrays, spec)
+    segs = [
+        np.ascontiguousarray(packed[key]).view(np.uint8).ravel()
+        for key in sorted(packed)
+    ]
+    return np.concatenate(segs) if len(segs) > 1 else segs[0]
+
+
+def unpack_device_combined(buf: jnp.ndarray, layout: tuple) -> dict[str, jnp.ndarray]:
+    """Inverse of pack_host_combined, traced inside the jitted executable.
+    Slices are static (n derives from the buffer length and the layout's
+    per-candidate byte totals), bitcasts collapse the byte dim."""
+    from jax import lax
+
+    total_pcb = sum(e[3] for e in layout)
+    n = buf.shape[0] // total_pcb
+    out = {}
+    off = 0
+    for key, kind, trailing, per_cand, dtype_str in layout:
+        nb = n * per_cand
+        seg = buf[off:off + nb]
+        off += nb
+        if kind == "u24":
+            b = seg.reshape((n, *trailing, 3)).astype(jnp.int32)
+            out[key] = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
+        else:
+            dt = jnp.dtype(dtype_str)
+            if dt.itemsize == 1:
+                out[key] = lax.bitcast_convert_type(seg.reshape((n, *trailing)), dt)
+            else:
+                out[key] = lax.bitcast_convert_type(
+                    seg.reshape((n, *trailing, dt.itemsize)), dt
+                )
+    return out

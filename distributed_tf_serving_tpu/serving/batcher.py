@@ -47,7 +47,15 @@ import numpy as np
 
 from ..models.base import Model
 from ..models.registry import Servable
-from ..ops.transfer import pack_host, transfer_spec, unpack_device
+from ..ops.transfer import (
+    combined_layout,
+    combined_supported,
+    pack_host,
+    pack_host_combined,
+    transfer_spec,
+    unpack_device,
+    unpack_device_combined,
+)
 from ..utils.tracing import request_trace
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
@@ -210,29 +218,42 @@ class DeviceInputCache:
     ) -> jax.Array | np.ndarray:
         """Device array for `arr`'s content, uploading (after `pack`, when
         given) only on miss. The digest keys on the PRE-pack bytes so a hit
-        skips the transfer-compression work too — under repeated traffic
-        pack_host was the batcher thread's single largest CPU cost, charged
-        even when the upload itself was skipped (round-3 profiling). `pack`
-        must be pure (same bytes in => same bytes out) and `pack_tag` must
-        identify the transform: the stored value is POST-pack, so the same
-        raw bytes packed differently (one servable u24-packs ids, another
-        does not) must occupy distinct entries or a hit would hand one
-        servable the other's packed layout."""
+        skips the transfer-compression work too. `pack` must be pure and
+        `pack_tag` must identify the transform: the stored value is
+        POST-pack, so the same raw bytes packed differently must occupy
+        distinct entries."""
         if self.bypassed:
             return pack(arr) if pack is not None else arr  # plain jit path
         key = (pack_tag, *self._key(name, arr))
+        return self._lookup(key, lambda: pack(arr) if pack is not None else arr)
+
+    def get_or_put_group(
+        self,
+        arrays: dict[str, np.ndarray],
+        build: Callable[[], np.ndarray],
+        tag: str,
+    ) -> jax.Array | np.ndarray:
+        """Device buffer for a GROUP of arrays (the combined-transfer path):
+        keyed on every member's content digest plus `tag` (the layout), so a
+        hit skips pack+concat+upload in one lookup. `build()` produces the
+        combined host buffer only on miss."""
+        if self.bypassed:
+            return build()
+        key = (tag,) + tuple(self._key(k, arrays[k]) for k in sorted(arrays))
+        return self._lookup(key, build)
+
+    def _lookup(self, key: tuple, build_host: Callable[[], np.ndarray]):
+        """Shared LRU hit/miss core: one implementation of the accounting,
+        eviction, and the adaptive-bypass probe."""
         with self._lock:
             cached = self._lru.get(key)
             if cached is not None:
                 self._lru.move_to_end(key)
                 self.hits += 1
-                # The avoided upload is the PACKED size (the cached device
-                # array), not the raw digest input.
+                # The avoided upload is the stored (post-pack) size.
                 self.bytes_skipped += cached.nbytes
                 return cached
-        if pack is not None:
-            arr = pack(arr)
-        device_arr = jax.device_put(arr)  # async; the executable waits, not us
+        device_arr = jax.device_put(build_host())  # async; the executable waits, not us
         with self._lock:
             self._lru[key] = device_arr
             self.misses += 1
@@ -515,21 +536,37 @@ class DynamicBatcher:
         for fut in futures:
             fut.result(timeout=600)
 
-    def jit_entry(self, servable: Servable) -> tuple[Callable, dict[str, str]]:
-        """The (jitted fn, transfer spec) this batcher serves `servable`
-        with — public so measurement harnesses (bench.py's device-limited
-        decomposition) can time the EXACT serving executable, warm caches
-        included, instead of compiling a lookalike."""
+    def jit_entry(self, servable: Servable) -> tuple[Callable, dict[str, str], bool]:
+        """The (jitted fn, transfer spec, combined) this batcher serves
+        `servable` with — public so measurement harnesses (bench.py's
+        device-limited decomposition) can time the EXACT serving executable,
+        warm caches included, instead of compiling a lookalike. When
+        `combined` is True the fn signature is (params, uint8_buffer,
+        layout) with layout static (ops/transfer.py combined_layout)."""
         return self._jit_for(servable)
 
     # ------------------------------------------------------------- internals
 
-    def _jit_for(self, servable: Servable) -> tuple[Callable, dict[str, str]]:
+    def _jit_for(self, servable: Servable) -> tuple[Callable, dict[str, str], bool]:
         entry = self._jitted.get(servable)
         if entry is None:
             spec = transfer_spec(servable.model) if self.compress_transfer else {}
             apply = servable.model.apply
-            if spec:
+            combined = self.compress_transfer and not servable.model.needs_x64
+            if combined:
+                # One uint8 buffer per batch = ONE host->device transfer
+                # instead of one per input; static-layout split + bitcasts
+                # are traced into the executable and fuse with consumers.
+                # (x64 models keep the per-key path: their int64 inputs
+                # must cross the boundary as int64, not raw bytes plus an
+                # in-graph bitcast that enable_x64 scoping complicates.)
+                fn = jax.jit(
+                    lambda params, buf, layout: apply(
+                        params, unpack_device_combined(buf, layout)
+                    ),
+                    static_argnums=2,
+                )
+            elif spec:
                 # Transfer decompression is traced into the executable, so it
                 # fuses with the embedding lookup's index arithmetic.
                 fn = jax.jit(lambda params, packed: apply(params, unpack_device(packed, spec)))
@@ -546,7 +583,7 @@ class DynamicBatcher:
                     with jax.enable_x64():
                         return _base(params, batch)
 
-            entry = (fn, spec)
+            entry = (fn, spec, combined)
             self._jitted[servable] = entry
         return entry
 
@@ -561,12 +598,38 @@ class DynamicBatcher:
             arrays["feat_ids"] = fold_ids_host(ids, servable.model.config.vocab_size)
         if self._run_fn is not None:
             return self._run_fn(servable, arrays)
-        fn, spec = self._jit_for(servable)
+        fn, spec, combined = self._jit_for(servable)
+        if combined and not combined_supported(arrays):
+            # Rare servable whose inputs cannot ride a byte buffer (string/
+            # bool/8-byte tensors): rebuild the per-key entry once and pin
+            # it (same spec — only the transfer packaging changes).
+            apply = servable.model.apply
+            fn = jax.jit(
+                lambda params, packed: apply(params, unpack_device(packed, spec))
+            ) if spec else jax.jit(apply)
+            self._jitted[servable] = (fn, spec, False)
+            combined = False
         # x64 models need the context around the UPLOADS too: device_put
         # (inside the input cache) canonicalizes, and an int64 batch put
         # outside the context reaches the x64-traced executable as int32.
         ctx = jax.enable_x64() if servable.model.needs_x64 else _NULL_CTX
         with ctx:
+            if combined:
+                layout = combined_layout(arrays, spec)
+                if self.input_cache is not None:
+                    # Digest the RAW arrays (a content hit skips pack AND
+                    # concat AND upload); layout in the tag keeps distinct
+                    # packings of identical bytes apart.
+                    with request_trace.span("batch.cache"):
+                        buf = self.input_cache.get_or_put_group(
+                            arrays,
+                            build=lambda: pack_host_combined(arrays, spec),
+                            tag=str(layout),
+                        )
+                else:
+                    buf = pack_host_combined(arrays, spec)
+                with request_trace.span("batch.jitcall"):
+                    return fn(servable.params, buf, layout)
             if self.input_cache is not None:
                 # Digest BEFORE packing: a content hit skips both the upload
                 # and the pack (u24/bf16) work.

@@ -80,3 +80,96 @@ def test_batcher_scores_identical_with_compression(compute_dtype, kind):
     # bf16 path: the model casts weights to bf16 anyway, so pre-casting on
     # host is bit-identical; f32 path: spec only packs ids, which is exact.
     np.testing.assert_array_equal(results[True], results[False])
+
+
+# ----------------------------------------------- combined single buffer
+
+
+@pytest.mark.parametrize("spec", [
+    {"feat_ids": "u24", "feat_wts": "bf16"},
+    {"feat_ids": "u24"},
+    {},
+])
+def test_combined_roundtrip(spec):
+    import ml_dtypes
+
+    from distributed_tf_serving_tpu.ops.transfer import (
+        combined_layout,
+        combined_supported,
+        pack_host_combined,
+        unpack_device_combined,
+    )
+
+    rng = np.random.RandomState(1)
+    arrays = {
+        "feat_ids": rng.randint(0, 1 << 20, size=(6, 5)).astype(np.int32),
+        "feat_wts": rng.rand(6, 5).astype(np.float32),
+        "dense_features": rng.rand(6, 3).astype(np.float32),
+    }
+    assert combined_supported(arrays)
+    layout = combined_layout(arrays, spec)
+    buf = pack_host_combined(arrays, spec)
+    assert buf.dtype == np.uint8 and buf.ndim == 1
+    assert buf.nbytes == 6 * sum(e[3] for e in layout)
+    out = jax.jit(
+        lambda b: unpack_device_combined(b, layout), static_argnums=()
+    )(buf)
+    np.testing.assert_array_equal(np.asarray(out["feat_ids"]), arrays["feat_ids"])
+    np.testing.assert_array_equal(
+        np.asarray(out["dense_features"]), arrays["dense_features"]
+    )
+    if spec.get("feat_wts") == "bf16":
+        np.testing.assert_array_equal(
+            np.asarray(out["feat_wts"]),
+            arrays["feat_wts"].astype(ml_dtypes.bfloat16),
+        )
+    else:
+        np.testing.assert_array_equal(np.asarray(out["feat_wts"]), arrays["feat_wts"])
+
+
+def test_combined_not_supported_for_strings_bool_and_8byte():
+    """Excluded classes pin the batcher's per-key fallback: strings cannot
+    ride bytes at all, bitcast rejects bool, and 8-byte dtypes cannot be
+    reconstructed under x32 canonicalization (round-3 review findings)."""
+    from distributed_tf_serving_tpu.ops.transfer import combined_supported
+
+    obj = np.empty(3, object)
+    obj[:] = [b"a", b"b", b"c"]
+    assert not combined_supported({"s": obj})
+    assert not combined_supported({"m": np.ones(3, bool)})
+    assert not combined_supported({"i": np.ones(3, np.int64)})
+    assert not combined_supported({"d": np.ones(3, np.float64)})
+    assert combined_supported({"a": np.ones(3, np.float32), "b": np.ones(3, np.uint8)})
+
+
+def test_batcher_combined_entry_scores_match_eager():
+    """The default (combined-transfer) batcher entry must score identically
+    to the eager forward, requests coalesced or not."""
+    from distributed_tf_serving_tpu.serving.batcher import fold_ids_host, prepare_inputs
+
+    cfg = ModelConfig(
+        num_fields=8, vocab_size=1 << 16, embed_dim=4, mlp_dims=(16,),
+        num_cross_layers=1, compute_dtype="bfloat16",
+    )
+    model = build_model("dcn_v2", cfg)
+    servable = Servable(
+        name="M", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(cfg.num_fields),
+    )
+    batcher = DynamicBatcher(buckets=(16, 64), max_wait_us=0).start()
+    try:
+        fn, spec, combined = batcher.jit_entry(servable)
+        assert combined, "default zoo path should use the combined buffer"
+        rng = np.random.RandomState(5)
+        arrays = {
+            "feat_ids": rng.randint(0, 1 << 40, size=(10, 8)).astype(np.int64),
+            "feat_wts": rng.rand(10, 8).astype(np.float32),
+        }
+        got = batcher.submit(servable, arrays).result(timeout=60)["prediction_node"]
+        want = np.asarray(
+            model.apply(servable.params, prepare_inputs(model, arrays))["prediction_node"]
+        )
+        np.testing.assert_array_equal(got, want[:10])
+    finally:
+        batcher.stop()

@@ -81,6 +81,13 @@ def main() -> None:
     payload = make_payload(candidates=CANDIDATES, num_fields=NUM_FIELDS)
     results = []
 
+    pool = None
+    if os.environ.get("EXP_UNIQUE", "0") == "1":
+        pool = [
+            make_payload(candidates=CANDIDATES, num_fields=NUM_FIELDS, seed=100 + i)
+            for i in range(128)
+        ]
+
     async def sweep(port: int):
         import dataclasses
 
@@ -95,7 +102,9 @@ def main() -> None:
                 report = await run_closed_loop(
                     client, payload, concurrency=conc, requests_per_worker=rpw,
                     sort_scores=True, warmup_requests=5,
-                    prepared=os.environ.get("EXP_PREPARED", "0") == "1",
+                    payload_pool=pool,
+                    prepared=(pool is None)
+                    and os.environ.get("EXP_PREPARED", "0") == "1",
                 )
                 cpu1, wall1 = time.process_time(), time.perf_counter()
             s = report.summary()
