@@ -314,13 +314,16 @@ def device_loop_step_s(
     est = max(measure(2, est_iters), 1e-8)
     iters_long = int(min(50_000, max(4 * est_iters, target_s / est)))
     step = measure(max(iters_long // 8, 2), iters_long)
-    if step <= 0:
+    if step <= 0 or step < est / 50:
         # A straggler round-trip polluted a wall (min-of-2 can't save a
-        # flap that spans both); one deeper retry with a wider N gap.
+        # flap that spans both); a reading 50x below the coarse estimate is
+        # physically implausible for the same op (r3: a 152-us DLRM step
+        # once read 0.0 us through exactly this failure). One deeper retry
+        # with a wider N gap.
         step = measure(max(iters_long // 4, 2), min(3 * iters_long, 60_000))
     # Degenerate readings become None, never a fake tiny number — a 0.0
     # here once crashed the whole child via a divide in the MFU line.
-    return step if step > 0 else None
+    return step if step > 0 and step >= est / 50 else None
 
 
 def train_on_chip(scale: Scale, config):

@@ -256,3 +256,79 @@ def test_graph_import_without_serving_default(tmp_path):
     x = np.random.RandomState(1).rand(5, 4).astype(np.float32)
     got = np.asarray(sv.model.apply(sv.params, {"x": x})["prediction_node"])
     assert got.shape == (5,) and np.all((got > 0) & (got < 1))
+
+
+_EXPORT_KERAS = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+out = sys.argv[1]
+rng = np.random.RandomState(4)
+tf.keras.utils.set_random_seed(4)
+
+inp_ids = tf.keras.Input(shape=(5,), dtype=tf.int64, name="feat_ids")
+inp_wts = tf.keras.Input(shape=(5,), dtype=tf.float32, name="feat_wts")
+folded = tf.keras.layers.Lambda(
+    lambda t: tf.math.floormod(t, 733), output_shape=(5,)
+)(inp_ids)
+emb = tf.keras.layers.Embedding(733, 6)(folded)
+weighted = tf.keras.layers.Multiply()([emb, tf.keras.layers.Reshape((5, 1))(inp_wts)])
+flat = tf.keras.layers.Flatten()(weighted)
+h = tf.keras.layers.Dense(16, activation="relu")(flat)
+h = tf.keras.layers.Dense(8, activation="tanh")(h)
+p = tf.keras.layers.Dense(1, activation="sigmoid", name="out")(h)
+p = tf.keras.layers.Reshape(())(p)
+model = tf.keras.Model([inp_ids, inp_wts], {"prediction_node": p})
+
+@tf.function(input_signature=[
+    tf.TensorSpec([None, 5], tf.int64, name="feat_ids"),
+    tf.TensorSpec([None, 5], tf.float32, name="feat_wts"),
+])
+def serve(feat_ids, feat_wts):
+    return model([feat_ids, feat_wts])
+
+tf.saved_model.save(model, out, signatures={"serving_default": serve})
+"""
+
+_GOLDEN_KERAS = """
+import sys, json
+import numpy as np
+import tensorflow as tf
+
+src, seed, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+rng = np.random.RandomState(seed)
+ids = rng.randint(0, 1 << 40, size=(n, 5)).astype(np.int64)
+wts = rng.rand(n, 5).astype(np.float32)
+f = tf.saved_model.load(src).signatures["serving_default"]
+out = f(feat_ids=tf.constant(ids), feat_wts=tf.constant(wts))
+print(json.dumps([float(x) for x in out["prediction_node"].numpy()]))
+"""
+
+
+def test_keras_export_serves_via_graph_executor(tmp_path):
+    """A genuine tf.keras functional model (Embedding/Dense/Lambda/Multiply
+    stack) — the most common real-world export shape — must serve via the
+    graph executor and match Keras's own forward."""
+    out = tmp_path / "keras_sm"
+    r = subprocess.run(
+        [sys.executable, "-c", _EXPORT_KERAS, str(out)],
+        capture_output=True, text=True, timeout=600,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"keras export unavailable: {r.stderr[-800:]}")
+    sv = import_savedmodel(out, "graph", ModelConfig(name="K", num_fields=5), name="K")
+    rng = np.random.RandomState(8)
+    arrays = {
+        "feat_ids": rng.randint(0, 1 << 40, size=(7, 5)).astype(np.int64),
+        "feat_wts": rng.rand(7, 5).astype(np.float32),
+    }
+    with jax.enable_x64():
+        got = np.asarray(sv.model.apply(sv.params, arrays)["prediction_node"], np.float32)
+    g = subprocess.run(
+        [sys.executable, "-c", _GOLDEN_KERAS, str(out), "8", "7"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert g.returncode == 0, g.stderr[-2000:]
+    want = np.asarray(json.loads(g.stdout.strip().splitlines()[-1]), np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
