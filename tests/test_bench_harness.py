@@ -1,0 +1,46 @@
+"""Bench-harness guards: the parent's salvage selection decides whether a
+relay wedge costs the round artifact, so it gets pinned here (bench.py is
+exercised end-to-end only on hardware)."""
+
+import importlib.util
+import json
+import pathlib
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", pathlib.Path(__file__).parent.parent / "bench.py"
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def test_last_json_selection():
+    bench = _load_bench()
+    out = "\n".join([
+        "not json",
+        json.dumps({"value": 412.5, "partial": True, "windows_qps": [{"qps": 412.5}]}),
+        "[bench] stray log on stdout",
+        json.dumps({"metric": "x", "value": 0.0, "error": "boom", "stage": "pallas"}),
+    ])
+    # Plain: the newest parseable line (the error) — what attempt-2
+    # reporting emits.
+    assert bench._last_json(out)["error"] == "boom"
+    # Measured: skips value-less/zero lines and finds the checkpoint — what
+    # salvage emits after a crash or hang.
+    assert bench._last_json(out, measured=True)["value"] == 412.5
+    # Nothing parseable -> None (parent falls through to retry/fail).
+    assert bench._last_json("nope\nnope") is None
+    assert bench._last_json("", measured=True) is None
+
+
+def test_scale_window_caps_clamped_by_ladder(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setenv("DTS_BENCH_TOP_BUCKET", "8192")
+    scale = bench.Scale("tpu")
+    assert scale.buckets[-1] == 8192  # ladder respects the env override
+    # Window caps above the ladder top are clamped at use (bench clamps via
+    # min(cap, buckets[-1]); here we just pin that the config carries caps
+    # the clamp must handle).
+    assert max(cap for cap, _conc in scale.windows) > 8192
