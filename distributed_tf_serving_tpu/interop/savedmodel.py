@@ -173,6 +173,16 @@ _EXTRACT_GRAPH_SCRIPT = textwrap.dedent(
     arrays = {}
     for v in f.graph.variables:
         arrays[v.name.split(":")[0]] = v.numpy()
+    if not arrays:
+        # TF1-format SavedModel (simple_save / SavedModelBuilder): the v1
+        # loader wrapper exposes no f.graph.variables, but its TensorBundle
+        # stores values under the VariableV2 node names directly — exactly
+        # the keys the graph executor binds (graph_exec.py VariableV2).
+        import os
+        prefix = os.path.join(src, "variables", "variables")
+        reader = tf.train.load_checkpoint(prefix)
+        for name in reader.get_variable_to_shape_map():
+            arrays[name] = reader.get_tensor(name)
     np.savez(out, **arrays)
     print(f"extracted {len(arrays)} graph variables")
     """

@@ -152,7 +152,18 @@ class PredictionServiceImpl:
             return ServiceError("RESOURCE_EXHAUSTED", str(exc))
         if isinstance(exc, DeviceWedgedError):
             return ServiceError("UNAVAILABLE", str(exc))
-        if isinstance(exc, TimeoutError):
+        # Explicit tuple, not bare TimeoutError: asyncio.TimeoutError and
+        # concurrent.futures.TimeoutError are aliases of the builtin only on
+        # Python >= 3.11; on 3.10 a batcher deadline would surface as
+        # INTERNAL and skip the fut.cancel() withdrawal below (round-3
+        # advisor finding).
+        import asyncio
+        import concurrent.futures
+
+        if isinstance(
+            exc,
+            (TimeoutError, asyncio.TimeoutError, concurrent.futures.TimeoutError),
+        ):
             # Withdraw the work: a cancelled item is skipped by the batcher,
             # so an abandoned deadline never turns into a zombie dispatch
             # that delays everyone behind it.

@@ -212,6 +212,221 @@ def test_unsupported_op_is_named():
         model.apply(params, {"x": np.ones((2,), np.float32)})
 
 
+def _tiny_meta(output_ref: str):
+    """MetaGraphDef skeleton with one f32 input x:[None,4] and one output."""
+    from distributed_tf_serving_tpu.proto import tf_meta_graph_pb2 as mg
+
+    meta = mg.MetaGraphDef()
+    sig = meta.signature_def["serving_default"]
+    sig.inputs["x"].name = "x:0"
+    sig.inputs["x"].dtype = 1
+    sig.outputs["y"].name = output_ref
+    sig.outputs["y"].dtype = 1
+    n = meta.graph_def.node.add()
+    n.name = "x"
+    n.op = "Placeholder"
+    return meta
+
+
+def test_tf1_variable_v2_resolves_to_value():
+    """TF1 ref-variables (VariableV2) yield the tensor value at every use
+    site — there is no ReadVariableOp in a TF1 graph, so a VariableV2 ->
+    Identity -> MatMul chain must see the array, not an opaque VarRef
+    (round-3 advisor finding: this exact chain failed with a 0-d shape
+    error while the docs claimed TF1 support)."""
+    meta = _tiny_meta("mm:0")
+    g = meta.graph_def
+    v = g.node.add(); v.name = "w"; v.op = "VariableV2"
+    ident = g.node.add(); ident.name = "w_read"; ident.op = "Identity"
+    ident.input.append("w")
+    mm = g.node.add(); mm.name = "mm"; mm.op = "MatMul"
+    mm.input.extend(["x", "w_read"])
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 3).astype(np.float32)
+    model, params = graph_model(meta, {"w": w}, name="tf1")
+    x = rng.rand(5, 4).astype(np.float32)
+    got = np.asarray(model.apply(params, {"x": x})["y"])
+    np.testing.assert_allclose(got, x @ w, rtol=1e-6)
+
+
+def test_tf1_variable_v2_missing_param_is_named():
+    meta = _tiny_meta("w:0")
+    v = meta.graph_def.node.add(); v.name = "w"; v.op = "VariableV2"
+    model, params = graph_model(meta, {}, name="tf1")
+    with pytest.raises(Exception, match="'w' not found"):
+        model.apply(params, {"x": np.ones((1, 4), np.float32)})
+
+
+def test_mod_is_truncated_remainder():
+    """TF's Mod/TruncateMod are C-style (result takes the DIVIDEND's sign);
+    FloorMod is Python-style. Both must hold on negative operands (round-3
+    advisor finding: Mod was floor-mod, silently diverging)."""
+    a = np.array([7, -7, 7, -7], np.int64)
+    b = np.array([3, 3, -3, -3], np.int64)
+    for op_name, want in (
+        ("Mod", np.array([1, -1, 1, -1], np.int64)),        # C semantics
+        ("TruncateMod", np.array([1, -1, 1, -1], np.int64)),
+        ("FloorMod", np.array([1, 2, -2, -1], np.int64)),   # Python semantics
+    ):
+        from distributed_tf_serving_tpu.interop.graph_exec import _OPS
+
+        (got,) = _OPS[op_name](None, [a, b], np)
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=op_name)
+
+
+_EXPORT_TF1 = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+out = sys.argv[1]
+v1 = tf.compat.v1
+v1.disable_eager_execution()
+v1.disable_resource_variables()  # genuine VariableV2 nodes, TF1-style
+rng = np.random.RandomState(21)
+
+g = v1.Graph()
+with g.as_default():
+    x = v1.placeholder(tf.float32, [None, 4], name="x")
+    w = v1.get_variable("w", initializer=rng.randn(4, 3).astype(np.float32))
+    b = v1.get_variable("b", initializer=rng.randn(3).astype(np.float32))
+    h = v1.nn.relu(v1.matmul(x, w) + b)
+    w2 = v1.get_variable("w2", initializer=rng.randn(3, 1).astype(np.float32))
+    y = v1.math.sigmoid(v1.squeeze(v1.matmul(h, w2), -1), name="prediction")
+    with v1.Session(graph=g) as sess:
+        sess.run(v1.global_variables_initializer())
+        assert any(v.op.type == "VariableV2" for v in v1.global_variables()), (
+            "export would not exercise the TF1 ref-variable path")
+        v1.saved_model.simple_save(
+            sess, out, inputs={"x": x}, outputs={"prediction_node": y})
+        xs = np.arange(20, dtype=np.float32).reshape(5, 4) / 10.0
+        import json
+        print("GOLDEN=" + json.dumps([float(v) for v in sess.run(y, {x: xs})]))
+"""
+
+
+def test_tf1_savedmodel_end_to_end(tmp_path):
+    """A genuine TF1-format export (simple_save over VariableV2 ref
+    variables) must import and serve, matching the TF1 session's forward."""
+    out = tmp_path / "tf1_sm"
+    r = subprocess.run(
+        [sys.executable, "-c", _EXPORT_TF1, str(out)],
+        capture_output=True, text=True, timeout=600,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"tf1 export unavailable: {r.stderr[-800:]}")
+    golden_line = next(
+        ln for ln in r.stdout.splitlines() if ln.startswith("GOLDEN=")
+    )
+    want = np.asarray(json.loads(golden_line[len("GOLDEN="):]), np.float32)
+    sv = import_savedmodel(out, "graph", ModelConfig(name="T1", num_fields=4), name="T1")
+    xs = np.arange(20, dtype=np.float32).reshape(5, 4) / 10.0
+    got = np.asarray(sv.model.apply(sv.params, {"x": xs})["prediction_node"], np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+_EXPORT_HASHTABLE = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+out = sys.argv[1]
+rng = np.random.RandomState(31)
+# Sparse catalog ids -> dense rows: the id-remap preprocessing shape
+# common in real CTR exports (VERDICT r3 task 9).
+keys = tf.constant([10**6, 5, 42, 10**12, 77, 3], tf.int64)
+vals = tf.constant([0, 1, 2, 3, 4, 5], tf.int64)
+
+
+class M(tf.Module):
+    def __init__(self):
+        super().__init__()
+        self.table = tf.lookup.StaticHashTable(
+            tf.lookup.KeyValueTensorInitializer(keys, vals), default_value=-1)
+        self.emb = tf.Variable(rng.randn(7, 4).astype(np.float32), name="emb")
+
+    @tf.function(input_signature=[
+        tf.TensorSpec([None, 3], tf.int64, name="feat_ids")])
+    def __call__(self, feat_ids):
+        row = self.table.lookup(feat_ids)
+        # Misses land on a dedicated OOV row (6).
+        safe = tf.where(row < 0, tf.fill(tf.shape(row), tf.constant(6, tf.int64)), row)
+        e = tf.gather(self.emb, safe)
+        return {"prediction_node": tf.math.sigmoid(tf.reduce_sum(e, axis=[1, 2]))}
+
+
+m = M()
+tf.saved_model.save(m, out, signatures={"serving_default": m.__call__})
+"""
+
+_GOLDEN_HASHTABLE = """
+import sys, json
+import numpy as np
+import tensorflow as tf
+
+src = sys.argv[1]
+ids = np.array([[5, 42, 999], [10**12, 3, 77], [1, 2, 10**6]], np.int64)
+f = tf.saved_model.load(src).signatures["serving_default"]
+out = f(feat_ids=tf.constant(ids))
+print(json.dumps([float(x) for x in out["prediction_node"].numpy()]))
+"""
+
+
+def test_static_hashtable_export_matches_tf(tmp_path):
+    """A genuine StaticHashTable export (int64 id-remap + OOV handling)
+    serves natively: table contents statically resolved from the
+    initializer chain, lookups as searchsorted — parity with TF's own
+    forward including misses."""
+    out = tmp_path / "ht_sm"
+    r = subprocess.run(
+        [sys.executable, "-c", _EXPORT_HASHTABLE, str(out)],
+        capture_output=True, text=True, timeout=600,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"tensorflow export unavailable: {r.stderr[-800:]}")
+    sv = import_savedmodel(out, "graph", ModelConfig(name="HT", num_fields=3), name="HT")
+    ids = np.array([[5, 42, 999], [10**12, 3, 77], [1, 2, 10**6]], np.int64)
+    with jax.enable_x64():
+        got = np.asarray(
+            sv.model.apply(sv.params, {"feat_ids": ids})["prediction_node"],
+            np.float32,
+        )
+    g = subprocess.run(
+        [sys.executable, "-c", _GOLDEN_HASHTABLE, str(out)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert g.returncode == 0, g.stderr[-2000:]
+    want = np.asarray(json.loads(g.stdout.strip().splitlines()[-1]), np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+    # And under jit (the serving path), where the lookup must trace.
+    with jax.enable_x64():
+        got_jit = np.asarray(
+            jax.jit(sv.model.apply)(sv.params, {"feat_ids": ids})["prediction_node"],
+            np.float32,
+        )
+    np.testing.assert_allclose(got_jit, want, rtol=2e-5, atol=1e-6)
+
+
+def test_unresolvable_table_is_named():
+    """A find against a table with no statically resolvable contents must
+    raise the documented UnsupportedOpError naming the node, not a shape
+    error."""
+    meta = _tiny_meta("find:0")
+    g = meta.graph_def
+    t = g.node.add(); t.name = "tbl"; t.op = "HashTableV2"
+    f = g.node.add(); f.name = "dflt"; f.op = "Const"
+    # A float Const we never wire as the table's initializer.
+    f.attr["value"].tensor.dtype = 1
+    f.attr["value"].tensor.float_val.append(-1.0)
+    find = g.node.add(); find.name = "find"; find.op = "LookupTableFindV2"
+    find.input.extend(["tbl", "x", "dflt"])
+
+    model, params = graph_model(meta, {}, name="tbl_test")
+    with pytest.raises(UnsupportedOpError, match="find.*statically resolvable"):
+        model.apply(params, {"x": np.ones((2, 2), np.float32)})
+
+
 def test_executor_rejects_unknown_signature(exotic_export):
     meta = serve_meta_graph(read_saved_model(exotic_export))
     with pytest.raises(Exception, match="nope"):
