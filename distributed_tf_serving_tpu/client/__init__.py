@@ -7,6 +7,7 @@ from .client import (
     ShardedPredictClient,
     build_predict_request,
     client_from_config,
+    compact_payload,
     predict_sync,
 )
 from .partition import (
@@ -23,6 +24,7 @@ __all__ = [
     "PreparedRequest",
     "build_predict_request",
     "client_from_config",
+    "compact_payload",
     "predict_sync",
     "partition_bounds",
     "partition_list",

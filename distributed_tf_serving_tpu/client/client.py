@@ -65,6 +65,38 @@ class PreparedRequest:
 _FAILOVER_CODES = frozenset({"UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED"})
 
 
+def compact_payload(
+    arrays: dict[str, np.ndarray], vocab_size: int
+) -> dict[str, np.ndarray]:
+    """Pre-apply the server's own first transforms client-side so the wire
+    carries half the bytes: int64 ids -> folded int32 (exact mod, the
+    server's host fold; models re-fold idempotently) and f32 weights ->
+    bf16 (the models' compute-dtype cast, round-to-nearest-even both
+    sides). Scores are bit-identical to the wide encoding — the packed
+    device bytes are the same — while the 516 KB reference request becomes
+    258 KB. The transport is >half the single-core request budget (~1.7
+    ms/MB through grpc-python), so this is the client knob with the largest
+    throughput effect; the server accepts it via the compact-wire widening
+    in service._decode_and_validate."""
+    import ml_dtypes
+
+    from .. import native
+
+    out = {}
+    for k, v in arrays.items():
+        if k == "feat_ids" and v.dtype == np.int64:
+            # The server's own canonical fold (native one-pass when built).
+            out[k] = native.fold_ids(v, vocab_size)
+        elif k == "feat_wts" and v.dtype == np.float32:
+            # ONLY the weights input: other float inputs (DLRM
+            # dense_features) are consumed in f32 by the models and the
+            # server rejects them in bf16 (service widening gate).
+            out[k] = v.astype(ml_dtypes.bfloat16)
+        else:
+            out[k] = v
+    return out
+
+
 def build_predict_request(
     arrays: dict[str, np.ndarray],
     model_name: str,

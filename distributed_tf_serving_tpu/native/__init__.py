@@ -153,6 +153,17 @@ def fold_i32(ids: np.ndarray, vocab: int) -> np.ndarray:
     return out
 
 
+def fold_ids(ids: np.ndarray, vocab: int) -> np.ndarray:
+    """THE canonical exact host fold (int64 -> int32 mod vocab): native
+    one-pass kernel when built, numpy remainder+astype otherwise —
+    bit-identical either way. Lives here (jax-free, importable by the
+    client) so the server's batcher and the client's compact_payload cannot
+    drift on the fold contract."""
+    if ids.dtype == np.int64 and available():
+        return fold_i32(ids, vocab)
+    return np.remainder(ids, np.int64(vocab)).astype(np.int32)
+
+
 def pack_u24_i32(ids: np.ndarray) -> np.ndarray:
     """Folded int32 ids [..] -> u24 bytes [.., 3] (one pass)."""
     lib = _load()

@@ -65,7 +65,9 @@ def pack_host(arrays: dict[str, np.ndarray], spec: dict[str, str]) -> dict[str, 
                 b = np.ascontiguousarray(arr).view(np.uint8).reshape(*arr.shape, 4)
                 out[key] = np.ascontiguousarray(b[..., :3])  # LE low 3 bytes
         elif how == "bf16":
-            if use_native:
+            if arr.dtype == ml_dtypes.bfloat16:
+                out[key] = arr  # compact-wire client already cast (RNE)
+            elif use_native:
                 out[key] = native.f32_to_bf16(arr)
             else:
                 out[key] = arr.astype(ml_dtypes.bfloat16)
@@ -99,13 +101,18 @@ def unpack_device(packed: dict[str, jnp.ndarray], spec: dict[str, str]) -> dict[
 
 def combined_supported(arrays: dict[str, np.ndarray]) -> bool:
     """True when every array can be reconstructed by the device-side
-    bitcast: fixed-width numerics up to 4 bytes. Excluded (these pin the
-    per-key fallback in the batcher): bool (bitcast_convert_type rejects
-    it), 8-byte dtypes (x32 canonicalization makes the 8-trailing-bytes
-    bitcast unsatisfiable — the per-key path's device_put downcast is the
-    documented behavior for those), strings/objects."""
+    bitcast: fixed-width numerics up to 4 bytes. ml_dtypes.bfloat16 is
+    explicitly included — its numpy dtype.kind is 'V' (void), not 'f', so
+    a kind test alone rejects exactly the compact-wire weights this path
+    exists to carry (round-4 review finding: the first compact request
+    permanently demoted the servable to the per-key path). Excluded (these
+    pin the per-key fallback in the batcher): bool (bitcast_convert_type
+    rejects it), 8-byte dtypes (x32 canonicalization makes the
+    8-trailing-bytes bitcast unsatisfiable — the per-key path's device_put
+    downcast is the documented behavior for those), strings/objects."""
     return all(
-        a.dtype.kind in "iuf" and a.dtype.itemsize in (1, 2, 4)
+        (a.dtype.kind in "iuf" and a.dtype.itemsize in (1, 2, 4))
+        or a.dtype == ml_dtypes.bfloat16
         for a in arrays.values()
     )
 

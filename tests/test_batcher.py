@@ -298,6 +298,40 @@ def test_input_cache_adaptive_bypass(servable):
         batcher.stop()
 
 
+def test_input_cache_bypass_is_regime_aware(servable):
+    """The probe window SLIDES: a unique phase after a hot repeated phase
+    still flips to bypass (round-3 weak #3: the one-shot probe kept paying
+    the digest because lifetime hit rate stayed high), and after
+    reprobe_every pass-through lookups a re-probe window re-engages the
+    cache when traffic turns repetitive again."""
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        cache = batcher.input_cache
+        cache.probe_window = 4
+        cache.reprobe_every = 3
+        hot = make_arrays(8, seed=7)
+        for _ in range(12):  # repeated phase: high global hit rate
+            batcher.submit(servable, hot).result()
+        assert not cache.bypassed and cache.hits >= 8
+        for s in range(5):  # unique phase: a cold window must still fire
+            batcher.submit(servable, make_arrays(8, seed=300 + s)).result()
+        assert cache.bypassed and cache.bypass_cycles == 1
+        # 2 more bypassed lookups reach reprobe_every=3 -> probing resumes;
+        # repeated traffic then re-engages the cache.
+        for s in range(2):
+            batcher.submit(servable, make_arrays(8, seed=400 + s)).result()
+        assert not cache.bypassed
+        for _ in range(4):
+            batcher.submit(servable, hot).result()
+        assert not cache.bypassed  # 3 hits / 4 lookups: window stays warm
+        h0 = cache.hits
+        for _ in range(3):
+            batcher.submit(servable, hot).result()
+        assert cache.hits > h0  # serving from the cache again
+    finally:
+        batcher.stop()
+
+
 def test_input_cache_pack_tag_disambiguates():
     """Same raw bytes packed under DIFFERENT transforms (one servable
     u24-packs ids, another serves them raw) must occupy distinct cache

@@ -1,0 +1,156 @@
+"""Compact-wire contract: client-side fold+bf16 halves request bytes with
+bit-identical scores, enforced pre-fold range, and hard rejection of
+anything that is not the documented widening pair."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import ml_dtypes
+
+from distributed_tf_serving_tpu.client import (
+    PredictClientError,
+    ShardedPredictClient,
+    build_predict_request,
+    compact_payload,
+    make_payload,
+)
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.serving import DynamicBatcher, PredictionServiceImpl
+from distributed_tf_serving_tpu.serving.server import create_server
+
+VOCAB = 1 << 14
+
+
+@pytest.fixture(scope="module")
+def stack():
+    config = ModelConfig(
+        name="DCN", num_fields=8, vocab_size=VOCAB, embed_dim=8,
+        mlp_dims=(16,), num_cross_layers=2, cross_full_matrix=True,
+    )
+    model = build_model("dcn_v2", config)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    registry = ServableRegistry()
+    registry.load(Servable(
+        name="DCN", version=1, model=model, params=params,
+        signatures=ctr_signatures(8),
+    ))
+    batcher = DynamicBatcher(buckets=(64, 256), max_wait_us=0).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    server, port = create_server(impl, "127.0.0.1:0")
+    server.start()
+    yield port
+    server.stop(0)
+    batcher.stop()
+
+
+def _predict(port, arrays):
+    async def go():
+        async with ShardedPredictClient(
+            [f"127.0.0.1:{port}"], "DCN", output_key="prediction_node"
+        ) as client:
+            return await client.predict(arrays)
+
+    return asyncio.run(go())
+
+
+def test_compact_scores_bit_identical(stack):
+    payload = make_payload(candidates=50, num_fields=8, seed=3)
+    compact = compact_payload(payload, VOCAB)
+    # Halved wire bytes at the reference point...
+    wide_bytes = len(build_predict_request(payload, "DCN").SerializeToString())
+    compact_bytes = len(build_predict_request(compact, "DCN").SerializeToString())
+    assert compact_bytes < 0.55 * wide_bytes
+    assert compact["feat_ids"].dtype == np.int32
+    assert compact["feat_wts"].dtype == ml_dtypes.bfloat16
+    # ...and the SAME scores, bitwise: both encodings produce identical
+    # packed device bytes (u24 of the same folded ids, the same bf16).
+    wide = _predict(stack, payload)
+    narrow = _predict(stack, compact)
+    np.testing.assert_array_equal(wide, narrow)
+
+
+def test_compact_unfolded_ids_rejected(stack):
+    payload = make_payload(candidates=10, num_fields=8, seed=4)
+    bad = compact_payload(payload, VOCAB)
+    bad["feat_ids"] = bad["feat_ids"] + VOCAB  # int32 but past the fold
+    with pytest.raises(PredictClientError, match="pre-folded|INVALID"):
+        _predict(stack, bad)
+
+
+def test_non_widening_dtype_still_rejected(stack):
+    payload = make_payload(candidates=10, num_fields=8, seed=5)
+    payload["feat_wts"] = payload["feat_wts"].astype(np.float16)  # not bf16
+    with pytest.raises(PredictClientError, match="dtype"):
+        _predict(stack, payload)
+
+
+def test_compact_negative_ids_rejected(stack):
+    """-1 would pass a max()-only guard and u24-pack to 0xFFFFFF — a wrong
+    but valid-looking embedding row (review finding); both range ends are
+    enforced."""
+    payload = make_payload(candidates=10, num_fields=8, seed=6)
+    bad = compact_payload(payload, VOCAB)
+    bad["feat_ids"] = bad["feat_ids"].copy()
+    bad["feat_ids"][0, 0] = -1
+    with pytest.raises(PredictClientError, match="pre-folded|INVALID"):
+        _predict(stack, bad)
+
+
+def test_combined_transfer_supports_bf16():
+    """ml_dtypes.bfloat16 has dtype.kind 'V'; a kind-only test rejected
+    exactly the compact weights the combined path exists to carry and
+    permanently demoted the servable to per-key transfers (review
+    finding)."""
+    from distributed_tf_serving_tpu.ops.transfer import combined_supported
+
+    arrays = {
+        "feat_ids": np.zeros((4, 8), np.int32),
+        "feat_wts": np.zeros((4, 8), ml_dtypes.bfloat16),
+    }
+    assert combined_supported(arrays)
+    assert not combined_supported({"x": np.zeros(3, np.int64)})
+    assert not combined_supported({"x": np.zeros(3, bool)})
+
+
+def test_bf16_rejected_where_model_needs_f32():
+    """wide_deep consumes weights through an f32 sparse-linear term
+    (wts_in_compute_dtype=False): bf16 there would NOT be bit-identical, so
+    the widening gate must reject it (review finding)."""
+    config = ModelConfig(
+        name="WD", num_fields=8, vocab_size=VOCAB, embed_dim=8, mlp_dims=(16,),
+    )
+    model = build_model("wide_deep", config)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    registry = ServableRegistry()
+    registry.load(Servable(
+        name="WD", version=1, model=model, params=params,
+        signatures=ctr_signatures(8),
+    ))
+    batcher = DynamicBatcher(buckets=(64,), max_wait_us=0).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    server, port = create_server(impl, "127.0.0.1:0")
+    server.start()
+    try:
+        payload = compact_payload(make_payload(10, 8, seed=9), VOCAB)
+        assert payload["feat_wts"].dtype == ml_dtypes.bfloat16
+
+        async def go():
+            async with ShardedPredictClient(
+                [f"127.0.0.1:{port}"], "WD", output_key="prediction_node"
+            ) as client:
+                return await client.predict(payload)
+
+        with pytest.raises(PredictClientError, match="dtype"):
+            asyncio.run(go())
+    finally:
+        server.stop(0)
+        batcher.stop()
