@@ -353,16 +353,22 @@ class Scale:
         self.timed_buckets = tuple(
             b for b in (1024, 2048, 4096, 8192, 16384, 32768) if b <= top
         ) if self.tpu else (256, 1024)
-        self.train_steps = 200 if self.tpu else 8
+        # 1000 steps (x5 round-3's 200): held-out AUC was information-
+        # limited, not optimization-limited — ~270 noisy Bernoulli views
+        # per embedding row cannot pin the teacher weight. 1000 steps
+        # (~1.3k views/row) plus full-horizon cosine decay reached 0.9235
+        # vs Bayes 0.9335 in the matched-density CPU study; the recorded
+        # auc_curve proves whichever limit remains.
+        self.train_steps = 1000 if self.tpu else 8
         self.train_batch = 2048 if self.tpu else 256
-        # Bench-scale training must be LEARNABLE, not just runnable: a
-        # uniform 262k-id catalog gives each embedding row ~50 noisy
-        # Bernoulli views in 200 steps — pure memorization, held-out AUC
-        # ~0.5 (measured r3). A 65k catalog (~200 views/row, closer to the
-        # head of a power-law CTR id distribution) with a hotter adam lr
-        # reaches ~0.84 vs the task's ~0.93 Bayes ceiling in ~10 s.
+        # Bench-scale training must be LEARNABLE, not just runnable: the
+        # teacher keys on raw ids, so an id seen a handful of times carries
+        # no transferable signal (a 262k-id catalog measured held-out AUC
+        # ~0.5 in r3). The 65k catalog — closer to the head of a power-law
+        # CTR id distribution — gives each embedding row the ~1.3k views
+        # the step count above is sized for.
         self.train_id_space = 1 << 16 if self.tpu else 1 << 12
-        self.train_lr = 1e-2
+        self.train_lr = 1.5e-2  # cosine peak (constant 1e-2 plateaued 0.03 lower)
         self.vocab_size = 1 << 20 if self.tpu else 1 << 14
         self.embed_dim = 16 if self.tpu else 8
         self.mlp_dims = (256, 128, 64) if self.tpu else (32, 16)
@@ -477,17 +483,29 @@ def train_on_chip(scale: Scale, config):
     from distributed_tf_serving_tpu.train.data import SyntheticCTRConfig
     from distributed_tf_serving_tpu.train.trainer import Trainer
 
+    import optax
+
     model = build_model("dcn_v2", config)
     t0 = time.perf_counter()
+    # Warmup + cosine-to-zero: the constant-LR run plateaued at 0.84 AUC
+    # with per-id gradient noise the tail never averaged out.
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=scale.train_lr,
+        warmup_steps=max(scale.train_steps // 10, 1),
+        decay_steps=scale.train_steps,
+    )
     trainer = Trainer(
         model,
-        learning_rate=scale.train_lr,
+        learning_rate=schedule,
         seed=0,
         stream_config=SyntheticCTRConfig(
             num_fields=config.num_fields, id_space=scale.train_id_space, seed=0
         ),
     )
-    metrics = trainer.fit(scale.train_steps, batch_size=scale.train_batch)
+    metrics = trainer.fit(
+        scale.train_steps, batch_size=scale.train_batch,
+        auc_every=max(scale.train_steps // 4, 1),
+    )
     auc_val, bayes = trainer.eval_auc(
         batches=4, batch_size=scale.train_batch, with_bayes=True
     )
@@ -500,14 +518,25 @@ def train_on_chip(scale: Scale, config):
         "loss": round(metrics["loss"], 4),
         "auc": round(auc_val, 4),  # held-out (indices disjoint from training)
         "bayes_auc": round(bayes, 4),  # the synthetic task's ceiling
+        "auc_curve": metrics.get("auc_curve"),  # steps-vs-AUC plateau proof
     }
     return model, trainer.state.params, block
 
 
-def pallas_probe(scale: Scale, config, cross_params) -> tuple[dict, bool]:
-    """VERDICT r2 task 3: run the fused Pallas cross kernel on the REAL
-    device (interpret only on the CPU smoke), assert it matches the XLA
-    path, time both, and decide whether serving should use it."""
+def pallas_probe(scale: Scale, config, cross_params) -> dict:
+    """Fused Pallas cross-stack capability probe: equality + timing vs the
+    per-layer XLA path on the real device (interpret on the CPU smoke).
+
+    DECISION (2026-07-31, round 4): the kernel is RETIRED from the serving
+    auto-enable path. Three rounds of on-chip measurement put it at
+    0.81-0.96x XLA at the flagship widths while the XLA path itself runs
+    at 0.70-0.73 MFU end-to-end (device_decomposition) — within ~1.4x of
+    the chip's roofline — and serving is host-bound at ~1% device
+    utilization, so even a winning kernel would not move the headline.
+    The kernel, its numerics-equality tests, and the explicit
+    ModelConfig.use_pallas_cross opt-in remain as a capability; this probe
+    keeps publishing the measured ratio so the decision stays auditable.
+    (README "Pallas" section carries the same note.)"""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -521,7 +550,6 @@ def pallas_probe(scale: Scale, config, cross_params) -> tuple[dict, bool]:
     interpret = not scale.tpu
     cd = config.cdtype
     block: dict = {"interpreted": interpret, "rows": scale.pallas_rows}
-    enable = False
     for d in scale.pallas_widths:
         entry: dict = {}
         try:
@@ -556,21 +584,16 @@ def pallas_probe(scale: Scale, config, cross_params) -> tuple[dict, bool]:
             entry["pallas_us"] = None if p_s is None else round(p_s * 1e6, 1)
             entry["xla_us"] = None if x_s is None else round(x_s * 1e6, 1)
             entry["speedup"] = round(x_s / p_s, 2) if (p_s and x_s) else None
-            if d == config.num_fields * config.embed_dim:
-                # Serve with the kernel only when it wins at the flagship
-                # width AND matches numerically (never on the CPU smoke:
-                # interpret mode proves lowering of nothing).
-                enable = bool(
-                    scale.tpu
-                    and entry.get("speedup")
-                    and entry["speedup"] > 1.0
-                    and entry["max_rel_err"] < 1e-2
-                )
         except Exception as exc:  # noqa: BLE001 — record, keep benching on XLA
             entry["error"] = f"{type(exc).__name__}: {exc}"[:500]
         block[f"d{d}"] = entry
-    block["enabled_for_serving"] = enable
-    return block, enable
+    block["enabled_for_serving"] = False  # retired (docstring decision)
+    block["decision"] = (
+        "retired-2026-07-31: 0.81-0.96x XLA across r2-r3 on-chip probes; "
+        "XLA path at 0.70-0.73 MFU and serving host-bound at ~1% device "
+        "utilization — kernel kept as ModelConfig.use_pallas_cross opt-in"
+    )
+    return block
 
 
 def device_decomposition(batcher, servable, scale: Scale, rtt_floor_ms, device: str) -> dict:
@@ -1123,16 +1146,8 @@ def child_main() -> None:
         log("checkpoint", f"headline windows complete: {qps:.1f} qps")
 
         stage = "pallas"
-        pallas_block, use_pallas = pallas_probe(scale, config, params["cross"])
+        pallas_block = pallas_probe(scale, config, params["cross"])
         log(stage, json.dumps(pallas_block))
-        if use_pallas:
-            # The probe ran after the XLA-path windows (headline first, so
-            # a wedge in the probe can't cost the round). When the fused
-            # kernel wins, serving enables it via config.use_pallas_cross
-            # (server CLI / ModelConfig); the headline stays the XLA
-            # number measured above — conservative, and the pallas block
-            # records the on-chip win for the next round to promote.
-            log(stage, "fused cross kernel wins on-chip; recorded for promotion")
 
         stage = "device_decomposition"
         device_block = device_decomposition(batcher, servable, scale, rtt_floor_ms, device)
