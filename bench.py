@@ -984,7 +984,8 @@ def child_main() -> None:
                     gauges that are not counters keep the window-end value."""
                     d = dataclasses.replace(after)
                     for f in ("batches", "requests", "candidates",
-                              "padded_candidates", "fill_waits"):
+                              "padded_candidates", "fill_waits",
+                              "fused_batches"):
                         setattr(d, f, getattr(after, f) - getattr(before, f))
                     return d
 
@@ -1180,6 +1181,7 @@ def child_main() -> None:
             "batch_occupancy": round(stats_rep.mean_occupancy, 3),
             "requests_per_batch": round(stats_rep.mean_requests_per_batch, 2),
             "batches": stats_rep.batches,
+            "fused_batches": stats_rep.fused_batches,
             "fill_waits": stats_rep.fill_waits,  # best window's, like the rest
             "input_cache": (
                 {

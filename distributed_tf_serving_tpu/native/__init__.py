@@ -106,6 +106,12 @@ def _load_locked(build: bool = True) -> ctypes.CDLL | None:
     lib.pack_u24_i32.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
     lib.f32_to_bf16.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
     lib.hash128.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    lib.pack_batch_u24_bf16.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+    ]
     return lib
 
 
@@ -193,4 +199,60 @@ def f32_to_bf16(wts: np.ndarray) -> np.ndarray:
     wts = np.ascontiguousarray(wts, dtype=np.float32)
     out = np.empty(wts.shape, ml_dtypes.bfloat16)
     lib.f32_to_bf16(_ptr(wts), wts.size, _ptr(out))
+    return out
+
+
+def pack_batch_u24_bf16(
+    ids_parts: list[np.ndarray],
+    wts_parts: list[np.ndarray],
+    fields: int,
+    bucket: int,
+    vocab: int,
+) -> np.ndarray:
+    """Fused batch assembly (see hostops.cc): per-request [n_p, F] id/weight
+    arrays -> the final padded combined uint8 buffer
+    [bucket*F*3 u24 | bucket*F*2 bf16] in one pass per input, zero padding
+    included. ids int64 are folded mod vocab; int32 (compact wire) pass
+    through; wts f32 are RNE-cast; bf16 copied. The per-part arrays must be
+    C-contiguous [n, fields] (the batcher's prepare_inputs guarantees it
+    for wire-decoded arrays; anything else is made contiguous here)."""
+    import ml_dtypes
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native hostops library unavailable")
+    nparts = len(ids_parts)
+    if nparts == 0 or nparts != len(wts_parts):
+        raise ValueError(f"part-count mismatch: {nparts} ids vs {len(wts_parts)} wts")
+    ids_c = [np.ascontiguousarray(a) for a in ids_parts]
+    wts_c = [np.ascontiguousarray(a) for a in wts_parts]
+    # Real raises, not asserts: these are the ONLY guards between caller
+    # mistakes and an out-of-bounds write in C (review finding — asserts
+    # vanish under python -O, turning a shape bug into heap corruption).
+    for i, (a, w) in enumerate(zip(ids_c, wts_c)):
+        if a.dtype not in (np.int64, np.int32):
+            raise ValueError(f"ids part {i}: dtype {a.dtype} not int64/int32")
+        if w.dtype not in (np.float32, ml_dtypes.bfloat16):
+            raise ValueError(f"wts part {i}: dtype {w.dtype} not f32/bf16")
+        if a.ndim != 2 or a.shape[1] != fields or w.shape != a.shape:
+            raise ValueError(
+                f"part {i}: shapes ids {a.shape} / wts {w.shape} do not "
+                f"match [n, {fields}]"
+            )
+    ids_ptrs = (ctypes.c_void_p * nparts)(*(a.ctypes.data for a in ids_c))
+    wts_ptrs = (ctypes.c_void_p * nparts)(*(a.ctypes.data for a in wts_c))
+    ids_is64 = np.fromiter(
+        (a.dtype == np.int64 for a in ids_c), np.uint8, nparts
+    )
+    wts_isf32 = np.fromiter(
+        (a.dtype == np.float32 for a in wts_c), np.uint8, nparts
+    )
+    ns = np.fromiter((a.shape[0] for a in ids_c), np.int64, nparts)
+    if int(ns.sum()) > bucket:
+        raise ValueError(f"{int(ns.sum())} rows exceed bucket {bucket}")
+    out = np.empty(bucket * fields * 5, np.uint8)  # 3 (u24) + 2 (bf16)
+    lib.pack_batch_u24_bf16(
+        ids_ptrs, _ptr(ids_is64), wts_ptrs, _ptr(wts_isf32),
+        _ptr(ns), nparts, fields, bucket, vocab, _ptr(out),
+    )
     return out

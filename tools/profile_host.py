@@ -80,6 +80,20 @@ def main() -> None:
     # host data plane — decode/batch/pack/encode/transport — which is the
     # quantity that transfers to the TPU rig.
     null_device = os.environ.get("PROF_NULL_DEVICE", "0") == "1"
+    # PROF_DEVICE_DELAY_MS stalls the batcher thread that long per batch
+    # (sleep drops the GIL like a real transfer wait): coalescing then
+    # fills batches to rig-like requests_per_batch, where per-BATCH host
+    # costs (generic pad vs fused pack) become visible. Applied on the
+    # REAL dispatch path below — a null-device run_fn would disable the
+    # input cache and the fused path entirely (batcher run_fn contract),
+    # so the combination is rejected rather than silently measuring the
+    # wrong thing.
+    delay_s = float(os.environ.get("PROF_DEVICE_DELAY_MS", "0")) / 1e3
+    if delay_s and null_device:
+        raise SystemExit(
+            "PROF_DEVICE_DELAY_MS requires the real dispatch path; "
+            "unset PROF_NULL_DEVICE (run_fn disables cache + fused pack)"
+        )
     run_fn = None
     if null_device:
         import numpy as _np
@@ -94,6 +108,24 @@ def main() -> None:
         completion_workers=4,
         run_fn=run_fn,
     ).start()
+    if delay_s:
+        # Stall both dispatch paths identically so the A/B isolates the
+        # host-side assembly cost, not the stall.
+        orig_exec = batcher._execute
+        orig_fused = batcher._try_execute_fused
+
+        def slow_exec(sv, arrays):
+            time.sleep(delay_s)
+            return orig_exec(sv, arrays)
+
+        def slow_fused(group, bucket):
+            out = orig_fused(group, bucket)
+            if out is not None:
+                time.sleep(delay_s)
+            return out
+
+        batcher._execute = slow_exec
+        batcher._try_execute_fused = slow_fused
     servable = Servable(
         name="DCN", version=1, model=model, params=params,
         signatures=ctr_signatures(NUM_FIELDS),
