@@ -1072,6 +1072,14 @@ def child_main() -> None:
                 # field; the headline stays on the reference-parity int64
                 # wire (DCNClient.java:98-108).
                 batcher.max_batch_candidates = min(16384, batcher.buckets[-1])
+                if batcher.input_cache is not None:
+                    # Phase boundary: the unique loop legitimately flipped
+                    # the cache to bypass; the compact A/B measures the
+                    # repeated-traffic operating point, so re-arm rather
+                    # than waiting out the auto re-probe cycle.
+                    batcher.input_cache.bypassed = False
+                    batcher.input_cache._win_hits = 0
+                    batcher.input_cache._win_lookups = 0
                 compact = compact_payload(payload, scale.vocab_size)
                 report_c = await loop(
                     pool=None, rpw=scale.requests_per_worker,

@@ -81,12 +81,25 @@ def main() -> None:
     payload = make_payload(candidates=CANDIDATES, num_fields=NUM_FIELDS)
     results = []
 
+    # EXP_COMPACT=1: the framework-native wire (client-side fold + bf16,
+    # half the bytes, bit-identical scores) — the round-4 on-rig A/B knob,
+    # composable with EXP_UNIQUE. DTS_TPU_NO_FUSED=1 (batcher env) isolates
+    # the native fused pack in the same sweeps.
+    compact = os.environ.get("EXP_COMPACT", "0") == "1"
+    if compact:
+        from distributed_tf_serving_tpu.client import compact_payload
+
+        payload = compact_payload(payload, config.vocab_size)
     pool = None
     if os.environ.get("EXP_UNIQUE", "0") == "1":
         pool = [
             make_payload(candidates=CANDIDATES, num_fields=NUM_FIELDS, seed=100 + i)
             for i in range(128)
         ]
+        if compact:
+            from distributed_tf_serving_tpu.client import compact_payload
+
+            pool = [compact_payload(p, config.vocab_size) for p in pool]
 
     async def sweep(port: int):
         import dataclasses
@@ -117,6 +130,8 @@ def main() -> None:
             d_padded = stats.padded_candidates - before.padded_candidates
             point = {
                 "server": "aio" if use_aio else "threads",
+                "compact": compact,
+                "fused_off": os.environ.get("DTS_TPU_NO_FUSED") == "1",
                 "concurrency": conc,
                 "qps": round(s["qps"], 1),
                 "p50_ms": round(s["p50_ms"], 1),
