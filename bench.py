@@ -1077,9 +1077,7 @@ def child_main() -> None:
                     # the cache to bypass; the compact A/B measures the
                     # repeated-traffic operating point, so re-arm rather
                     # than waiting out the auto re-probe cycle.
-                    batcher.input_cache.bypassed = False
-                    batcher.input_cache._win_hits = 0
-                    batcher.input_cache._win_lookups = 0
+                    batcher.input_cache.rearm()
                 compact = compact_payload(payload, scale.vocab_size)
                 report_c = await loop(
                     pool=None, rpw=scale.requests_per_worker,

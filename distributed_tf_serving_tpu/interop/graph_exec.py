@@ -24,11 +24,16 @@ Scope (documented, enforced):
   key/value constants; LookupTableFindV2 lowers to searchsorted + select —
   pure vectorized device code, no host callback (the common id-remap
   preprocessing in CTR exports).
-- NOT supported (explicit UnsupportedOpError naming the node): control flow
-  (If/While/case), TensorList/TensorArray, stateful mutation
-  (AssignVariableOp in the serving path), sparse ops, string processing,
-  mutable/file-backed/string-keyed tables. An export that needs them must
-  be served by its original runtime.
+- Constant-predicate conditionals (If/StatelessIf/Case over a predicate
+  the graph determines at trace time — the config-gated preprocessing
+  shape): the chosen branch is inlined, exactly XLA's own constant-fold
+  behavior.
+- NOT supported (explicit UnsupportedOpError naming the node):
+  data-dependent control flow (live-predicate If, While/loops),
+  TensorList/TensorArray, stateful mutation (AssignVariableOp in the
+  serving path), sparse ops, string processing, mutable/file-backed/
+  string-keyed tables. An export that needs them must be served by its
+  original runtime.
 
 Numerics: executed under jax.enable_x64 when the graph carries int64/f64
 tensors (TF semantics are x64-native; silently downcasting hashed int64
@@ -513,6 +518,59 @@ class _FunctionLibrary:
         # table node name -> (sorted_keys, sorted_values) numpy arrays;
         # populated by _resolve_table_contents (GraphExecutor/graph_model).
         self.tables: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        # Import-time variable values (numpy), for resolving conditional
+        # predicates concretely: under the serving jit the live params are
+        # TRACERS, but a config-gated If's predicate is decided by frozen
+        # export-time values — which is also what the value will be on
+        # every request (inference params never change within a servable
+        # version). Populated by graph_model.
+        self.const_params: dict[str, np.ndarray] = {}
+
+
+def _concrete_ref(env, lib, ref: str, what: str):
+    """Evaluate `ref` to a CONCRETE value even mid-trace, re-walking the
+    producing chain with numpy and import-time variable values. Touching
+    live data (a Placeholder, or a traced function arg with no concrete
+    origin) raises UnsupportedOpError — that predicate genuinely is
+    data-dependent."""
+    val = env.tensor(ref)
+    if not isinstance(val, jax.core.Tracer):
+        return val
+    parts = ref.split(":")
+    head = parts[0]
+    idx = int(parts[-1]) if len(parts) > 1 and parts[-1].isdigit() else 0
+    node = env.nodes.get(head)
+    if node is None:
+        raise UnsupportedOpError(
+            f"{what}: ref {ref!r} has no concrete origin in this scope"
+        )
+    if node.op in ("Placeholder", "PlaceholderWithDefault"):
+        raise UnsupportedOpError(f"{what}: depends on live input {head!r}")
+    if node.op == "ReadVariableOp":
+        handle = _concrete_ref(env, lib, node.input[0], what)
+        if isinstance(handle, VarRef) and handle.key in lib.const_params:
+            return lib.const_params[handle.key]
+        raise UnsupportedOpError(
+            f"{what}: variable read has no import-time value"
+        )
+    fn = _OPS.get(node.op)
+    if fn is None:
+        raise UnsupportedOpError(
+            f"{what}: cannot concretely evaluate op {node.op!r} ({head!r})"
+        )
+    inputs = [
+        _concrete_ref(env, lib, i, what)
+        for i in node.input
+        if not i.startswith("^")
+    ]
+    try:
+        return fn(node, inputs, np)[idx]
+    except (UnsupportedOpError, GraphExecError):
+        raise
+    except Exception as exc:  # noqa: BLE001
+        raise UnsupportedOpError(
+            f"{what}: concrete re-evaluation of {head!r} failed: {exc}"
+        ) from exc
 
 
 _TABLE_INIT_OPS = ("LookupTableImportV2", "LookupTableImport",
@@ -789,6 +847,50 @@ def _eval_node(node, env, lib, params) -> tuple:
             f"{node.name}: stateful variable mutation ({op}) in a serving "
             "graph is outside the executor's scope"
         )
+    if op in ("If", "StatelessIf"):
+        # Constant-predicate conditionals: the chosen branch is inlined at
+        # trace time (exactly what XLA would do after constant folding).
+        # Serving graphs gate preprocessing on captured config constants/
+        # variables; under the serving jit those reads are TRACERS, so the
+        # predicate is re-evaluated concretely against import-time values
+        # (_concrete_ref). A predicate that genuinely depends on live
+        # input stays out of scope (would need lax.cond with matched
+        # branch signatures) and raises the documented error.
+        try:
+            cond = _concrete_ref(
+                env, lib, node.input[0], f"node {node.name!r} ({op}) predicate"
+            )
+        except UnsupportedOpError as exc:
+            raise UnsupportedOpError(
+                f"node {node.name!r}: {op} with a data-dependent predicate "
+                f"is outside the executor's scope ({exc})"
+            ) from exc
+        branch = "then_branch" if bool(np.asarray(cond)) else "else_branch"
+        fname = node.attr[branch].func.name
+        args = [env.tensor(i) for i in node.input[1:] if not i.startswith("^")]
+        return _invoke_function(fname, node, args, lib, params, role=branch)
+    if op in ("Case", "StatelessCase"):
+        try:
+            idx = _concrete_ref(
+                env, lib, node.input[0], f"node {node.name!r} ({op}) index"
+            )
+        except UnsupportedOpError as exc:
+            raise UnsupportedOpError(
+                f"node {node.name!r}: {op} with a data-dependent branch "
+                f"index is outside the executor's scope ({exc})"
+            ) from exc
+        branches = node.attr["branches"].list.func
+        if not branches:
+            raise GraphExecError(f"{node.name}: Case with no branches")
+        i = int(np.asarray(idx))
+        # TF semantics: ANY out-of-range index (negative included) runs
+        # the LAST branch.
+        if i < 0 or i >= len(branches):
+            i = len(branches) - 1
+        args = [env.tensor(r) for r in node.input[1:] if not r.startswith("^")]
+        return _invoke_function(
+            branches[i].name, node, args, lib, params, role=f"branch {i}"
+        )
     if op in _CALL_OPS:
         fname = node.attr["f"].func.name
         return _call_function(fname, node, env, lib, params)
@@ -818,17 +920,25 @@ def _eval_node(node, env, lib, params) -> tuple:
         ) from exc
 
 
-def _call_function(fname, node, env, lib, params) -> tuple:
+def _invoke_function(fname, node, args, lib, params, role="function") -> tuple:
+    """Arity-checked FunctionDef invocation — ONE implementation shared by
+    direct calls, If branches, and Case branches, so a mismatched call
+    always reports 'takes N args, got M' rather than a downstream
+    unknown-node error."""
     fdef = lib.functions.get(fname)
     if fdef is None:
-        raise GraphExecError(f"{node.name}: unknown function {fname!r}")
-    args = [env.tensor(i) for i in node.input if not i.startswith("^")]
+        raise GraphExecError(f"{node.name}: unknown {role} {fname!r}")
     want = len(fdef.signature.input_arg)
     if len(args) != want:
         raise GraphExecError(
-            f"{node.name}: function {fname!r} takes {want} args, got {len(args)}"
+            f"{node.name}: {role} {fname!r} takes {want} args, got {len(args)}"
         )
     return _FuncEval(fdef, args, lib, params).results()
+
+
+def _call_function(fname, node, env, lib, params) -> tuple:
+    args = [env.tensor(i) for i in node.input if not i.startswith("^")]
+    return _invoke_function(fname, node, args, lib, params)
 
 
 # ------------------------------------------------------------- public API
@@ -903,6 +1013,9 @@ def graph_model(
     Returns (model, params). params is the variables dict itself — the
     model's pytree is flat {variable_key: array}."""
     ex = GraphExecutor(meta_graph, signature_name)
+    # Import-time values back the concrete predicate resolution for
+    # config-gated conditionals (see _FunctionLibrary.const_params).
+    ex.lib.const_params = {k: np.asarray(v) for k, v in variables.items()}
     sig = meta_graph.signature_def[signature_name]
 
     # num_fields from the first 2-D int input when present (diagnostics and

@@ -214,6 +214,19 @@ class DeviceInputCache:
         self._win_lookups = 0
         self._bypassed_lookups = 0
 
+    def rearm(self) -> None:
+        """Exit bypass immediately and restart the probe cycle — for
+        callers that KNOW a traffic-regime boundary just happened (a bench
+        phase change, a deployment cutover) and should not wait out the
+        automatic re-probe cadence. One locked reset of the full counter
+        set so external callers cannot drift from _note_bypassed's own
+        re-arm sequence."""
+        with self._lock:
+            self.bypassed = False
+            self._bypassed_lookups = 0
+            self._win_hits = 0
+            self._win_lookups = 0
+
     def _note_bypassed(self) -> None:
         """Count a pass-through lookup; periodically re-enter probing."""
         with self._lock:

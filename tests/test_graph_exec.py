@@ -408,6 +408,92 @@ def test_static_hashtable_export_matches_tf(tmp_path):
     np.testing.assert_allclose(got_jit, want, rtol=2e-5, atol=1e-6)
 
 
+_EXPORT_COND = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+out = sys.argv[1]
+rng = np.random.RandomState(41)
+
+
+class M(tf.Module):
+    def __init__(self):
+        super().__init__()
+        self.w = tf.Variable(rng.randn(4, 3).astype(np.float32), name="w")
+        # Captured config tensor driving the branch: exported as a real
+        # StatelessIf/If node (a python bool would be traced away).
+        self.use_relu = tf.Variable(True, trainable=False, name="use_relu")
+
+    @tf.function(input_signature=[tf.TensorSpec([None, 4], tf.float32, name="x")])
+    def __call__(self, x):
+        h = tf.matmul(x, self.w)
+        h = tf.cond(self.use_relu, lambda: tf.nn.relu(h), lambda: tf.nn.tanh(h))
+        return {"prediction_node": tf.reduce_sum(h, axis=1)}
+
+
+m = M()
+tf.saved_model.save(m, out, signatures={"serving_default": m.__call__})
+import json
+xs = np.arange(12, dtype=np.float32).reshape(3, 4) / 6.0 - 0.5
+f = tf.saved_model.load(out).signatures["serving_default"]
+print("GOLDEN=" + json.dumps([float(v) for v in f(x=tf.constant(xs))["prediction_node"].numpy()]))
+"""
+
+
+def test_constant_predicate_cond_export(tmp_path):
+    """A genuine tf.cond export gated on a captured config variable must
+    serve: the executor resolves the predicate at trace time and inlines
+    the chosen branch (If/StatelessIf)."""
+    out = tmp_path / "cond_sm"
+    r = subprocess.run(
+        [sys.executable, "-c", _EXPORT_COND, str(out)],
+        capture_output=True, text=True, timeout=600,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"tensorflow export unavailable: {r.stderr[-800:]}")
+    golden = next(l for l in r.stdout.splitlines() if l.startswith("GOLDEN="))
+    want = np.asarray(json.loads(golden[len("GOLDEN="):]), np.float32)
+    sv = import_savedmodel(out, "graph", ModelConfig(name="C", num_fields=4), name="C")
+    xs = np.arange(12, dtype=np.float32).reshape(3, 4) / 6.0 - 0.5
+    got = np.asarray(sv.model.apply(sv.params, {"x": xs})["prediction_node"], np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+    # And under jit — the SERVING path, where params (and so the variable
+    # read feeding the predicate) are tracers: the executor must resolve
+    # the predicate from import-time values, not reject it (review
+    # finding: the un-jitted assertion alone left serving broken).
+    got_jit = np.asarray(
+        jax.jit(sv.model.apply)(sv.params, {"x": xs})["prediction_node"],
+        np.float32,
+    )
+    np.testing.assert_allclose(got_jit, want, rtol=2e-5, atol=1e-6)
+
+
+def test_data_dependent_if_is_named():
+    """An If whose predicate depends on live input stays a documented,
+    node-named error under jit (no silent single-branch inlining)."""
+    meta = _tiny_meta("cond:0")
+    g = meta.graph_def
+    red = g.node.add(); red.name = "pred"; red.op = "Any"
+    red.input.extend(["x", "axes"])
+    ax = g.node.add(); ax.name = "axes"; ax.op = "Const"
+    ax.attr["value"].tensor.dtype = 3
+    ax.attr["value"].tensor.int_val.append(0)
+    ax.attr["value"].tensor.tensor_shape.dim.add().size = 1
+    cond = g.node.add(); cond.name = "cond"; cond.op = "StatelessIf"
+    cond.input.extend(["pred", "x"])
+    fn = g.library.function.add()
+    fn.signature.name = "branch"
+    cond.attr["then_branch"].func.name = "branch"
+    cond.attr["else_branch"].func.name = "branch"
+
+    model, params = graph_model(meta, {}, name="dd")
+    with pytest.raises(UnsupportedOpError, match="data-dependent"):
+        jax.jit(lambda p, b: model.apply(p, b))(
+            params, {"x": np.ones((2, 2), np.float32) > 0}
+        )
+
+
 def test_unresolvable_table_is_named():
     """A find against a table with no statically resolvable contents must
     raise the documented UnsupportedOpError naming the node, not a shape

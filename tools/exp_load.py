@@ -97,8 +97,6 @@ def main() -> None:
             for i in range(128)
         ]
         if compact:
-            from distributed_tf_serving_tpu.client import compact_payload
-
             pool = [compact_payload(p, config.vocab_size) for p in pool]
 
     async def sweep(port: int):
