@@ -121,6 +121,39 @@ def test_combined_transfer_supports_bf16():
     assert not combined_supported({"x": np.zeros(3, bool)})
 
 
+def test_compact_over_sharded_mesh_executor():
+    """Compact payloads through the MESH path (DynamicBatcher ->
+    ShardedExecutor over the 8-device CPU mesh): the fold skip (int32) and
+    bf16 passthrough must survive candidate sharding with scores equal to
+    the wide path."""
+    from distributed_tf_serving_tpu.models import build_model
+    from distributed_tf_serving_tpu.parallel import ShardedExecutor, make_mesh
+
+    config = ModelConfig(
+        name="DCN", num_fields=8, vocab_size=VOCAB, embed_dim=8,
+        mlp_dims=(16,), num_cross_layers=2, cross_full_matrix=True,
+    )
+    model = build_model("dcn_v2", config)
+    sv = Servable(
+        name="DCN", version=1, model=model,
+        params=jax.jit(model.init)(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(8),
+    )
+    mesh = make_mesh(8, model_parallel=2)
+    batcher = DynamicBatcher(
+        buckets=(64,), max_wait_us=0, run_fn=ShardedExecutor(mesh)
+    ).start()
+    try:
+        wide = make_payload(candidates=40, num_fields=8, seed=17)
+        a = batcher.submit(sv, wide).result(timeout=120)["prediction_node"]
+        b = batcher.submit(sv, compact_payload(wide, VOCAB)).result(
+            timeout=120
+        )["prediction_node"]
+        np.testing.assert_array_equal(a, b)
+    finally:
+        batcher.stop()
+
+
 def test_bf16_rejected_where_model_needs_f32():
     """wide_deep consumes weights through an f32 sparse-linear term
     (wts_in_compute_dtype=False): bf16 there would NOT be bit-identical, so
