@@ -37,6 +37,23 @@ def test_fold_i32_pow2_mask_path():
     np.testing.assert_array_equal(native.fold_i32(ids, vocab), want)
 
 
+def test_fold_ids_canonical_helper(monkeypatch):
+    """native.fold_ids is THE shared fold (server batcher + client
+    compact_payload): native and numpy fallback must be bit-identical, and
+    non-int64 input passes through the numpy path unchanged in value."""
+    rng = np.random.RandomState(2)
+    ids = rng.randint(-(1 << 61), 1 << 61, size=(97, 7), dtype=np.int64)
+    for vocab in (1 << 20, 1009):
+        a = native.fold_ids(ids, vocab)
+        monkeypatch.setattr(native, "available", lambda: False)
+        b = native.fold_ids(ids, vocab)
+        monkeypatch.undo()
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == np.int32
+    already = np.arange(12, dtype=np.int32).reshape(3, 4)
+    np.testing.assert_array_equal(native.fold_ids(already, 1 << 20), already)
+
+
 def test_pack_u24_boundaries():
     ids = np.array([[0, 1, 255, 256, 65535, 65536, (1 << 24) - 1]], np.int32)
     got = native.pack_u24_i32(ids)
