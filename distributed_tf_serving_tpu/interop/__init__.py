@@ -10,15 +10,6 @@ the serving process — its descriptor pool collides with ours), and the
 result lands in the model zoo's native param trees / checkpoint format.
 """
 
-from .savedmodel import (
-    SavedModelImportError,
-    extract_variables,
-    import_savedmodel,
-    map_variables,
-    read_saved_model,
-    signatures_from_meta_graph,
-)
-
 __all__ = [
     "SavedModelImportError",
     "extract_variables",
@@ -27,3 +18,16 @@ __all__ = [
     "read_saved_model",
     "signatures_from_meta_graph",
 ]
+
+
+def __getattr__(name):
+    # Lazy re-exports (PEP 562): savedmodel pulls the vendored proto
+    # bindings, and the EXPORT path (interop/export.py) must be importable
+    # in a process that imports TensorFlow first — our tensorflow.*
+    # descriptors collide with TF's in the process-wide pool, so this
+    # package must not register them as an import side effect.
+    if name in __all__:
+        from . import savedmodel
+
+        return getattr(savedmodel, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

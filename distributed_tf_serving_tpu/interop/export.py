@@ -1,0 +1,179 @@
+"""Export a native servable as a TensorFlow SavedModel — the reverse
+interop leg.
+
+The importer (interop/savedmodel.py) brings TF-Serving artifacts IN; this
+module takes trained in-tree models OUT to consumers still running
+`tensorflow_model_server`: the zoo forward is converted with jax2tf
+(StableHLO carried in an `XlaCallModule` op, which TF's runtime executes
+natively — jax 0.9 emits this for every conversion mode), wrapped in the
+reference serving contract (`feat_ids` DT_INT64 + `feat_wts` DT_FLOAT
+[n,F] -> `prediction_node`, DCNClient.java:98-108), with the vocab fold
+expressed in TF ops (`floormod` == the host fold's exact mod) so int64
+ids beyond 2^31 survive exactly as they do in-tree. Weights land as
+ordinary tf.Variables, so the artifact has the standard `variables/`
+TensorBundle layout and version-directory lifecycle tools work unchanged.
+
+This is the BASELINE.json north star's direction ("a jax2tf-exported
+SavedModel") implemented as the exit path; round-trip intake of such an
+artifact by OUR graph executor is out of scope by design — XlaCallModule
+embeds StableHLO, not TF ops, and the native side serves its own
+checkpoints (train/checkpoint.py) without any TF detour.
+
+MUST run in a process that has NOT imported the vendored protos: our
+tensorflow.* descriptors collide with TensorFlow's in the process-wide
+descriptor pool. `python -m distributed_tf_serving_tpu.interop.export`
+imports tensorflow first and only proto-free subpackages after (models/
+train keep their proto imports lazy for exactly this reason —
+models/registry.py note).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def export_servable(checkpoint_dir: str, out_dir: str, validate: bool = True) -> dict:
+    """Convert the checkpointed servable to a SavedModel at `out_dir`.
+
+    Returns a summary dict (model kind, num params, validation result).
+    Raises if the servable is outside the standard 2-input CTR contract
+    (DLRM dense_features exports are not implemented yet — documented)."""
+    import os
+
+    import tensorflow as tf  # noqa: F401 — must precede any proto import
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # This image's sitecustomize pins the axon TPU platform OVER the
+        # env var; honoring an explicit CPU request needs the config-level
+        # override before any backend initializes (tests/conftest.py note)
+        # — otherwise a wedged relay hangs the export inside backend init.
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.experimental import jax2tf
+
+    from ..train.checkpoint import load_servable
+
+    servable = load_servable(checkpoint_dir)
+    model = servable.model
+    config = model.config
+    sig = servable.signature("")
+    input_names = sorted(s.name for s in sig.inputs)
+    if input_names != ["feat_ids", "feat_wts"]:
+        raise NotImplementedError(
+            f"export supports the standard 2-input CTR contract; servable "
+            f"declares {input_names} (dense_features exports not implemented)"
+        )
+    if not model.folds_ids_on_host:
+        raise NotImplementedError(
+            "export requires a zoo servable with the host id fold contract"
+        )
+    F = config.num_fields
+    vocab = config.vocab_size
+    params = jax.tree.map(np.asarray, servable.params)
+
+    def forward(p, ids32, wts):
+        out = model.apply(p, {"feat_ids": ids32, "feat_wts": wts})
+        return out["prediction_node"]
+
+    tf_fn = jax2tf.convert(
+        forward,
+        polymorphic_shapes=[None, f"(b, {F})", f"(b, {F})"],
+        with_gradient=False,
+    )
+
+    class ExportedCTR(tf.Module):
+        pass
+
+    module = ExportedCTR()
+    # tf.Variables per leaf: standard variables/ layout in the artifact.
+    module.params = tf.nest.map_structure(tf.Variable, params)
+
+    @tf.function(
+        input_signature=[
+            tf.TensorSpec([None, F], tf.int64, name="feat_ids"),
+            tf.TensorSpec([None, F], tf.float32, name="feat_wts"),
+        ]
+    )
+    def serve(feat_ids, feat_wts):
+        # TF-side exact fold (floormod == mathematical mod): int64 wire ids
+        # stay faithful past 2^31, and the converted fn sees the folded
+        # int32 ids the in-tree serving path feeds the model.
+        ids32 = tf.cast(tf.math.floormod(feat_ids, tf.constant(vocab, tf.int64)), tf.int32)
+        return {"prediction_node": tf_fn(module.params, ids32, feat_wts)}
+
+    module.serve = serve
+    # Validate-then-commit: the artifact is written to a sibling temp dir,
+    # validated THROUGH TF from there, and only renamed into place when it
+    # passes — a version watcher pointed at the output base path must
+    # never see a complete-looking directory holding a diverged model
+    # (same protocol as train/checkpoint.py save_servable).
+    import shutil
+
+    tmp_dir = out_dir.rstrip("/") + f".tmp-export-{os.getpid()}"
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    try:
+        tf.saved_model.save(module, tmp_dir, signatures={"serving_default": serve})
+        summary = {
+            "out": out_dir,
+            "model": servable.name,
+            "version": servable.version,
+            "num_fields": F,
+            "vocab_size": vocab,
+            "param_leaves": len(jax.tree.leaves(params)),
+        }
+        if validate:
+            # Reload the artifact THROUGH TF and compare against the
+            # in-tree forward on ids past 2^31 (the fold-fidelity
+            # regression the importer tests pin in the other direction).
+            # Scores are sigmoid outputs in (0,1): a single absolute gate
+            # is the right metric, and it is the SAME bound the export
+            # tests assert — one threshold, no flaky gap between them.
+            max_abs_err_bound = 1e-5
+            rng = np.random.RandomState(7)
+            ids = rng.randint(0, 1 << 40, size=(16, F)).astype(np.int64)
+            wts = rng.rand(16, F).astype(np.float32)
+            reloaded = tf.saved_model.load(tmp_dir).signatures["serving_default"]
+            got = reloaded(feat_ids=tf.constant(ids), feat_wts=tf.constant(wts))[
+                "prediction_node"
+            ].numpy()
+            from .. import native
+
+            want = np.asarray(
+                forward(servable.params, native.fold_ids(ids, vocab), wts)
+            )
+            err = float(np.max(np.abs(got - want)))
+            if err >= max_abs_err_bound:
+                raise RuntimeError(
+                    f"exported SavedModel diverges from the native forward "
+                    f"(max abs err {err:.3e} >= {max_abs_err_bound})"
+                )
+            summary["validated"] = True
+            summary["max_abs_err"] = err
+        shutil.rmtree(out_dir, ignore_errors=True)
+        os.replace(tmp_dir, out_dir)
+    except BaseException:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
+    return summary
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="Export a native servable checkpoint as a TF SavedModel"
+    )
+    parser.add_argument("--checkpoint", required=True,
+                        help="servable checkpoint dir (train.save_servable)")
+    parser.add_argument("--out", required=True, help="SavedModel output dir")
+    parser.add_argument("--no-validate", action="store_true")
+    args = parser.parse_args(argv)
+    summary = export_servable(
+        args.checkpoint, args.out, validate=not args.no_validate
+    )
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,8 +17,13 @@ import threading
 import jax.numpy as jnp
 import numpy as np
 
-from ..proto import tf_framework_pb2 as fw
-from ..proto import tf_meta_graph_pb2 as mg
+# NOTE: the proto bindings are imported LAZILY inside the functions that
+# build protobuf messages (to_tensor_info / to_signature_def /
+# ctr_signatures): our vendored tensorflow.* descriptors collide with
+# TensorFlow's own in the process-wide descriptor pool, and the SavedModel
+# EXPORT path (interop/export.py) must import tensorflow + this models
+# package in ONE process. Keeping this module proto-free at import time is
+# what makes that possible.
 from .base import Batch, Model, Params
 
 # TF-Serving method names carried in SignatureDef.method_name.
@@ -49,7 +54,9 @@ class TensorSpec:
     # (tensor_shape.proto unknown_rank, seen in imported SavedModels).
     shape: tuple[int | None, ...] | None
 
-    def to_tensor_info(self) -> mg.TensorInfo:
+    def to_tensor_info(self):
+        from ..proto import tf_meta_graph_pb2 as mg
+
         info = mg.TensorInfo(name=f"{self.name}:0", dtype=self.dtype)
         if self.shape is None:
             info.tensor_shape.unknown_rank = True
@@ -78,7 +85,9 @@ class Signature:
     def output_names(self) -> list[str]:
         return [s.name for s in self.outputs]
 
-    def to_signature_def(self) -> mg.SignatureDef:
+    def to_signature_def(self):
+        from ..proto import tf_meta_graph_pb2 as mg
+
         sd = mg.SignatureDef(method_name=self.method_name)
         for spec in self.inputs:
             sd.inputs[spec.name].CopyFrom(spec.to_tensor_info())
@@ -90,31 +99,38 @@ class Signature:
 def ctr_signatures(num_fields: int, with_dense: int | None = None) -> dict[str, Signature]:
     """The standard CTR signature set matching the reference contract
     (feat_ids int64 [n,F] + feat_wts float [n,F] -> prediction_node [n])."""
+    # Hardcoded DataType values (types.proto, wire-frozen since TF 1.0:
+    # DT_FLOAT=1, DT_STRING=7, DT_INT64=9) rather than the proto enum: the
+    # SavedModel EXPORT path calls this from a process where TensorFlow
+    # owns the descriptor pool, so this function must not import the
+    # vendored bindings even lazily (tests/test_codec.py pins these values
+    # against the real enum).
+    DT_FLOAT, DT_STRING, DT_INT64 = 1, 7, 9
     inputs = [
-        TensorSpec("feat_ids", fw.DataType.DT_INT64, (None, num_fields)),
-        TensorSpec("feat_wts", fw.DataType.DT_FLOAT, (None, num_fields)),
+        TensorSpec("feat_ids", DT_INT64, (None, num_fields)),
+        TensorSpec("feat_wts", DT_FLOAT, (None, num_fields)),
     ]
     if with_dense:
-        inputs.append(TensorSpec("dense_features", fw.DataType.DT_FLOAT, (None, with_dense)))
+        inputs.append(TensorSpec("dense_features", DT_FLOAT, (None, with_dense)))
     predict = Signature(
         inputs=tuple(inputs),
         outputs=(
-            TensorSpec("prediction_node", fw.DataType.DT_FLOAT, (None,)),
-            TensorSpec("logits", fw.DataType.DT_FLOAT, (None,)),
+            TensorSpec("prediction_node", DT_FLOAT, (None,)),
+            TensorSpec("logits", DT_FLOAT, (None,)),
         ),
         method_name=PREDICT_METHOD,
     )
     classify = dataclasses.replace(
         predict,
         outputs=(
-            TensorSpec("scores", fw.DataType.DT_FLOAT, (None, 2)),
-            TensorSpec("classes", fw.DataType.DT_STRING, (None, 2)),
+            TensorSpec("scores", DT_FLOAT, (None, 2)),
+            TensorSpec("classes", DT_STRING, (None, 2)),
         ),
         method_name=CLASSIFY_METHOD,
     )
     regress = dataclasses.replace(
         predict,
-        outputs=(TensorSpec("outputs", fw.DataType.DT_FLOAT, (None,)),),
+        outputs=(TensorSpec("outputs", DT_FLOAT, (None,)),),
         method_name=REGRESS_METHOD,
     )
     return {DEFAULT_SIGNATURE: predict, "classify": classify, "regress": regress}
@@ -142,7 +158,7 @@ class Servable:
     def __call__(self, batch: Batch) -> dict[str, jnp.ndarray]:
         return self.model.apply(self.params, batch)
 
-    def signature_def_map(self) -> dict[str, mg.SignatureDef]:
+    def signature_def_map(self) -> dict:
         return {k: v.to_signature_def() for k, v in self.signatures.items()}
 
 

@@ -26,9 +26,9 @@ import numpy as np
 import optax
 from jax.sharding import Mesh
 
+from .. import native
 from ..models.base import Model
 from ..parallel.sharding import batch_shardings, place_params
-from ..serving.batcher import fold_ids_host
 from .data import SyntheticCTRConfig, SyntheticCTRStream, auc
 
 
@@ -118,7 +118,7 @@ class Trainer:
 
     def _prepare(self, batch: dict[str, np.ndarray]) -> dict[str, jnp.ndarray]:
         out = {
-            "feat_ids": fold_ids_host(batch["feat_ids"], self.model.config.vocab_size),
+            "feat_ids": native.fold_ids(batch["feat_ids"], self.model.config.vocab_size),
             "feat_wts": batch["feat_wts"],
             "labels": batch["labels"],
         }

@@ -141,3 +141,18 @@ def test_bfloat16_half_val_bit_patterns():
     assert out[0] == ml_dtypes.bfloat16(1.5)
     back = codec.from_ndarray(out, use_tensor_content=False)
     assert list(back.half_val) == [0x3FC0]
+
+
+def test_registry_hardcoded_dtype_values_match_enum():
+    """models/registry.ctr_signatures hardcodes DataType values so the
+    SavedModel export process never imports the vendored protos (descriptor
+    pool collision with TF); pin them against the real enum here."""
+    from distributed_tf_serving_tpu.models import ctr_signatures
+
+    sigs = ctr_signatures(4, with_dense=3)
+    specs = {s.name: s.dtype for s in sigs["serving_default"].inputs}
+    assert specs["feat_ids"] == fw.DataType.DT_INT64 == 9
+    assert specs["feat_wts"] == fw.DataType.DT_FLOAT == 1
+    assert specs["dense_features"] == fw.DataType.DT_FLOAT
+    cls = {s.name: s.dtype for s in sigs["classify"].outputs}
+    assert cls["classes"] == fw.DataType.DT_STRING == 7

@@ -1,0 +1,117 @@
+"""SavedModel EXPORT (interop/export.py — the reverse interop leg): a
+trained native servable becomes a standard TF-Serving artifact, validated
+for score parity by TensorFlow itself in the export subprocess, and for
+the reference wire contract by our own proto reader in-process."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+F = 6
+CFG = ModelConfig(
+    name="DCN", num_fields=F, vocab_size=1 << 12, embed_dim=8,
+    mlp_dims=(16,), num_cross_layers=2, cross_full_matrix=True,
+)
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    root = tmp_path_factory.mktemp("export")
+    ckpt, out = root / "ckpt", root / "sm"
+    model = build_model("dcn_v2", CFG)
+    sv = Servable(
+        name="DCN", version=3, model=model,
+        params=jax.jit(model.init)(jax.random.PRNGKey(5)),
+        signatures=ctr_signatures(F),
+    )
+    save_servable(ckpt, sv, kind="dcn_v2")
+    # Export in a SUBPROCESS: it imports tensorflow; this process holds the
+    # vendored protos — the two must never share a descriptor pool.
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_tf_serving_tpu.interop.export",
+         "--checkpoint", str(ckpt), "--out", str(out)],
+        capture_output=True, text=True, timeout=900,
+    )
+    if r.returncode != 0:
+        if "tensorflow" in r.stderr.lower() and "No module" in r.stderr:
+            pytest.skip("tensorflow unavailable for export")
+        raise AssertionError(r.stderr[-2000:])
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    return sv, out, summary
+
+
+def test_export_validates_against_native_forward(exported):
+    """The export subprocess reloads its own artifact through TF and
+    compares against the in-tree forward on ids past 2^31; the summary
+    carries that verdict."""
+    _sv, _out, summary = exported
+    assert summary["validated"] is True
+    assert summary["max_abs_err"] < 1e-5
+    assert summary["vocab_size"] == CFG.vocab_size
+
+
+def test_export_carries_reference_wire_contract(exported):
+    """Read the artifact with OUR proto bindings (no TF in this process):
+    serving_default must declare the reference contract — feat_ids
+    DT_INT64 [-1,F] + feat_wts DT_FLOAT -> prediction_node DT_FLOAT — so
+    the reference's own Java client could hit a server loading this
+    artifact unchanged."""
+    from distributed_tf_serving_tpu.interop import read_saved_model
+    from distributed_tf_serving_tpu.interop.savedmodel import serve_meta_graph
+    from distributed_tf_serving_tpu.proto import tf_framework_pb2 as fw
+
+    _sv, out, _summary = exported
+    meta = serve_meta_graph(read_saved_model(out))
+    sig = meta.signature_def["serving_default"]
+    assert sig.inputs["feat_ids"].dtype == fw.DataType.DT_INT64
+    assert [d.size for d in sig.inputs["feat_ids"].tensor_shape.dim] == [-1, F]
+    assert sig.inputs["feat_wts"].dtype == fw.DataType.DT_FLOAT
+    assert sig.outputs["prediction_node"].dtype == fw.DataType.DT_FLOAT
+    # The artifact stores weights in the standard variables/ TensorBundle.
+    assert (out / "variables").exists()
+
+
+def test_export_round_trip_scores_via_tf_golden(exported):
+    """Independent TF process scores the artifact on a fresh batch; must
+    match the native servable's own forward (fold included)."""
+    sv, out, _summary = exported
+    golden_src = f"""
+import json
+import numpy as np
+import tensorflow as tf
+rng = np.random.RandomState(11)
+ids = rng.randint(0, 1 << 40, size=(9, {F})).astype(np.int64)
+wts = rng.rand(9, {F}).astype(np.float32)
+f = tf.saved_model.load({str(out)!r}).signatures["serving_default"]
+print(json.dumps([float(x) for x in
+                  f(feat_ids=tf.constant(ids), feat_wts=tf.constant(wts))["prediction_node"].numpy()]))
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", golden_src],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    got = np.asarray(json.loads(r.stdout.strip().splitlines()[-1]), np.float32)
+    from distributed_tf_serving_tpu import native
+
+    rng = np.random.RandomState(11)
+    ids = rng.randint(0, 1 << 40, size=(9, F)).astype(np.int64)
+    wts = rng.rand(9, F).astype(np.float32)
+    want = np.asarray(sv.model.apply(
+        sv.params,
+        {"feat_ids": native.fold_ids(ids, CFG.vocab_size), "feat_wts": wts},
+    )["prediction_node"], np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
