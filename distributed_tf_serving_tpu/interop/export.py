@@ -38,8 +38,8 @@ def export_servable(checkpoint_dir: str, out_dir: str, validate: bool = True) ->
     """Convert the checkpointed servable to a SavedModel at `out_dir`.
 
     Returns a summary dict (model kind, num params, validation result).
-    Raises if the servable is outside the standard 2-input CTR contract
-    (DLRM dense_features exports are not implemented yet — documented)."""
+    Supports the standard 2-input CTR contract and the 3-input
+    dense_features (DLRM) contract; anything else raises."""
     import os
 
     import tensorflow as tf  # noqa: F401 — must precede any proto import
@@ -61,10 +61,24 @@ def export_servable(checkpoint_dir: str, out_dir: str, validate: bool = True) ->
     config = model.config
     sig = servable.signature("")
     input_names = sorted(s.name for s in sig.inputs)
-    if input_names != ["feat_ids", "feat_wts"]:
+    dense_dim = None
+    if input_names == ["dense_features", "feat_ids", "feat_wts"]:
+        dense_spec = sig.input_specs["dense_features"]
+        dense_dim = dense_spec.shape[1] if dense_spec.shape else None
+        if not dense_dim:
+            # A declared-but-unknown dense width must FAIL, not silently
+            # ship a 2-input artifact: DLRM substitutes zeros for a missing
+            # dense input, so validation alone could never catch the
+            # dropped contract (review finding).
+            raise NotImplementedError(
+                "dense_features with unknown width cannot be exported "
+                f"(signature shape {dense_spec.shape}); re-save the "
+                "servable with a concrete num_dense_features"
+            )
+    elif input_names != ["feat_ids", "feat_wts"]:
         raise NotImplementedError(
-            f"export supports the standard 2-input CTR contract; servable "
-            f"declares {input_names} (dense_features exports not implemented)"
+            f"export supports the CTR contracts (2-input, or 3-input with "
+            f"dense_features); servable declares {input_names}"
         )
     if not model.folds_ids_on_host:
         raise NotImplementedError(
@@ -74,15 +88,16 @@ def export_servable(checkpoint_dir: str, out_dir: str, validate: bool = True) ->
     vocab = config.vocab_size
     params = jax.tree.map(np.asarray, servable.params)
 
-    def forward(p, ids32, wts):
-        out = model.apply(p, {"feat_ids": ids32, "feat_wts": wts})
-        return out["prediction_node"]
+    def forward(p, ids32, wts, dense=None):
+        batch = {"feat_ids": ids32, "feat_wts": wts}
+        if dense is not None:
+            batch["dense_features"] = dense
+        return model.apply(p, batch)["prediction_node"]
 
-    tf_fn = jax2tf.convert(
-        forward,
-        polymorphic_shapes=[None, f"(b, {F})", f"(b, {F})"],
-        with_gradient=False,
-    )
+    poly = [None, f"(b, {F})", f"(b, {F})"]
+    if dense_dim is not None:
+        poly.append(f"(b, {dense_dim})")
+    tf_fn = jax2tf.convert(forward, polymorphic_shapes=poly, with_gradient=False)
 
     class ExportedCTR(tf.Module):
         pass
@@ -91,18 +106,25 @@ def export_servable(checkpoint_dir: str, out_dir: str, validate: bool = True) ->
     # tf.Variables per leaf: standard variables/ layout in the artifact.
     module.params = tf.nest.map_structure(tf.Variable, params)
 
-    @tf.function(
-        input_signature=[
-            tf.TensorSpec([None, F], tf.int64, name="feat_ids"),
-            tf.TensorSpec([None, F], tf.float32, name="feat_wts"),
-        ]
-    )
-    def serve(feat_ids, feat_wts):
+    specs = [
+        tf.TensorSpec([None, F], tf.int64, name="feat_ids"),
+        tf.TensorSpec([None, F], tf.float32, name="feat_wts"),
+    ]
+    if dense_dim is not None:
+        specs.append(
+            tf.TensorSpec([None, dense_dim], tf.float32, name="dense_features")
+        )
+
+    @tf.function(input_signature=specs)
+    def serve(feat_ids, feat_wts, dense_features=None):
         # TF-side exact fold (floormod == mathematical mod): int64 wire ids
         # stay faithful past 2^31, and the converted fn sees the folded
         # int32 ids the in-tree serving path feeds the model.
         ids32 = tf.cast(tf.math.floormod(feat_ids, tf.constant(vocab, tf.int64)), tf.int32)
-        return {"prediction_node": tf_fn(module.params, ids32, feat_wts)}
+        args = (ids32, feat_wts) if dense_features is None else (
+            ids32, feat_wts, dense_features
+        )
+        return {"prediction_node": tf_fn(module.params, *args)}
 
     module.serve = serve
     # Validate-then-commit: the artifact is written to a sibling temp dir,
@@ -135,14 +157,18 @@ def export_servable(checkpoint_dir: str, out_dir: str, validate: bool = True) ->
             rng = np.random.RandomState(7)
             ids = rng.randint(0, 1 << 40, size=(16, F)).astype(np.int64)
             wts = rng.rand(16, F).astype(np.float32)
+            feeds = {"feat_ids": tf.constant(ids), "feat_wts": tf.constant(wts)}
+            extra = ()
+            if dense_dim is not None:
+                dense = rng.rand(16, dense_dim).astype(np.float32)
+                feeds["dense_features"] = tf.constant(dense)
+                extra = (dense,)
             reloaded = tf.saved_model.load(tmp_dir).signatures["serving_default"]
-            got = reloaded(feat_ids=tf.constant(ids), feat_wts=tf.constant(wts))[
-                "prediction_node"
-            ].numpy()
+            got = reloaded(**feeds)["prediction_node"].numpy()
             from .. import native
 
             want = np.asarray(
-                forward(servable.params, native.fold_ids(ids, vocab), wts)
+                forward(servable.params, native.fold_ids(ids, vocab), wts, *extra)
             )
             err = float(np.max(np.abs(got - want)))
             if err >= max_abs_err_bound:

@@ -84,6 +84,34 @@ def test_export_carries_reference_wire_contract(exported):
     assert (out / "variables").exists()
 
 
+def test_export_dlrm_dense_features(tmp_path):
+    """The 3-input DLRM contract (dense_features) exports too, with the
+    same TF-side validation."""
+    cfg = ModelConfig(
+        name="DLRM", num_fields=F, vocab_size=1 << 12, embed_dim=8,
+        mlp_dims=(16,), num_dense_features=4, bottom_mlp_dims=(16, 8),
+    )
+    model = build_model("dlrm", cfg)
+    sv = Servable(
+        name="DLRM", version=1, model=model,
+        params=jax.jit(model.init)(jax.random.PRNGKey(2)),
+        signatures=ctr_signatures(F, with_dense=4),
+    )
+    ckpt, out = tmp_path / "ckpt", tmp_path / "sm"
+    save_servable(ckpt, sv, kind="dlrm")
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_tf_serving_tpu.interop.export",
+         "--checkpoint", str(ckpt), "--out", str(out)],
+        capture_output=True, text=True, timeout=900,
+    )
+    if r.returncode != 0:
+        if "tensorflow" in r.stderr.lower() and "No module" in r.stderr:
+            pytest.skip("tensorflow unavailable for export")
+        raise AssertionError(r.stderr[-2000:])
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["validated"] is True and summary["max_abs_err"] < 1e-5
+
+
 def test_export_round_trip_scores_via_tf_golden(exported):
     """Independent TF process scores the artifact on a fresh batch; must
     match the native servable's own forward (fold included)."""
