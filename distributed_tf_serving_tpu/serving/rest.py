@@ -1,0 +1,282 @@
+"""TF-Serving-compatible REST gateway (the :8501 surface).
+
+`tensorflow_model_server` serves every model on two ports: gRPC (:8500)
+and a JSON REST API (:8501) with the `/v1/models/...` routes. The
+reference client speaks gRPC only (DCNClient.java), but the ecosystem the
+reference lives in — dashboards, canary probes, curl debugging — uses the
+REST surface constantly; a drop-in replacement must answer it.
+
+Routes (TF-Serving REST API v1 semantics):
+- `POST /v1/models/{model}[/versions/{v}]:predict`
+  body `{"instances": [...]}` (row format: one dict per instance, or the
+  bare value for single-input models) -> `{"predictions": [...]}`;
+  body `{"inputs": {...}}` (columnar) -> `{"outputs": ...}` (dict when
+  the signature has several outputs, bare tensor when one);
+  optional `"signature_name"`.
+- `GET  /v1/models/{model}` -> version status list.
+- `GET  /v1/models/{model}/metadata` -> signature metadata (JSON).
+
+Requests are converted to the SAME PredictRequest protos the gRPC path
+parses and handed to PredictionServiceImpl.predict_async — one
+implementation of resolution, validation, widening, batching, and error
+taxonomy; the gateway only translates JSON<->tensors and ServiceError
+codes onto HTTP statuses (TF-Serving's own REST error shape:
+`{"error": "..."}`).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+from aiohttp import web
+
+from .. import codec
+from ..proto import serving_apis_pb2 as apis
+from .service import PredictionServiceImpl, ServiceError
+
+log = logging.getLogger("dts_tpu.rest")
+
+_HTTP_STATUS = {
+    "NOT_FOUND": 404,
+    "INVALID_ARGUMENT": 400,
+    "RESOURCE_EXHAUSTED": 429,
+    "UNAVAILABLE": 503,
+    "DEADLINE_EXCEEDED": 504,
+    "INTERNAL": 500,
+}
+
+
+def _json_error(code: str, message: str) -> web.Response:
+    return web.json_response(
+        {"error": message}, status=_HTTP_STATUS.get(code, 500)
+    )
+
+
+class RestGateway:
+    """aiohttp application exposing a PredictionServiceImpl over REST."""
+
+    def __init__(self, impl: PredictionServiceImpl):
+        self.impl = impl
+        self.app = web.Application(client_max_size=256 * 1024 * 1024)
+        self.app.add_routes([
+            web.post("/v1/models/{model}:predict", self.predict),
+            web.post(
+                "/v1/models/{model}/versions/{version}:predict", self.predict
+            ),
+            web.get("/v1/models/{model}", self.status),
+            web.get("/v1/models/{model}/metadata", self.metadata),
+        ])
+
+    # ------------------------------------------------------------- helpers
+
+    def _resolve_specs(self, model: str, version, signature_name: str):
+        # ONE lookup-error taxonomy, shared with the gRPC path.
+        from .service import _wrap_lookup
+
+        servable = _wrap_lookup(lambda: self.impl.registry.resolve(model, version))
+        sig = _wrap_lookup(lambda: servable.signature(signature_name))
+        return servable, sig
+
+    @staticmethod
+    def _parse_version(raw) -> int | None:
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except ValueError as e:
+            # A non-numeric /versions/{v} segment is a CLIENT error (TF-
+            # Serving also has /labels/{l}; labels are out of scope here),
+            # not an internal one.
+            raise ServiceError(
+                "INVALID_ARGUMENT", f"version must be an integer, got {raw!r}"
+            ) from e
+
+    @staticmethod
+    def _arrays_from_instances(instances, sig) -> dict[str, np.ndarray]:
+        if not isinstance(instances, list) or not instances:
+            raise ServiceError(
+                "INVALID_ARGUMENT", "instances must be a non-empty list"
+            )
+        specs = sig.input_specs
+        if isinstance(instances[0], dict):
+            columns: dict[str, list] = {}
+            for i, inst in enumerate(instances):
+                if not isinstance(inst, dict):
+                    raise ServiceError(
+                        "INVALID_ARGUMENT",
+                        f"instance {i} is not an object (mixed row formats)",
+                    )
+                for k, v in inst.items():
+                    columns.setdefault(k, []).append(v)
+            if any(len(v) != len(instances) for v in columns.values()):
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    "every instance must carry the same input names",
+                )
+        else:
+            # Bare-value shorthand: legal only for single-input signatures
+            # (TF-Serving REST API rule).
+            if len(specs) != 1:
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    "bare-value instances require a single-input signature; "
+                    f"this one expects {sorted(specs)}",
+                )
+            columns = {next(iter(specs)): instances}
+        return RestGateway._to_ndarrays(columns, specs)
+
+    @staticmethod
+    def _to_ndarrays(columns: dict, specs) -> dict[str, np.ndarray]:
+        arrays = {}
+        for name, vals in columns.items():
+            spec = specs.get(name)
+            np_dtype = codec.dtype_to_numpy(spec.dtype) if spec else None
+            try:
+                arrays[name] = np.asarray(vals, dtype=np_dtype)
+            except (TypeError, ValueError, OverflowError) as e:
+                raise ServiceError(
+                    "INVALID_ARGUMENT", f"input {name!r}: {e}"
+                ) from e
+        return arrays
+
+    # -------------------------------------------------------------- routes
+
+    async def predict(self, request: web.Request) -> web.Response:
+        model = request.match_info["model"]
+        try:
+            version = self._parse_version(request.match_info.get("version"))
+            try:
+                body = await request.json()
+            except Exception as e:  # noqa: BLE001 — malformed JSON is a 400
+                return _json_error("INVALID_ARGUMENT", f"invalid JSON body: {e}")
+            if not isinstance(body, dict):
+                return _json_error("INVALID_ARGUMENT", "body must be a JSON object")
+            signature_name = body.get("signature_name", "")
+            row_format = "instances" in body
+            if row_format == ("inputs" in body):
+                return _json_error(
+                    "INVALID_ARGUMENT",
+                    'body must carry exactly one of "instances" or "inputs"',
+                )
+            servable, sig = self._resolve_specs(model, version, signature_name)
+            if row_format:
+                arrays = self._arrays_from_instances(body["instances"], sig)
+            else:
+                cols = body["inputs"]
+                if not isinstance(cols, dict):
+                    # Bare columnar tensor: single-input shorthand.
+                    specs = sig.input_specs
+                    if len(specs) != 1:
+                        return _json_error(
+                            "INVALID_ARGUMENT",
+                            "bare inputs require a single-input signature",
+                        )
+                    cols = {next(iter(specs)): cols}
+                arrays = self._to_ndarrays(cols, sig.input_specs)
+
+            # ONE semantics path: the same proto the gRPC surface parses.
+            req = apis.PredictRequest()
+            req.model_spec.name = model
+            req.model_spec.signature_name = signature_name
+            if version is not None:
+                req.model_spec.version.value = version
+            for key, arr in arrays.items():
+                codec.from_ndarray(
+                    arr, use_tensor_content=True, out=req.inputs[key]
+                )
+            resp = await self.impl.predict_async(req)
+            outputs = {
+                k: codec.to_ndarray(v).tolist() for k, v in resp.outputs.items()
+            }
+            if row_format:
+                names = list(outputs)
+                if len(names) == 1:
+                    predictions = outputs[names[0]]
+                else:
+                    n = len(next(iter(outputs.values())))
+                    predictions = [
+                        {k: outputs[k][i] for k in names} for i in range(n)
+                    ]
+                return web.json_response({"predictions": predictions})
+            if len(outputs) == 1:
+                return web.json_response(
+                    {"outputs": next(iter(outputs.values()))}
+                )
+            return web.json_response({"outputs": outputs})
+        except ServiceError as e:
+            return _json_error(e.code, str(e))
+        except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
+            log.exception("internal error serving REST predict")
+            return _json_error("INTERNAL", f"internal error: {e}")
+
+    async def status(self, request: web.Request) -> web.Response:
+        model = request.match_info["model"]
+        versions = self.impl.registry.models().get(model)
+        if not versions:
+            return _json_error("NOT_FOUND", f"model {model!r} not found")
+        return web.json_response({
+            "model_version_status": [
+                {
+                    "version": str(v),
+                    "state": "AVAILABLE",
+                    "status": {"error_code": "OK", "error_message": ""},
+                }
+                for v in sorted(versions)
+            ]
+        })
+
+    async def metadata(self, request: web.Request) -> web.Response:
+        model = request.match_info["model"]
+        try:
+            servable, _ = self._resolve_specs(model, None, "")
+        except ServiceError as e:
+            return _json_error(e.code, str(e))
+
+        from ..proto import tf_framework_pb2 as fw
+
+        def spec_json(spec):
+            shape = (
+                {"unknown_rank": True}
+                if spec.shape is None
+                else {"dim": [{"size": str(-1 if d is None else d)} for d in spec.shape]}
+            )
+            # Enum by NAME: proto3 JSON (what tensorflow_model_server's
+            # REST metadata emits) prints enums as strings, and ecosystem
+            # parsers match on "DT_INT64", not 9.
+            try:
+                dtype = fw.DataType.Name(spec.dtype)
+            except ValueError:
+                dtype = int(spec.dtype)
+            return {"dtype": dtype, "tensor_shape": shape}
+
+        sig_defs = {
+            name: {
+                "method_name": sig.method_name,
+                "inputs": {s.name: spec_json(s) for s in sig.inputs},
+                "outputs": {s.name: spec_json(s) for s in sig.outputs},
+            }
+            for name, sig in servable.signatures.items()
+        }
+        return web.json_response({
+            "model_spec": {
+                "name": servable.name,
+                "version": str(servable.version),
+                "signature_name": "",
+            },
+            "metadata": {"signature_def": {"signature_def": sig_defs}},
+        })
+
+
+async def start_rest_gateway(
+    impl: PredictionServiceImpl, host: str = "127.0.0.1", port: int = 8501
+) -> tuple[web.AppRunner, int]:
+    """Start the gateway; returns (runner, bound_port). Stop with
+    `await runner.cleanup()`."""
+    gw = RestGateway(impl)
+    runner = web.AppRunner(gw.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    bound = runner.addresses[0][1]  # public API (private site._server breaks across aiohttp versions)
+    return runner, bound

@@ -295,6 +295,13 @@ def build_stack(
 
 
 def serve(argv=None) -> None:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Honor an explicit CPU request over this image's sitecustomize
+        # axon pin (config-level override required before backend init —
+        # same guard as bench.py's probe and interop/export.py).
+        jax.config.update("jax_platforms", "cpu")
     parser = argparse.ArgumentParser(description="TPU-native PredictionService")
     parser.add_argument("--config", help="TOML config file ([server] section)")
     parser.add_argument("--checkpoint", help="servable checkpoint dir (train.save_servable)")
@@ -322,6 +329,9 @@ def serve(argv=None) -> None:
         help="shard dense MLP/cross weights over the model axis",
     )
     parser.add_argument("--no-warmup", action="store_true")
+    parser.add_argument("--rest-port", dest="rest_port", type=int, default=0,
+                        help="also serve the TF-Serving REST API (:8501 "
+                        "surface, /v1/models/... routes) on this port")
     parser.add_argument("--metrics-every-s", type=float, default=0.0,
                         help="periodically log a metrics snapshot")
     args = parser.parse_args(argv)
@@ -359,6 +369,48 @@ def serve(argv=None) -> None:
     metrics = ServerMetrics()
     server, port = create_server(impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics)
     server.start()
+    if args.rest_port:
+        # REST rides its own event loop in a daemon thread: the gRPC
+        # server here is the threaded variant, and the gateway only
+        # touches the (thread-safe) impl/batcher. Startup is SYNCHRONIZED:
+        # an operator who asked for the :8501 surface must get a fatal
+        # error on bind failure, not a healthy-looking gRPC server plus a
+        # dead thread (tensorflow_model_server exits on REST bind failure
+        # too).
+        import asyncio
+        import threading
+
+        from .rest import start_rest_gateway
+
+        rest_ready: dict = {}
+        rest_up = threading.Event()
+
+        def run_rest():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            try:
+                _runner, bound = loop.run_until_complete(
+                    start_rest_gateway(impl, cfg.host, args.rest_port)
+                )
+                rest_ready["port"] = bound
+            except BaseException as exc:  # noqa: BLE001 — reported to main
+                rest_ready["error"] = exc
+                return
+            finally:
+                rest_up.set()
+            loop.run_forever()
+
+        threading.Thread(target=run_rest, name="rest", daemon=True).start()
+        rest_up.wait(timeout=30)
+        if "error" in rest_ready:
+            server.stop(0)
+            batcher.stop()
+            raise SystemExit(
+                f"REST gateway failed to start on {cfg.host}:{args.rest_port}: "
+                f"{rest_ready['error']}"
+            )
+        log.info("REST gateway on %s:%d (/v1/models/...)",
+                 cfg.host, rest_ready.get("port", args.rest_port))
     log.info(
         "PredictionService on %s:%d (model=%s kind=%s mesh=%s devices=%s)",
         cfg.host, port, servable.name if servable else "<awaiting versions>",
