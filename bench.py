@@ -8,7 +8,7 @@ PredictionService over a real localhost gRPC socket — the full stack the
 reference exercised, with tensorflow_model_server replaced by the JAX/XLA
 backend and its server-side batching by the padded-bucket pipeline batcher.
 
-Scope (rounds 3-4), all in the ONE json line:
+Scope (rounds 3-5), all in the ONE json line:
 - headline `value` = the MEDIAN of three sustained windows (8192/16384/
   32768 batch caps; best_window stays a separate field) — robust to the
   rig's documented 370-517 QPS tunnel drift;
@@ -23,7 +23,15 @@ Scope (rounds 3-4), all in the ONE json line:
   stalls are flagged, never quoted as the chip), device-limited QPS, MFU,
   upload_mb_s + the unique-traffic link cap, rtt floor;
 - p50_colocated_est: the <=2 ms north-star argument from measured host
-  phases + device step (components listed; BASELINE.md analysis);
+  phases + device step (components listed; BASELINE.md analysis) — and,
+  new in r5, the MEASURED counterpart: latency_mode (2048 cap, 4-way
+  concurrency, p50/p99 + phase means) with p50_latency_mode_minus_rtt_ms
+  subtracting the same-run relay floor;
+- host_ceiling / wide_wire_ceiling_qps (r5): the same closed loop against
+  a null-device batcher on the same core — the measured transport+service
+  upper bound for each wire format, so vs_baseline shortfalls are
+  attributed to a measured bound instead of re-litigated against tunnel
+  weather;
 - the Pallas capability probe (equality + timing; RETIRED from serving by
   the dated decision in pallas_probe's docstring) and an adversarial
   overload phase recording shed behavior (RESOURCE_EXHAUSTED);
@@ -150,7 +158,13 @@ def fail(stage: str, error: str, **extra) -> None:
     measurement when one exists (provenance-labeled, VERDICT r3 task 2):
     the rig being down at collection time must degrade the evidence, not
     zero it. rc stays 1 — the LIVE run did fail; the value field carries
-    the last real measurement instead of a meaningless 0.0."""
+    the last real measurement instead of a meaningless 0.0.
+
+    CONSUMER CONTRACT (advisor r4): a salvaged line still reports rc=1 and
+    carries salvaged/salvaged_from_commit/measured_at/live_value — any
+    consumer reading `value` MUST gate on `salvaged` (or rc) before
+    attributing the number to this run; the driver's BENCH_r*.json records
+    rc alongside the line, so provenance survives ingestion."""
     line = {
         "metric": "ctr_qps_per_chip_1k",
         "value": 0.0,
@@ -1040,6 +1054,39 @@ def child_main() -> None:
                     "batch_cap": best[0],
                     "qps": round(best[1].summary()["qps"], 1),
                 }
+
+                stage = "latency_mode"
+                # VERDICT r4 task 4: MEASURE the latency operating point
+                # instead of estimating it. Small bucket cap + near-zero
+                # concurrency = no queueing, batches of 1-2 requests: the
+                # measured p50 is rtt_floor + host work + device step. On
+                # this rig the ~65-70 ms relay floor dominates, so the
+                # number that answers the <=2 ms north star is p50 MINUS
+                # the same-run rtt floor (the relay is rig plumbing, not
+                # architecture; a co-located client pays ~0.1 ms instead).
+                batcher.max_batch_candidates = min(2048, batcher.buckets[-1])
+                request_trace.reset()
+                lat_conc = 4 if scale.tpu else 2
+                lat_rpw = 100 if scale.tpu else 3
+                log(stage, f"batch_cap={batcher.max_batch_candidates} "
+                           f"concurrency={lat_conc} x {lat_rpw}")
+                report_l = await loop(prepared=True, conc=lat_conc, rpw=lat_rpw)
+                s_l = report_l.summary()
+                res["latency_mode"] = {
+                    "batch_cap": batcher.max_batch_candidates,
+                    "concurrency": lat_conc,
+                    "requests": s_l["requests"],
+                    "qps": round(s_l["qps"], 1),
+                    "p50_ms": round(s_l["p50_ms"], 3),
+                    "p99_ms": round(s_l["p99_ms"], 3),
+                    "mean_ms": round(s_l["mean_ms"], 3),
+                    "phases_us": {
+                        name: snap["mean_us"]
+                        for name, snap in request_trace.snapshot().items()
+                    },
+                }
+                log(stage, f"p50={s_l['p50_ms']:.2f}ms p99={s_l['p99_ms']:.2f}ms "
+                           f"(rtt_floor={rtt_floor_ms and round(rtt_floor_ms, 2)}ms)")
             finally:
                 await server.stop(0)
 
@@ -1125,6 +1172,75 @@ def child_main() -> None:
             finally:
                 await server.stop(0)
 
+        async def measure_host_ceiling():
+            nonlocal stage
+            stage = "host_ceiling"
+            # VERDICT r4 task 2: the measured transport ceiling, INSIDE the
+            # artifact. A second server over the SAME registry but a null-
+            # device batcher (run_fn returns canned scores; no jit, no
+            # transfer, no relay) serves the identical closed loop on the
+            # identical core: the measured QPS is everything EXCEPT the
+            # device — grpc transport + proto decode/encode + batching +
+            # merge/sort — i.e. the hard upper bound any device could reach
+            # through this host. vs_baseline arguments stop re-litigating
+            # tunnel weather: headline < ceiling < target means the wire is
+            # transport-bound on this 1-core host, measured same-session.
+            def null_run(sv, arrays):
+                n = next(iter(arrays.values())).shape[0]
+                return {"prediction_node": np.zeros(n, np.float32)}
+
+            ceil_batcher = DynamicBatcher(
+                buckets=scale.buckets,
+                max_wait_us=2000,
+                completion_workers=12,
+                run_fn=null_run,
+            ).start()
+            try:
+                ceil_impl = PredictionServiceImpl(registry, ceil_batcher)
+                server, port = create_server_async(ceil_impl, "127.0.0.1:0")
+                await server.start()
+                try:
+                    ceil_batcher.max_batch_candidates = min(
+                        16384, ceil_batcher.buckets[-1]
+                    )
+                    loop = make_loop(port)
+                    rpw = 40 if scale.tpu else 3
+                    log(stage, f"null-device wide wire: conc={scale.concurrency} x {rpw}")
+                    rep_w = await loop(prepared=True, conc=scale.concurrency, rpw=rpw)
+                    compact = compact_payload(payload, scale.vocab_size)
+                    log(stage, "null-device compact wire (same window)")
+                    async with ShardedPredictClient(
+                        [f"127.0.0.1:{port}"], "DCN",
+                        channels_per_host=scale.channels_per_host,
+                    ) as client:
+                        rep_c = await run_closed_loop(
+                            client, compact,
+                            concurrency=scale.concurrency,
+                            requests_per_worker=rpw,
+                            sort_scores=True,
+                            warmup_requests=5,
+                            prepared=True,
+                        )
+                    s_w, s_c = rep_w.summary(), rep_c.summary()
+                    res["host_ceiling"] = {
+                        "wide_wire_ceiling_qps": round(s_w["qps"], 1),
+                        "wide_p50_ms": round(s_w["p50_ms"], 3),
+                        "compact_wire_ceiling_qps": round(s_c["qps"], 1),
+                        "compact_p50_ms": round(s_c["p50_ms"], 3),
+                        "requests_each": s_w["requests"],
+                        "note": "same closed loop vs a null-device batcher "
+                                "in the same process/core: transport + "
+                                "decode/batch/encode with zero device or "
+                                "relay time — the measured upper bound of "
+                                "this host's data plane per wire format",
+                    }
+                    log(stage, f"wide ceiling {s_w['qps']:.1f} qps, "
+                               f"compact ceiling {s_c['qps']:.1f} qps")
+                finally:
+                    await server.stop(0)
+            finally:
+                ceil_batcher.stop()
+
         asyncio.run(serve_windows())
         report = res["report"]
         s = report.summary()
@@ -1152,6 +1268,7 @@ def child_main() -> None:
             "headline_batch_cap": res["headline_batch_cap"],
             "best_window": res["best_window"],
             "rtt_floor_ms": None if rtt_floor_ms is None else round(rtt_floor_ms, 2),
+            "latency_mode": res.get("latency_mode"),
             "train": train_block,
             "device": device,
             "partial": True,
@@ -1175,6 +1292,8 @@ def child_main() -> None:
         phases_unique = res["phases_unique"]
         overload_block = res["overload"]
         batcher.stop()
+
+        asyncio.run(measure_host_ceiling())
 
         stage = "report"
         dev_qps = device_block.get("device_limited_qps") or 0.0
@@ -1210,6 +1329,25 @@ def child_main() -> None:
                 else None
             ),
             "achieved_fraction_of_device_limit": round(qps / dev_qps, 3) if dev_qps else None,
+            # Measured latency operating point (VERDICT r4 task 4): the
+            # minus-rtt variant is the architecture's p50 with the rig's
+            # relay plumbing subtracted — the number the <=2 ms north star
+            # is judged against (a co-located client pays ~0.1 ms dispatch
+            # instead of the relay floor).
+            "p50_latency_mode_ms": (
+                res["latency_mode"]["p50_ms"] if res.get("latency_mode") else None
+            ),
+            "p50_latency_mode_minus_rtt_ms": (
+                round(res["latency_mode"]["p50_ms"] - rtt_floor_ms, 3)
+                if res.get("latency_mode") and rtt_floor_ms is not None
+                else None
+            ),
+            # Measured same-session transport ceiling (VERDICT r4 task 2).
+            "wide_wire_ceiling_qps": (
+                res["host_ceiling"]["wide_wire_ceiling_qps"]
+                if res.get("host_ceiling") else None
+            ),
+            "host_ceiling": res.get("host_ceiling"),
             "p50_colocated_est": colocated_latency_estimate(
                 phases, device_block, stats_rep, res["headline_batch_cap"]
             ),

@@ -40,6 +40,16 @@ inline void write_u24(uint8_t* dst, uint32_t v) {
   dst[2] = static_cast<uint8_t>(v >> 16);
 }
 
+// f32 bits -> bf16 bits, round-to-nearest-even with NaN quieting (the one
+// rounding rule, shared by the exported f32_to_bf16 and the fused pack).
+inline uint16_t bf16_bits(uint32_t u) {
+  if ((u & 0x7fffffffu) > 0x7f800000u) {   // NaN: keep quiet, drop payload
+    return static_cast<uint16_t>((u >> 16) | 0x0040u);
+  }
+  uint32_t rounding = 0x7fffu + ((u >> 16) & 1u);
+  return static_cast<uint16_t>((u + rounding) >> 16);
+}
+
 }  // namespace
 
 extern "C" {
@@ -108,13 +118,7 @@ void pack_u24_i32(const int32_t* ids, int64_t n, uint8_t* out) {
 void f32_to_bf16(const float* in, int64_t n, uint16_t* out) {
   const uint32_t* bits = reinterpret_cast<const uint32_t*>(in);
   for (int64_t i = 0; i < n; ++i) {
-    uint32_t u = bits[i];
-    if ((u & 0x7fffffffu) > 0x7f800000u) {   // NaN: keep quiet, drop payload
-      out[i] = static_cast<uint16_t>((u >> 16) | 0x0040u);
-    } else {
-      uint32_t rounding = 0x7fffu + ((u >> 16) & 1u);
-      out[i] = static_cast<uint16_t>((u + rounding) >> 16);
-    }
+    out[i] = bf16_bits(bits[i]);
   }
 }
 
@@ -164,10 +168,19 @@ void pack_batch_u24_bf16(const void** ids_ptrs, const uint8_t* ids_is64,
         write_u24(idst + 3 * i, static_cast<uint32_t>(ids[i]));
       }
     }
-    uint16_t* wdst =
-        reinterpret_cast<uint16_t*>(wts_base + row * fields * 2);
+    // Byte-granular stores: the weights segment starts at bucket*fields*3,
+    // which is ODD for odd bucket*fields — a uint16_t* store there would be
+    // misaligned UB (unreachable with the shipped pow2 buckets, but the
+    // layout must be correct for arbitrary configs). memcpy of 2 bytes
+    // compiles to a single unaligned store on x86/arm.
+    uint8_t* wdst = wts_base + row * fields * 2;
     if (wts_isf32[p]) {
-      f32_to_bf16(static_cast<const float*>(wts_ptrs[p]), n, wdst);
+      const uint32_t* bits =
+          static_cast<const uint32_t*>(wts_ptrs[p]);
+      for (int64_t i = 0; i < n; ++i) {
+        uint16_t v = bf16_bits(bits[i]);
+        std::memcpy(wdst + 2 * i, &v, 2);
+      }
     } else {
       std::memcpy(wdst, wts_ptrs[p], static_cast<size_t>(n) * 2);
     }

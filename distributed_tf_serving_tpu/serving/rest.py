@@ -13,6 +13,11 @@ Routes (TF-Serving REST API v1 semantics):
   body `{"inputs": {...}}` (columnar) -> `{"outputs": ...}` (dict when
   the signature has several outputs, bare tensor when one);
   optional `"signature_name"`.
+- `POST /v1/models/{model}[/versions/{v}]:classify` and `...:regress`
+  body `{"examples": [{feat: val, ...}, ...], "context": {...}?}` ->
+  `{"results": [...]}` (label/score pairs per example for classify, one
+  value per example for regress), riding the same Example plane as the
+  gRPC Classify/Regress RPCs (`example_codec.decode_input`).
 - `GET  /v1/models/{model}` -> version status list.
 - `GET  /v1/models/{model}/metadata` -> signature metadata (JSON).
 
@@ -63,6 +68,14 @@ class RestGateway:
             web.post("/v1/models/{model}:predict", self.predict),
             web.post(
                 "/v1/models/{model}/versions/{version}:predict", self.predict
+            ),
+            web.post("/v1/models/{model}:classify", self.classify),
+            web.post(
+                "/v1/models/{model}/versions/{version}:classify", self.classify
+            ),
+            web.post("/v1/models/{model}:regress", self.regress),
+            web.post(
+                "/v1/models/{model}/versions/{version}:regress", self.regress
             ),
             web.get("/v1/models/{model}", self.status),
             web.get("/v1/models/{model}/metadata", self.metadata),
@@ -209,6 +222,127 @@ class RestGateway:
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             log.exception("internal error serving REST predict")
             return _json_error("INTERNAL", f"internal error: {e}")
+
+    # ------------------------------------------------- classify / regress
+
+    @staticmethod
+    def _feature_from_json(key: str, value, feature) -> None:
+        """Fill one tf.Example Feature from a JSON value (TF-Serving REST
+        Example encoding: scalars or flat lists; ints -> int64_list, floats
+        -> float_list with int coercion, strings -> bytes_list, and
+        `{"b64": ...}` objects for binary — json_tensor.cc semantics)."""
+        import base64
+
+        vals = value if isinstance(value, list) else [value]
+        if not vals:
+            raise ServiceError(
+                "INVALID_ARGUMENT", f"feature {key!r}: empty value list"
+            )
+        if any(isinstance(v, float) for v in vals):
+            try:
+                feature.float_list.value.extend(float(v) for v in vals)
+            except (TypeError, ValueError) as e:
+                raise ServiceError(
+                    "INVALID_ARGUMENT", f"feature {key!r}: {e}"
+                ) from e
+        elif all(isinstance(v, bool) is False and isinstance(v, int) for v in vals):
+            try:
+                feature.int64_list.value.extend(vals)
+            except ValueError as e:  # out of int64 range is a client error
+                raise ServiceError(
+                    "INVALID_ARGUMENT", f"feature {key!r}: {e}"
+                ) from e
+        elif all(isinstance(v, str) for v in vals):
+            feature.bytes_list.value.extend(v.encode("utf-8") for v in vals)
+        elif all(isinstance(v, dict) and set(v) == {"b64"} for v in vals):
+            try:
+                feature.bytes_list.value.extend(
+                    base64.b64decode(v["b64"]) for v in vals
+                )
+            except Exception as e:  # noqa: BLE001 — bad base64 is a 400
+                raise ServiceError(
+                    "INVALID_ARGUMENT", f"feature {key!r}: invalid base64: {e}"
+                ) from e
+        else:
+            raise ServiceError(
+                "INVALID_ARGUMENT",
+                f"feature {key!r}: values must be all-int, all-float "
+                "(ints coerce), all-string, or all-b64 objects",
+            )
+
+    def _example_from_json(self, obj, index: int):
+        from ..proto import tf_example_pb2 as ex
+
+        if not isinstance(obj, dict):
+            raise ServiceError(
+                "INVALID_ARGUMENT", f"example {index} is not a JSON object"
+            )
+        example = ex.Example()
+        for key, value in obj.items():
+            self._feature_from_json(
+                key, value, example.features.feature[key]
+            )
+        return example
+
+    def _build_example_request(self, request: web.Request, req, body: dict) -> None:
+        """Shared :classify/:regress body parsing into a Classification/
+        RegressionRequest's model_spec + Input (examples [+ context])."""
+        model = request.match_info["model"]
+        version = self._parse_version(request.match_info.get("version"))
+        req.model_spec.name = model
+        if version is not None:
+            req.model_spec.version.value = version
+        req.model_spec.signature_name = body.get("signature_name", "")
+        examples = body.get("examples")
+        if not isinstance(examples, list) or not examples:
+            raise ServiceError(
+                "INVALID_ARGUMENT", 'body must carry a non-empty "examples" list'
+            )
+        context = body.get("context")
+        if context is not None:
+            target = req.input.example_list_with_context
+            target.context.CopyFrom(self._example_from_json(context, -1))
+            dest = target.examples
+        else:
+            dest = req.input.example_list.examples
+        for i, obj in enumerate(examples):
+            dest.append(self._example_from_json(obj, i))
+
+    async def _example_route(self, request: web.Request, kind: str) -> web.Response:
+        try:
+            try:
+                body = await request.json()
+            except Exception as e:  # noqa: BLE001 — malformed JSON is a 400
+                return _json_error("INVALID_ARGUMENT", f"invalid JSON body: {e}")
+            if not isinstance(body, dict):
+                return _json_error("INVALID_ARGUMENT", "body must be a JSON object")
+            if kind == "classify":
+                req = apis.ClassificationRequest()
+                self._build_example_request(request, req, body)
+                resp = await self.impl.classify_async(req)
+                # TF-Serving REST shape (json_tensor.cc): one
+                # [[label, score], ...] list per example, same order.
+                results = [
+                    [[c.label, c.score] for c in cls.classes]
+                    for cls in resp.result.classifications
+                ]
+            else:
+                req = apis.RegressionRequest()
+                self._build_example_request(request, req, body)
+                resp = await self.impl.regress_async(req)
+                results = [r.value for r in resp.result.regressions]
+            return web.json_response({"results": results})
+        except ServiceError as e:
+            return _json_error(e.code, str(e))
+        except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
+            log.exception("internal error serving REST %s", kind)
+            return _json_error("INTERNAL", f"internal error: {e}")
+
+    async def classify(self, request: web.Request) -> web.Response:
+        return await self._example_route(request, "classify")
+
+    async def regress(self, request: web.Request) -> web.Response:
+        return await self._example_route(request, "regress")
 
     async def status(self, request: web.Request) -> web.Response:
         model = request.match_info["model"]

@@ -105,12 +105,12 @@ class AioGrpcPredictionService:
     On a single-core serving host the thread-per-RPC model's GIL hand-offs
     and context switches are a first-order cost (round-3 load experiment:
     ~15% of achievable QPS at 64-way concurrency); the coroutine model keeps
-    the hot Predict path on one thread and awaits the batcher future. The
-    non-hot RPCs run their (cheap, synchronous) impl bodies inline on the
-    loop — their device work still rides the batcher queue asynchronously
-    only for Predict; Classify/Regress/MultiInference block the loop for
-    their batch, so coroutine servers are for Predict-dominant deployments
-    (the reference's entire workload is Predict, DCNClient.java:111-112).
+    the hot paths on one thread and awaits the batcher future:
+    Predict/Classify/Regress all ride their _async impl variants.
+    MultiInference and GetModelMetadata run their (cheap, synchronous)
+    bodies inline — MultiInference's sub-calls block the loop for their
+    batch, acceptable for its diagnostic traffic share (the reference's
+    entire workload is Predict, DCNClient.java:111-112).
     """
 
     def __init__(self, impl: PredictionServiceImpl, metrics: ServerMetrics | None = None):
@@ -140,10 +140,10 @@ class AioGrpcPredictionService:
         return await self._call("Predict", self.impl.predict_async, request, context)
 
     async def Classify(self, request, context):
-        return await self._call("Classify", self.impl.classify, request, context)
+        return await self._call("Classify", self.impl.classify_async, request, context)
 
     async def Regress(self, request, context):
-        return await self._call("Regress", self.impl.regress, request, context)
+        return await self._call("Regress", self.impl.regress_async, request, context)
 
     async def MultiInference(self, request, context):
         return await self._call("MultiInference", self.impl.multi_inference, request, context)
@@ -401,13 +401,16 @@ def serve(argv=None) -> None:
             loop.run_forever()
 
         threading.Thread(target=run_rest, name="rest", daemon=True).start()
-        rest_up.wait(timeout=30)
-        if "error" in rest_ready:
+        # A wait() timeout (gateway thread hung before setting the event)
+        # is a startup failure too: the fail-fast contract promises the
+        # operator a live :8501 or a fatal exit, never a healthy-looking
+        # log line over an unknown gateway state.
+        if not rest_up.wait(timeout=30) or "error" in rest_ready:
             server.stop(0)
             batcher.stop()
             raise SystemExit(
                 f"REST gateway failed to start on {cfg.host}:{args.rest_port}: "
-                f"{rest_ready['error']}"
+                f"{rest_ready.get('error', 'startup timed out after 30s')}"
             )
         log.info("REST gateway on %s:%d (/v1/models/...)",
                  cfg.host, rest_ready.get("port", args.rest_port))

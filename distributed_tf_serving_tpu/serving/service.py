@@ -342,17 +342,33 @@ class PredictionServiceImpl:
 
     # ----------------------------------------------------- Classify / Regress
 
-    def _run_examples(self, request):
+    def _examples_prepare(self, request):
+        """Shared front half of Classify/Regress: resolution + Example
+        decode. Returns (servable, arrays)."""
         servable, _ = self._resolve(request.model_spec)
         try:
             arrays = decode_input(request.input, servable.model.config.num_fields)
         except ExampleDecodeError as e:
             raise ServiceError("INVALID_ARGUMENT", str(e)) from e
+        return servable, arrays
+
+    def _run_examples(self, request):
+        servable, arrays = self._examples_prepare(request)
         outputs = self._run(servable, arrays, output_keys=("prediction_node",))
         return servable, outputs
 
-    def classify(self, request: apis.ClassificationRequest) -> apis.ClassificationResponse:
-        servable, outputs = self._run_examples(request)
+    async def _run_examples_async(self, request):
+        """_run_examples for coroutine servers (the REST gateway's
+        :classify/:regress routes ride the same event loop as :predict)."""
+        servable, arrays = self._examples_prepare(request)
+        outputs = await self._run_async(
+            servable, arrays, output_keys=("prediction_node",)
+        )
+        return servable, outputs
+
+    def _classify_finish(
+        self, request, servable, outputs
+    ) -> apis.ClassificationResponse:
         scores = outputs["prediction_node"]
         resp = apis.ClassificationResponse()
         resp.model_spec.CopyFrom(
@@ -364,8 +380,17 @@ class PredictionServiceImpl:
             cls.classes.add(label="1", score=float(p))
         return resp
 
-    def regress(self, request: apis.RegressionRequest) -> apis.RegressionResponse:
+    def classify(self, request: apis.ClassificationRequest) -> apis.ClassificationResponse:
         servable, outputs = self._run_examples(request)
+        return self._classify_finish(request, servable, outputs)
+
+    async def classify_async(
+        self, request: apis.ClassificationRequest
+    ) -> apis.ClassificationResponse:
+        servable, outputs = await self._run_examples_async(request)
+        return self._classify_finish(request, servable, outputs)
+
+    def _regress_finish(self, request, servable, outputs) -> apis.RegressionResponse:
         resp = apis.RegressionResponse()
         resp.model_spec.CopyFrom(
             self._echo_spec(servable, request.model_spec.signature_name or "regress")
@@ -373,6 +398,16 @@ class PredictionServiceImpl:
         for p in outputs["prediction_node"]:
             resp.result.regressions.add(value=float(p))
         return resp
+
+    def regress(self, request: apis.RegressionRequest) -> apis.RegressionResponse:
+        servable, outputs = self._run_examples(request)
+        return self._regress_finish(request, servable, outputs)
+
+    async def regress_async(
+        self, request: apis.RegressionRequest
+    ) -> apis.RegressionResponse:
+        servable, outputs = await self._run_examples_async(request)
+        return self._regress_finish(request, servable, outputs)
 
     # --------------------------------------------------------- MultiInference
 

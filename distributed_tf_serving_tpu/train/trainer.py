@@ -116,6 +116,17 @@ class Trainer:
             )
         )
 
+    def snapshot_params(self):
+        """Donation-safe copy of the current params, sharding preserved.
+
+        The train step donates its state (donate_argnums — HBM stays flat),
+        so `trainer.state.params` leaves are DELETED by the next fit() call.
+        A Servable built directly from state.params therefore dies the
+        moment training continues (and device_put/place_params alias rather
+        than copy when the sharding already matches). Serve-while-training
+        callers must hand the registry this snapshot instead."""
+        return jax.tree_util.tree_map(jnp.copy, self.state.params)
+
     def _prepare(self, batch: dict[str, np.ndarray]) -> dict[str, jnp.ndarray]:
         out = {
             "feat_ids": native.fold_ids(batch["feat_ids"], self.model.config.vocab_size),

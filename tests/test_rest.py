@@ -177,6 +177,117 @@ def test_status_and_metadata_routes(stack):
     assert missing == 404
 
 
+def test_classify_route_matches_grpc(stack):
+    """REST :classify must produce the same label/score pairs as the gRPC
+    Classify RPC fed the equivalent ExampleList (one impl, two surfaces)."""
+    impl, _sv = stack
+    from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+    from distributed_tf_serving_tpu.serving.example_codec import make_example
+
+    rng = np.random.RandomState(11)
+    ids = rng.randint(0, 1 << 40, size=(4, F)).astype(np.int64)
+    wts = rng.rand(4, F).astype(np.float32)
+
+    req = apis.ClassificationRequest()
+    req.model_spec.name = "DCN"
+    for i in range(4):
+        req.input.example_list.examples.append(make_example(ids[i], wts[i]))
+    grpc_out = impl.classify(req)
+    grpc_results = [
+        [[c.label, c.score] for c in cls.classes]
+        for cls in grpc_out.result.classifications
+    ]
+
+    async def handler(session):
+        body = {"examples": [
+            {"feat_ids": ids[i].tolist(), "feat_wts": wts[i].tolist()}
+            for i in range(4)
+        ]}
+        async with session.post("/v1/models/DCN:classify", json=body) as r:
+            assert r.status == 200, await r.text()
+            return await r.json()
+
+    out = _run(impl, handler)
+    assert len(out["results"]) == 4
+    for rest_cls, grpc_cls in zip(out["results"], grpc_results):
+        assert [c[0] for c in rest_cls] == [c[0] for c in grpc_cls]
+        np.testing.assert_allclose(
+            [c[1] for c in rest_cls], [c[1] for c in grpc_cls], rtol=1e-6
+        )
+
+
+def test_regress_route_with_context(stack):
+    """REST :regress with a shared context Example (feat_wts hoisted into
+    the context, per-example feat_ids) matches the gRPC Regress RPC fed
+    the equivalent ExampleListWithContext."""
+    impl, _sv = stack
+    from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+    from distributed_tf_serving_tpu.serving.example_codec import make_example
+
+    rng = np.random.RandomState(12)
+    ids = rng.randint(0, 1 << 40, size=(3, F)).astype(np.int64)
+    ctx_wts = rng.rand(F).astype(np.float32)
+
+    req = apis.RegressionRequest()
+    req.model_spec.name = "DCN"
+    req.input.example_list_with_context.context.CopyFrom(
+        make_example([], ctx_wts)
+    )
+    del req.input.example_list_with_context.context.features.feature["feat_ids"]
+    for i in range(3):
+        req.input.example_list_with_context.examples.append(make_example(ids[i]))
+    grpc_vals = [r.value for r in impl.regress(req).result.regressions]
+
+    async def handler(session):
+        body = {
+            "context": {"feat_wts": ctx_wts.tolist()},
+            "examples": [{"feat_ids": ids[i].tolist()} for i in range(3)],
+        }
+        async with session.post("/v1/models/DCN:regress", json=body) as r:
+            assert r.status == 200, await r.text()
+            return await r.json()
+
+    out = _run(impl, handler)
+    np.testing.assert_allclose(out["results"], grpc_vals, rtol=1e-6)
+
+
+def test_classify_regress_error_taxonomy(stack):
+    impl, _sv = stack
+
+    async def handler(session):
+        results = {}
+        async with session.post("/v1/models/NOPE:classify",
+                                json={"examples": [{"feat_ids": [1] * F}]}) as r:
+            results["unknown_model"] = (r.status, await r.json())
+        async with session.post("/v1/models/DCN:classify", json={}) as r:
+            results["no_examples"] = (r.status, await r.json())
+        async with session.post(
+            "/v1/models/DCN:regress",
+            json={"examples": [{"feat_ids": [1] * (F - 1)}]}  # wrong arity
+        ) as r:
+            results["bad_arity"] = (r.status, await r.json())
+        async with session.post(
+            "/v1/models/DCN:classify",
+            json={"examples": [{"feat_ids": ["x"] * F}]}  # strings, not ids
+        ) as r:
+            results["bad_type"] = (r.status, await r.json())
+        async with session.post(
+            "/v1/models/DCN:classify",
+            json={"examples": [{"feat_ids": [1 << 63] * F}]}  # > int64 max
+        ) as r:
+            results["out_of_range"] = (r.status, await r.json())
+        return results
+
+    res = _run(impl, handler)
+    assert res["unknown_model"][0] == 404
+    assert res["no_examples"][0] == 400
+    assert res["bad_arity"][0] == 400
+    assert res["bad_type"][0] == 400
+    assert res["out_of_range"][0] == 400  # protobuf range error, not a 500
+    for _status, body in res.values():
+        assert "error" in body
+
+
 def test_rest_and_grpc_same_scores(stack):
     """The REST gateway and the gRPC path hand identical protos to the
     same impl: scores must agree bitwise."""

@@ -45,6 +45,41 @@ def test_training_learns():
     assert np.isfinite(first["loss"])
 
 
+def test_snapshot_params_survives_donation():
+    """The train step donates its state, so state.params leaves die on the
+    next fit(); snapshot_params must return copies that stay live (the
+    serve-while-training contract — a Servable built from the snapshot
+    keeps scoring after training continues)."""
+    trainer = Trainer(build_model("dcn_v2", CFG), seed=3)
+    trainer.fit(steps=1, batch_size=64)
+    snap = trainer.snapshot_params()
+    live_ref = trainer.state.params
+    trainer.fit(steps=1, batch_size=64)
+    # The old live state is donated-dead...
+    with pytest.raises(Exception):
+        np.asarray(jax.tree_util.tree_leaves(live_ref)[0])
+    # ...but the snapshot still scores.
+    model = trainer.model
+    batch = {
+        "feat_ids": np.zeros((4, CFG.num_fields), np.int64),
+        "feat_wts": np.ones((4, CFG.num_fields), np.float32),
+    }
+    out = np.asarray(model.apply(snap, batch)["prediction_node"])
+    assert out.shape == (4,) and np.all(np.isfinite(out))
+
+
+def test_snapshot_params_preserves_mesh_sharding():
+    mesh = make_mesh(8, model_parallel=2)
+    trainer = Trainer(build_model("dcn_v2", CFG), mesh=mesh, seed=3, tensor_parallel=True)
+    trainer.fit(steps=1, batch_size=64)
+    snap = trainer.snapshot_params()
+    for live, copy in zip(
+        jax.tree_util.tree_leaves(trainer.state.params),
+        jax.tree_util.tree_leaves(snap),
+    ):
+        assert live.sharding == copy.sharding
+
+
 @pytest.mark.parametrize("model_parallel", [1, 2])
 def test_sharded_training_matches_semantics(model_parallel):
     """Same seed, same data: mesh-sharded training must track the

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Watch for the TPU relay to recover. Probes jax.devices() with a hard
+# timeout every interval; exits 0 the moment a probe sees a TPU device,
+# exits 1 after the deadline. Logs each attempt to artifacts/relay_watch.log.
+set -u
+cd "$(dirname "$0")/.."
+DEADLINE_S=${RELAY_WATCH_DEADLINE_S:-39600}   # 11 h
+INTERVAL_S=${RELAY_WATCH_INTERVAL_S:-180}
+START=$(date +%s)
+LOG=artifacts/relay_watch.log
+echo "[relay_watch] start $(date -u +%FT%TZ) deadline=${DEADLINE_S}s interval=${INTERVAL_S}s" >> "$LOG"
+while true; do
+  NOW=$(date +%s)
+  if [ $((NOW - START)) -ge "$DEADLINE_S" ]; then
+    echo "[relay_watch] deadline reached $(date -u +%FT%TZ) — relay never returned" >> "$LOG"
+    exit 1
+  fi
+  OUT=$(timeout 150 python -c "import jax; ds=jax.devices(); print([str(d) for d in ds])" 2>&1)
+  RC=$?
+  if [ $RC -eq 0 ] && echo "$OUT" | grep -qi "tpu"; then
+    echo "[relay_watch] UP $(date -u +%FT%TZ): $OUT" >> "$LOG"
+    exit 0
+  fi
+  echo "[relay_watch] down $(date -u +%FT%TZ) rc=$RC: $(echo "$OUT" | tail -1 | cut -c1-160)" >> "$LOG"
+  sleep "$INTERVAL_S"
+done
