@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Mixed-surface robustness soak against the real serving stack.
+
+Round-4 ran this scenario inline (ROUND4.md "Robustness soak": 54,746
+zero-error requests on the CPU platform); VERDICT r4 task 7 asks for the
+same pressure against the REAL chip's timing behavior, where relay jitter
+and stalls are exactly the stress that matters. This makes the soak a
+committed, re-runnable tool for both platforms.
+
+Traffic mix on ONE event loop (the deployed topology):
+- gRPC workers interleaving wide / compact / unique payloads every few
+  requests (exercises the widening validator, the content-addressed device
+  cache's regime detector, and the fused batch assembler under mixed
+  dtypes);
+- REST workers alternating :predict (columnar) with :classify Examples
+  (exercises the JSON plane and the Example decode path into the same
+  batcher).
+
+Reports one JSON line: per-surface request/error counts, error taxonomy,
+RSS start/end (leak watch), batcher + input-cache counters, wall/QPS.
+Env knobs: SOAK_SECONDS (default 300), SOAK_GRPC_WORKERS (8),
+SOAK_REST_WORKERS (4), SOAK_CANDIDATES (1000).
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_FIELDS = 43
+
+
+def rss_gb() -> float:
+    with open("/proc/self/status") as f:
+        for ln in f:
+            if ln.startswith("VmRSS:"):
+                return round(int(ln.split()[1]) / 1e6, 3)
+    return 0.0
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import aiohttp
+    import numpy as np
+
+    from distributed_tf_serving_tpu.client import (
+        PredictClientError,
+        ShardedPredictClient,
+        compact_payload,
+        make_payload,
+    )
+    from distributed_tf_serving_tpu.models import (
+        ModelConfig,
+        Servable,
+        ServableRegistry,
+        build_model,
+        ctr_signatures,
+    )
+    from distributed_tf_serving_tpu.serving import DynamicBatcher, PredictionServiceImpl
+    from distributed_tf_serving_tpu.serving.rest import start_rest_gateway
+    from distributed_tf_serving_tpu.serving.server import create_server_async
+
+    platform = jax.devices()[0].platform
+    tpu = platform != "cpu"
+    seconds = float(os.environ.get("SOAK_SECONDS", "300"))
+    grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "8"))
+    rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "4"))
+    candidates = int(os.environ.get("SOAK_CANDIDATES", "1000"))
+
+    # Bench-scale servable on the accelerator; small on the CPU platform so
+    # the one core spends its budget on the serving stack, not the forward.
+    config = ModelConfig(
+        name="DCN",
+        num_fields=NUM_FIELDS,
+        vocab_size=(1 << 20) if tpu else (1 << 14),
+        embed_dim=16 if tpu else 8,
+        mlp_dims=(256, 128, 64) if tpu else (16,),
+        num_cross_layers=3 if tpu else 1,
+        cross_full_matrix=True,
+    )
+    model = build_model("dcn_v2", config)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    registry = ServableRegistry()
+    servable = Servable(
+        name="DCN", version=1, model=model, params=params,
+        signatures=ctr_signatures(NUM_FIELDS),
+    )
+    registry.load(servable)
+    buckets = (1024, 2048, 4096, 8192, 16384) if tpu else (1024, 2048)
+    batcher = DynamicBatcher(
+        buckets=buckets, max_wait_us=2000, completion_workers=12,
+    ).start()
+    batcher.max_batch_candidates = buckets[-1]
+    for b in buckets:
+        batcher.warmup(servable, buckets=(b,))
+        batcher.submit(
+            servable,
+            compact_payload(batcher.warmup_arrays(servable, b), config.vocab_size),
+            _warmup=True,
+        ).result(timeout=600)
+    impl = PredictionServiceImpl(registry, batcher)
+
+    wide = make_payload(candidates=candidates, num_fields=NUM_FIELDS)
+    compact = compact_payload(wide, config.vocab_size)
+    unique_pool = [
+        make_payload(candidates=candidates, num_fields=NUM_FIELDS, seed=500 + i)
+        for i in range(32)
+    ]
+    rest_cols = {
+        "feat_ids": wide["feat_ids"][:64].tolist(),
+        "feat_wts": wide["feat_wts"][:64].tolist(),
+    }
+    rest_examples = [
+        {"feat_ids": wide["feat_ids"][i].tolist(),
+         "feat_wts": wide["feat_wts"][i].tolist()}
+        for i in range(8)
+    ]
+
+    counts = {
+        "grpc_ok": 0, "grpc_err": 0,
+        "rest_ok": 0, "rest_err": 0,
+        "errors": {},
+    }
+    rss_start = rss_gb()
+    deadline = time.perf_counter() + seconds
+
+    def note_error(kind: str, detail: str) -> None:
+        counts[f"{kind}_err"] += 1
+        key = detail[:120]
+        counts["errors"][key] = counts["errors"].get(key, 0) + 1
+
+    async def grpc_worker(client, wid: int):
+        i = 0
+        while time.perf_counter() < deadline:
+            i += 1
+            # Interleave regimes every 7 requests, like the r4 soak: the
+            # cache's regime detector must ride the transitions without
+            # false bypass or stale hits.
+            phase = (i // 7 + wid) % 3
+            payload = (wide, compact, unique_pool[(i + wid) % len(unique_pool)])[phase]
+            try:
+                await client.predict(payload, sort_scores=True)
+                counts["grpc_ok"] += 1
+            except PredictClientError as e:
+                note_error("grpc", f"{getattr(e.code, 'name', e.code)}: {e}")
+            except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
+                note_error("grpc", f"{type(e).__name__}: {e}")
+
+    async def rest_worker(session, wid: int):
+        i = 0
+        while time.perf_counter() < deadline:
+            i += 1
+            try:
+                if (i + wid) % 5 == 0:
+                    async with session.post(
+                        "/v1/models/DCN:classify", json={"examples": rest_examples}
+                    ) as r:
+                        body = await r.json()
+                        ok = r.status == 200 and len(body.get("results", ())) == len(rest_examples)
+                else:
+                    async with session.post(
+                        "/v1/models/DCN:predict", json={"inputs": rest_cols}
+                    ) as r:
+                        body = await r.json()
+                        ok = r.status == 200 and "outputs" in body
+                if ok:
+                    counts["rest_ok"] += 1
+                else:
+                    note_error("rest", f"http {r.status}: {json.dumps(body)[:80]}")
+            except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
+                note_error("rest", f"{type(e).__name__}: {e}")
+
+    async def drive():
+        server, gport = create_server_async(impl, "127.0.0.1:0")
+        await server.start()
+        runner, rport = await start_rest_gateway(impl, port=0)
+        try:
+            async with ShardedPredictClient(
+                [f"127.0.0.1:{gport}"], "DCN", channels_per_host=3
+            ) as client, aiohttp.ClientSession(
+                f"http://127.0.0.1:{rport}"
+            ) as session:
+                await asyncio.gather(
+                    *(grpc_worker(client, w) for w in range(grpc_workers)),
+                    *(rest_worker(session, w) for w in range(rest_workers)),
+                )
+        finally:
+            await runner.cleanup()
+            await server.stop(0)
+
+    t0 = time.perf_counter()
+    asyncio.run(drive())
+    wall = time.perf_counter() - t0
+    total = counts["grpc_ok"] + counts["rest_ok"]
+    line = {
+        "soak_seconds": round(wall, 1),
+        "platform": str(jax.devices()[0]),
+        "requests_total": total,
+        "qps": round(total / wall, 1),
+        **{k: v for k, v in counts.items() if k != "errors"},
+        "error_taxonomy": counts["errors"],
+        "rss_gb_start": rss_start,
+        "rss_gb_end": rss_gb(),
+        "batcher": {
+            "batches": batcher.stats.batches,
+            "fused_batches": batcher.stats.fused_batches,
+            "requests_per_batch": round(batcher.stats.mean_requests_per_batch, 2),
+        },
+        "input_cache": (
+            {
+                "hits": batcher.input_cache.hits,
+                "misses": batcher.input_cache.misses,
+                "bypassed": batcher.input_cache.bypassed,
+                "bypass_cycles": batcher.input_cache.bypass_cycles,
+                "mb_upload_skipped": round(batcher.input_cache.bytes_skipped / 1e6, 1),
+            }
+            if batcher.input_cache is not None
+            else None
+        ),
+    }
+    batcher.stop()
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()
