@@ -163,15 +163,23 @@ class Servable:
 
 
 class ServableRegistry:
-    """Thread-safe name -> {version -> Servable} store.
+    """Thread-safe name -> {version -> Servable} store, with version labels.
 
-    Mutation happens on the control plane (load/unload); the serving data
-    plane only reads, so a plain lock around dict ops suffices.
+    Mutation happens on the control plane (load/unload/set_label); the
+    serving data plane only reads, so a plain lock around dict ops suffices.
+
+    Version labels replicate tensorflow_model_server's label routing
+    (model.proto field 4 upstream; assigned via ModelServerConfig
+    version_labels there, via set_label / the server config here): a label
+    like "stable"/"canary" names ONE loaded version per model, and requests
+    may address it instead of a number — retargeting the label is the
+    blue-green flip, no client change.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._servables: dict[str, dict[int, Servable]] = {}
+        self._labels: dict[str, dict[str, int]] = {}
 
     def load(self, servable: Servable) -> None:
         with self._lock:
@@ -183,21 +191,62 @@ class ServableRegistry:
                 raise ModelNotFoundError(name)
             if version is None:
                 del self._servables[name]
+                self._labels.pop(name, None)
             else:
                 versions = self._servables[name]
                 if version not in versions:
                     raise VersionNotFoundError(f"{name} v{version}")
                 del versions[version]
+                labels = self._labels.get(name)
+                if labels:
+                    # A label must never dangle onto an unloaded version
+                    # (upstream refuses to assign labels to unavailable
+                    # versions for the same reason).
+                    for label in [l for l, v in labels.items() if v == version]:
+                        del labels[label]
                 if not versions:
                     del self._servables[name]
+                    self._labels.pop(name, None)
 
-    def resolve(self, name: str, version: int | None = None) -> Servable:
-        """ModelSpec resolution: absent version wrapper => latest
-        (model.proto:12-14)."""
+    def set_label(self, name: str, label: str, version: int) -> None:
+        """Point `label` at a LOADED version (upstream rule: labels can only
+        name available versions, so a typo'd rollout fails at config time,
+        not at request time)."""
+        if not label:
+            raise ValueError("version label must be non-empty")
         with self._lock:
             versions = self._servables.get(name)
             if not versions:
                 raise ModelNotFoundError(f"model {name!r} not loaded")
+            if version not in versions:
+                raise VersionNotFoundError(
+                    f"cannot label {name!r} v{version} as {label!r}: version not "
+                    f"loaded; have {sorted(versions)}"
+                )
+            self._labels.setdefault(name, {})[label] = version
+
+    def resolve(
+        self,
+        name: str,
+        version: int | None = None,
+        label: str | None = None,
+    ) -> Servable:
+        """ModelSpec resolution: absent version wrapper => latest
+        (model.proto:12-14); version_label => the labeled version (upstream
+        model.proto field 4). version XOR label is enforced by the caller
+        (the proto oneof upstream)."""
+        with self._lock:
+            versions = self._servables.get(name)
+            if not versions:
+                raise ModelNotFoundError(f"model {name!r} not loaded")
+            if label is not None:
+                assigned = self._labels.get(name, {})
+                if label not in assigned:
+                    raise VersionNotFoundError(
+                        f"model {name!r} has no version label {label!r}; "
+                        f"have {sorted(assigned)}"
+                    )
+                version = assigned[label]
             if version is None:
                 return versions[max(versions)]
             if version not in versions:
@@ -209,3 +258,7 @@ class ServableRegistry:
     def models(self) -> dict[str, list[int]]:
         with self._lock:
             return {k: sorted(v) for k, v in self._servables.items()}
+
+    def labels(self, name: str) -> dict[str, int]:
+        with self._lock:
+            return dict(self._labels.get(name, {}))

@@ -6,8 +6,10 @@ reference client speaks gRPC only (DCNClient.java), but the ecosystem the
 reference lives in — dashboards, canary probes, curl debugging — uses the
 REST surface constantly; a drop-in replacement must answer it.
 
-Routes (TF-Serving REST API v1 semantics):
-- `POST /v1/models/{model}[/versions/{v}]:predict`
+Routes (TF-Serving REST API v1 semantics; every POST verb also accepts
+`/versions/{v}` or `/labels/{l}` segments — label routing matches the
+model server's version_labels map):
+- `POST /v1/models/{model}[/versions/{v}|/labels/{l}]:predict`
   body `{"instances": [...]}` (row format: one dict per instance, or the
   bare value for single-input models) -> `{"predictions": [...]}`;
   body `{"inputs": {...}}` (columnar) -> `{"outputs": ...}` (dict when
@@ -20,6 +22,8 @@ Routes (TF-Serving REST API v1 semantics):
   gRPC Classify/Regress RPCs (`example_codec.decode_input`).
 - `GET  /v1/models/{model}` -> version status list.
 - `GET  /v1/models/{model}/metadata` -> signature metadata (JSON).
+- `GET  /monitoring/prometheus/metrics` -> Prometheus text exposition
+  (the model server's monitoring endpoint; TF-Serving metric names).
 
 Requests are converted to the SAME PredictRequest protos the gRPC path
 parses and handed to PredictionServiceImpl.predict_async — one
@@ -32,6 +36,7 @@ codes onto HTTP statuses (TF-Serving's own REST error shape:
 from __future__ import annotations
 
 import logging
+import time
 
 import numpy as np
 from aiohttp import web
@@ -59,35 +64,58 @@ def _json_error(code: str, message: str) -> web.Response:
 
 
 class RestGateway:
-    """aiohttp application exposing a PredictionServiceImpl over REST."""
+    """aiohttp application exposing a PredictionServiceImpl over REST.
 
-    def __init__(self, impl: PredictionServiceImpl):
+    When a ServerMetrics is provided (the server CLI passes the gRPC
+    server's instance, so both surfaces aggregate in one place), every
+    REST request is observed under a `REST.<Verb>` entrypoint and the
+    gateway answers `GET /monitoring/prometheus/metrics` — the model
+    server's monitoring endpoint (enabled there via --monitoring_config_
+    file; always on here, it is read-only and costs nothing when
+    unscraped)."""
+
+    def __init__(self, impl: PredictionServiceImpl, metrics=None):
+        from ..utils.metrics import ServerMetrics
+
         self.impl = impl
+        self.metrics = metrics or ServerMetrics()
         self.app = web.Application(client_max_size=256 * 1024 * 1024)
         self.app.add_routes([
             web.post("/v1/models/{model}:predict", self.predict),
             web.post(
                 "/v1/models/{model}/versions/{version}:predict", self.predict
             ),
+            web.post(
+                "/v1/models/{model}/labels/{label}:predict", self.predict
+            ),
             web.post("/v1/models/{model}:classify", self.classify),
             web.post(
                 "/v1/models/{model}/versions/{version}:classify", self.classify
+            ),
+            web.post(
+                "/v1/models/{model}/labels/{label}:classify", self.classify
             ),
             web.post("/v1/models/{model}:regress", self.regress),
             web.post(
                 "/v1/models/{model}/versions/{version}:regress", self.regress
             ),
+            web.post(
+                "/v1/models/{model}/labels/{label}:regress", self.regress
+            ),
             web.get("/v1/models/{model}", self.status),
             web.get("/v1/models/{model}/metadata", self.metadata),
+            web.get("/monitoring/prometheus/metrics", self.prometheus),
         ])
 
     # ------------------------------------------------------------- helpers
 
-    def _resolve_specs(self, model: str, version, signature_name: str):
+    def _resolve_specs(self, model: str, version, signature_name: str, label=None):
         # ONE lookup-error taxonomy, shared with the gRPC path.
         from .service import _wrap_lookup
 
-        servable = _wrap_lookup(lambda: self.impl.registry.resolve(model, version))
+        servable = _wrap_lookup(
+            lambda: self.impl.registry.resolve(model, version, label)
+        )
         sig = _wrap_lookup(lambda: servable.signature(signature_name))
         return servable, sig
 
@@ -98,12 +126,23 @@ class RestGateway:
         try:
             return int(raw)
         except ValueError as e:
-            # A non-numeric /versions/{v} segment is a CLIENT error (TF-
-            # Serving also has /labels/{l}; labels are out of scope here),
-            # not an internal one.
+            # A non-numeric /versions/{v} segment is a CLIENT error, not an
+            # internal one (label routing rides /labels/{l} instead).
             raise ServiceError(
                 "INVALID_ARGUMENT", f"version must be an integer, got {raw!r}"
             ) from e
+
+    @staticmethod
+    def _fill_model_spec(spec, model: str, version: int | None, label) -> None:
+        """ONE place that turns route segments into a ModelSpec, for all
+        three POST verbs (version and label arrive from distinct routes, so
+        the upstream oneof exclusivity holds by construction here; the
+        service still enforces it for raw proto callers)."""
+        spec.name = model
+        if version is not None:
+            spec.version.value = version
+        if label:
+            spec.version_label = label
 
     @staticmethod
     def _arrays_from_instances(instances, sig) -> dict[str, np.ndarray]:
@@ -155,10 +194,20 @@ class RestGateway:
 
     # -------------------------------------------------------------- routes
 
+    async def _observed(self, name: str, handler, request) -> web.Response:
+        t0 = time.perf_counter()
+        resp = await handler(request)
+        self.metrics.observe(name, time.perf_counter() - t0, resp.status < 400)
+        return resp
+
     async def predict(self, request: web.Request) -> web.Response:
+        return await self._observed("REST.Predict", self._predict, request)
+
+    async def _predict(self, request: web.Request) -> web.Response:
         model = request.match_info["model"]
         try:
             version = self._parse_version(request.match_info.get("version"))
+            label = request.match_info.get("label")
             try:
                 body = await request.json()
             except Exception as e:  # noqa: BLE001 — malformed JSON is a 400
@@ -172,7 +221,7 @@ class RestGateway:
                     "INVALID_ARGUMENT",
                     'body must carry exactly one of "instances" or "inputs"',
                 )
-            servable, sig = self._resolve_specs(model, version, signature_name)
+            servable, sig = self._resolve_specs(model, version, signature_name, label)
             if row_format:
                 arrays = self._arrays_from_instances(body["instances"], sig)
             else:
@@ -189,11 +238,14 @@ class RestGateway:
                 arrays = self._to_ndarrays(cols, sig.input_specs)
 
             # ONE semantics path: the same proto the gRPC surface parses.
+            # The spec pins the CONCRETE version this gateway just resolved
+            # (and validated inputs against) — re-sending the label (or an
+            # absent version) would let the impl re-resolve, and a label
+            # retarget / hot-swap landing between decode and execute would
+            # pair one version's signature with another's execution.
             req = apis.PredictRequest()
-            req.model_spec.name = model
+            self._fill_model_spec(req.model_spec, model, servable.version, None)
             req.model_spec.signature_name = signature_name
-            if version is not None:
-                req.model_spec.version.value = version
             for key, arr in arrays.items():
                 codec.from_ndarray(
                     arr, use_tensor_content=True, out=req.inputs[key]
@@ -289,9 +341,9 @@ class RestGateway:
         RegressionRequest's model_spec + Input (examples [+ context])."""
         model = request.match_info["model"]
         version = self._parse_version(request.match_info.get("version"))
-        req.model_spec.name = model
-        if version is not None:
-            req.model_spec.version.value = version
+        self._fill_model_spec(
+            req.model_spec, model, version, request.match_info.get("label")
+        )
         req.model_spec.signature_name = body.get("signature_name", "")
         examples = body.get("examples")
         if not isinstance(examples, list) or not examples:
@@ -339,10 +391,27 @@ class RestGateway:
             return _json_error("INTERNAL", f"internal error: {e}")
 
     async def classify(self, request: web.Request) -> web.Response:
-        return await self._example_route(request, "classify")
+        return await self._observed(
+            "REST.Classify",
+            lambda r: self._example_route(r, "classify"),
+            request,
+        )
 
     async def regress(self, request: web.Request) -> web.Response:
-        return await self._example_route(request, "regress")
+        return await self._observed(
+            "REST.Regress",
+            lambda r: self._example_route(r, "regress"),
+            request,
+        )
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        stats = getattr(self.impl.batcher, "stats", None)
+        return web.Response(
+            body=self.metrics.prometheus_text(stats).encode("utf-8"),
+            headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
+            },
+        )
 
     async def status(self, request: web.Request) -> web.Response:
         model = request.match_info["model"]
@@ -403,11 +472,15 @@ class RestGateway:
 
 
 async def start_rest_gateway(
-    impl: PredictionServiceImpl, host: str = "127.0.0.1", port: int = 8501
+    impl: PredictionServiceImpl,
+    host: str = "127.0.0.1",
+    port: int = 8501,
+    metrics=None,
 ) -> tuple[web.AppRunner, int]:
     """Start the gateway; returns (runner, bound_port). Stop with
-    `await runner.cleanup()`."""
-    gw = RestGateway(impl)
+    `await runner.cleanup()`. Pass the gRPC server's ServerMetrics so
+    /monitoring/prometheus/metrics aggregates both surfaces."""
+    gw = RestGateway(impl, metrics)
     runner = web.AppRunner(gw.app)
     await runner.setup()
     site = web.TCPSite(runner, host, port)

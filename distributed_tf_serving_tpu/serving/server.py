@@ -244,7 +244,9 @@ def build_stack(
             model_base_path,
             registry,
             VersionWatcherConfig(
-                model_name=cfg.model_name, model_kind=cfg.model_kind
+                model_name=cfg.model_name,
+                model_kind=cfg.model_kind,
+                desired_labels=cfg.version_labels,
             ),
             # warmup_via_queue: compilation rides the batching thread, so a
             # hot-load never races the jit caches with live traffic.
@@ -291,6 +293,13 @@ def build_stack(
     if cfg.warmup:
         log.info("warming bucket ladder %s", cfg.buckets)
         batcher.warmup(servable)
+    # Static-artifact paths load exactly the versions above, so a label
+    # naming anything else is a config error — fail at startup, like
+    # tensorflow_model_server refusing labels on unavailable versions
+    # (the watcher path instead retries as versions land).
+    for label, version in cfg.version_labels:
+        registry.set_label(cfg.model_name, label, version)
+        log.info("label %r -> %s v%d", label, cfg.model_name, version)
     return registry, batcher, impl, servable, mesh, None
 
 
@@ -334,6 +343,13 @@ def serve(argv=None) -> None:
                         "surface, /v1/models/... routes) on this port")
     parser.add_argument("--metrics-every-s", type=float, default=0.0,
                         help="periodically log a metrics snapshot")
+    parser.add_argument(
+        "--version-label", dest="version_label_args", action="append",
+        metavar="LABEL=VERSION", default=None,
+        help="assign a version label (repeatable), e.g. --version-label "
+        "stable=2 --version-label canary=3; requests may then address "
+        "/labels/{label} (REST) or ModelSpec.version_label (gRPC)",
+    )
     args = parser.parse_args(argv)
 
     cfgs = load_config(args.config) if args.config else {"server": ServerConfig()}
@@ -355,6 +371,21 @@ def serve(argv=None) -> None:
     }
     if args.no_warmup:
         overrides["warmup"] = False
+    if args.version_label_args:
+        pairs = []
+        for raw in args.version_label_args:
+            label, sep, version = raw.partition("=")
+            try:
+                pairs.append((label, int(version)))
+            except ValueError:
+                sep = ""
+            if not sep or not label:
+                raise SystemExit(
+                    f"--version-label expects LABEL=VERSION, got {raw!r}"
+                )
+        # CLI labels replace the TOML map entirely (same precedence as the
+        # scalar overrides above).
+        overrides["version_labels"] = tuple(sorted(pairs))
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
 
@@ -390,7 +421,7 @@ def serve(argv=None) -> None:
             asyncio.set_event_loop(loop)
             try:
                 _runner, bound = loop.run_until_complete(
-                    start_rest_gateway(impl, cfg.host, args.rest_port)
+                    start_rest_gateway(impl, cfg.host, args.rest_port, metrics)
                 )
                 rest_ready["port"] = bound
             except BaseException as exc:  # noqa: BLE001 — reported to main

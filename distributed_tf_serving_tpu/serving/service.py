@@ -65,11 +65,30 @@ class PredictionServiceImpl:
 
     # ------------------------------------------------------------ resolution
 
+    @staticmethod
+    def _version_choice(model_spec: apis.ModelSpec) -> tuple[int | None, str | None]:
+        """(version, label) from a ModelSpec, enforcing the upstream oneof:
+        the real model.proto wraps version/version_label in oneof
+        version_choice, so setting both is a client error there — here the
+        vendored proto (reference parity) has no oneof, and the server
+        enforces the exclusivity instead."""
+        version = model_spec.version.value if model_spec.HasField("version") else None
+        label = model_spec.version_label or None
+        if version is not None and label is not None:
+            raise ServiceError(
+                "INVALID_ARGUMENT",
+                "model_spec sets both version and version_label; they are a "
+                "oneof upstream — choose one",
+            )
+        return version, label
+
     def _resolve(self, model_spec: apis.ModelSpec) -> tuple[Servable, Signature]:
         if not model_spec.name:
             raise ServiceError("INVALID_ARGUMENT", "model_spec.name is required")
-        version = model_spec.version.value if model_spec.HasField("version") else None
-        servable = _wrap_lookup(lambda: self.registry.resolve(model_spec.name, version))
+        version, label = self._version_choice(model_spec)
+        servable = _wrap_lookup(
+            lambda: self.registry.resolve(model_spec.name, version, label)
+        )
         signature = _wrap_lookup(lambda: servable.signature(model_spec.signature_name))
         return servable, signature
 
@@ -450,10 +469,10 @@ class PredictionServiceImpl:
             )
         if not request.model_spec.name:
             raise ServiceError("INVALID_ARGUMENT", "model_spec.name is required")
-        version = (
-            request.model_spec.version.value if request.model_spec.HasField("version") else None
+        version, label = self._version_choice(request.model_spec)
+        servable = _wrap_lookup(
+            lambda: self.registry.resolve(request.model_spec.name, version, label)
         )
-        servable = _wrap_lookup(lambda: self.registry.resolve(request.model_spec.name, version))
 
         resp = apis.GetModelMetadataResponse()
         resp.model_spec.CopyFrom(self._echo_spec(servable, ""))

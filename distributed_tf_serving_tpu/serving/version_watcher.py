@@ -27,7 +27,12 @@ import pathlib
 import threading
 from typing import Callable
 
-from ..models.registry import Servable, ServableRegistry
+from ..models.registry import (
+    ModelNotFoundError,
+    Servable,
+    ServableRegistry,
+    VersionNotFoundError,
+)
 
 log = logging.getLogger("dts_tpu.versions")
 
@@ -100,6 +105,11 @@ class VersionWatcherConfig:
     # plausible-scores/wrong-math surprise an auto-rollout must not spring.
     # Explicit import_savedmodel calls (operator present) default it on.
     allow_generic_fallback: bool = False
+    # Desired (label, version) assignments, applied as versions become
+    # loadable (tensorflow_model_server's version_labels semantics: a label
+    # can only point at an available version, so assignment is retried each
+    # poll until the version lands).
+    desired_labels: tuple[tuple[str, int], ...] = ()
 
 
 class VersionWatcher:
@@ -135,6 +145,7 @@ class VersionWatcher:
         )
         self._attempts: dict[int, int] = {}  # version -> failed load count
         self._attempt_mtime: dict[int, int] = {}  # version -> mtime at last failure
+        self._label_warned: set[str] = set()  # once-per-label pending warning
 
     # ----------------------------------------------------------------- API
 
@@ -194,14 +205,35 @@ class VersionWatcher:
                     self._attempts[version], self.config.max_load_attempts,
                 )
 
-        # Retention: keep the newest K of the union; unload the rest (only
-        # versions that are actually loaded).
+        # Retention: keep the newest K of the union PLUS any labeled
+        # version — a pinned "stable" must not be retired out from under
+        # its label by newer rollouts (blue-green would silently break).
         loaded = set(self.registry.models().get(name, ()))
+        pinned = set(self.registry.labels(name).values()) | {
+            v for _l, v in self.config.desired_labels
+        }
         keep = set(sorted(loaded, reverse=True)[: self.config.keep_versions])
+        keep |= pinned & loaded
         for version in sorted(loaded - keep):
             self.registry.unload(name, version)
             log.info("retired %s v%d (retention window %d)",
                      name, version, self.config.keep_versions)
+
+        # Label reconciliation: point each desired label at its version the
+        # moment that version is loaded; idempotent, re-tried every poll.
+        for label, version in self.config.desired_labels:
+            if self.registry.labels(name).get(label) == version:
+                continue
+            try:
+                self.registry.set_label(name, label, version)
+                log.info("label %r -> %s v%d", label, name, version)
+            except (ModelNotFoundError, VersionNotFoundError):
+                if label not in self._label_warned:
+                    self._label_warned.add(label)
+                    log.warning(
+                        "label %r wants %s v%d, which is not loaded yet; "
+                        "will keep trying each poll", label, name, version,
+                    )
 
     # ------------------------------------------------------------ internals
 

@@ -43,6 +43,11 @@ class ServerConfig:
     # shard dense MLP/cross weights over the model axis (§2.4 TP row;
     # embedding tables are always vocab-sharded when a mesh is used)
     tensor_parallel: bool = False
+    # Version-label routing (tensorflow_model_server's version_labels map:
+    # "stable"/"canary" -> version number). TOML: version_labels = {stable
+    # = 2, canary = 3}; stored as sorted (label, version) pairs so the
+    # frozen config stays hashable.
+    version_labels: tuple[tuple[str, int], ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,6 +92,10 @@ def _coerce(cls, data: dict[str, Any]):
     for key, value in data.items():
         if isinstance(value, list):
             value = tuple(value)
+        elif isinstance(value, dict) and key == "version_labels":
+            # TOML inline table -> the hashable pair form the frozen
+            # dataclass stores.
+            value = tuple(sorted((str(k), int(v)) for k, v in value.items()))
         kwargs[key] = value
     return cls(**kwargs)
 

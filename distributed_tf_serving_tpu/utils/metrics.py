@@ -83,6 +83,21 @@ class LatencyHistogram:
             "p99_ms": round(self.percentile_ms(99), 3),
         }
 
+    def prometheus_buckets(self) -> tuple[list[tuple[float, int]], float, int]:
+        """(cumulative (le_us, count) pairs, sum_us, total) for Prometheus
+        histogram exposition. Trimmed past the last occupied bucket — the
+        +Inf bucket the caller appends covers the remainder — so an idle
+        RPC costs 1 line, not 156."""
+        with self._lock:
+            counts = list(self._counts)
+            total, sum_us = self._total, self._sum_us
+        last = max((i for i, c in enumerate(counts) if c), default=-1)
+        out, acc = [], 0
+        for i in range(last + 1):
+            acc += counts[i]
+            out.append((_EDGES_US[i], acc))
+        return out, sum_us, total
+
 
 @dataclasses.dataclass
 class RpcMetrics:
@@ -138,3 +153,43 @@ class ServerMetrics:
                 "max_queue_depth": batcher_stats.max_queue_depth,
             }
         return out
+
+    def prometheus_text(self, batcher_stats=None) -> str:
+        """Prometheus exposition (text format 0.0.4) of the same data
+        snapshot() serves as JSON. Metric names mirror tensorflow_model_
+        server's monitoring surface (`:tensorflow:serving:request_count` /
+        `:tensorflow:serving:request_latency`, microsecond buckets) so
+        existing TF-Serving dashboards and alert rules scrape unchanged;
+        batcher gauges are framework-native and ride the dts_tpu_ prefix."""
+        rc, rl = ":tensorflow:serving:request_count", ":tensorflow:serving:request_latency"
+        lines = [f"# TYPE {rc} counter"]
+        with self._lock:
+            items = sorted(self._rpcs.items())
+        for name, m in items:
+            lines.append(f'{rc}{{entrypoint="{name}",status="OK"}} {m.ok}')
+            if m.errors:
+                lines.append(f'{rc}{{entrypoint="{name}",status="ERROR"}} {m.errors}')
+        lines.append(f"# TYPE {rl} histogram")
+        for name, m in items:
+            buckets, sum_us, total = m.latency.prometheus_buckets()
+            for le_us, cum in buckets:
+                lines.append(
+                    f'{rl}_bucket{{entrypoint="{name}",le="{le_us:.6g}"}} {cum}'
+                )
+            lines.append(f'{rl}_bucket{{entrypoint="{name}",le="+Inf"}} {total}')
+            lines.append(f'{rl}_sum{{entrypoint="{name}"}} {sum_us:.6g}')
+            lines.append(f'{rl}_count{{entrypoint="{name}"}} {total}')
+        if batcher_stats is not None:
+            for metric, kind, value in (
+                ("dts_tpu_batcher_batches_total", "counter", batcher_stats.batches),
+                ("dts_tpu_batcher_requests_total", "counter", batcher_stats.requests),
+                ("dts_tpu_batcher_mean_occupancy", "gauge",
+                 round(batcher_stats.mean_occupancy, 4)),
+                ("dts_tpu_batcher_mean_requests_per_batch", "gauge",
+                 round(batcher_stats.mean_requests_per_batch, 3)),
+                ("dts_tpu_batcher_max_queue_depth", "gauge",
+                 batcher_stats.max_queue_depth),
+            ):
+                lines.append(f"# TYPE {metric} {kind}")
+                lines.append(f"{metric} {value}")
+        return "\n".join(lines) + "\n"

@@ -154,6 +154,38 @@ def test_error_taxonomy_maps_to_http(stack):
         assert "error" in body
 
 
+def test_label_routes(stack):
+    """/labels/{l} routes resolve through the registry's label map for all
+    three POST verbs; unknown labels take the NOT_FOUND taxonomy."""
+    impl, sv = stack
+    impl.registry.set_label("DCN", "stable", 1)
+    rng = np.random.RandomState(9)
+    ids = rng.randint(0, 1 << 40, size=(3, F)).astype(np.int64)
+    wts = rng.rand(3, F).astype(np.float32)
+
+    async def handler(session):
+        body = {"inputs": {"feat_ids": ids.tolist(), "feat_wts": wts.tolist()}}
+        async with session.post("/v1/models/DCN/labels/stable:predict", json=body) as r:
+            assert r.status == 200, await r.text()
+            pred = np.asarray((await r.json())["outputs"]["prediction_node"], np.float32)
+        ex_body = {"examples": [
+            {"feat_ids": ids[i].tolist(), "feat_wts": wts[i].tolist()}
+            for i in range(3)
+        ]}
+        async with session.post("/v1/models/DCN/labels/stable:classify", json=ex_body) as r:
+            classify_status = r.status
+        async with session.post("/v1/models/DCN/labels/stable:regress", json=ex_body) as r:
+            regress_status = r.status
+        async with session.post("/v1/models/DCN/labels/nope:predict", json=body) as r:
+            unknown = (r.status, await r.json())
+        return pred, classify_status, regress_status, unknown
+
+    pred, c_status, r_status, unknown = _run(impl, handler)
+    np.testing.assert_allclose(pred, _native_scores(sv, ids, wts), rtol=1e-5)
+    assert c_status == 200 and r_status == 200
+    assert unknown[0] == 404 and "error" in unknown[1]
+
+
 def test_status_and_metadata_routes(stack):
     impl, _sv = stack
 
@@ -286,6 +318,48 @@ def test_classify_regress_error_taxonomy(stack):
     assert res["out_of_range"][0] == 400  # protobuf range error, not a 500
     for _status, body in res.values():
         assert "error" in body
+
+
+def test_prometheus_monitoring_endpoint(stack):
+    """/monitoring/prometheus/metrics serves TF-Serving-named metrics in
+    text format 0.0.4: OK and ERROR counters, a monotone latency histogram
+    with matching _count, and the batcher gauges."""
+    impl, _sv = stack
+    ids = np.ones((2, F), np.int64)
+    wts = np.ones((2, F), np.float32)
+
+    async def handler(session):
+        body = {"inputs": {"feat_ids": ids.tolist(), "feat_wts": wts.tolist()}}
+        for _ in range(3):
+            async with session.post("/v1/models/DCN:predict", json=body) as r:
+                assert r.status == 200
+        async with session.post("/v1/models/NOPE:predict", json=body) as r:
+            assert r.status == 404
+        async with session.get("/monitoring/prometheus/metrics") as r:
+            return r.status, r.headers["Content-Type"], await r.text()
+
+    status, ctype, text = _run(impl, handler)
+    assert status == 200
+    assert "version=0.0.4" in ctype
+    ok = err = None
+    hist_counts, hist_count_line = [], None
+    for ln in text.splitlines():
+        if ln.startswith('#'):
+            continue
+        name, _, value = ln.rpartition(" ")
+        if name.startswith(':tensorflow:serving:request_count{entrypoint="REST.Predict"'):
+            if 'status="OK"' in name:
+                ok = int(value)
+            elif 'status="ERROR"' in name:
+                err = int(value)
+        elif name.startswith(':tensorflow:serving:request_latency_bucket{entrypoint="REST.Predict"'):
+            hist_counts.append(int(value))
+        elif name.startswith(':tensorflow:serving:request_latency_count{entrypoint="REST.Predict"'):
+            hist_count_line = int(value)
+    assert ok == 3 and err == 1
+    assert hist_counts == sorted(hist_counts)  # cumulative => monotone
+    assert hist_counts[-1] == hist_count_line == 4  # +Inf bucket == count
+    assert "dts_tpu_batcher_batches_total" in text
 
 
 def test_rest_and_grpc_same_scores(stack):

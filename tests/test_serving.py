@@ -107,6 +107,64 @@ def test_predict_version_pinning(stack):
     np.testing.assert_allclose(a1, _golden(registry.resolve("DCN", 1), arrays), rtol=1e-6)
 
 
+def test_predict_version_label_routing(stack):
+    """ModelSpec.version_label (upstream model.proto field 4) resolves to
+    the labeled version; retargeting the label is the blue-green flip."""
+    registry, impl, _ = stack
+    registry.set_label("DCN", "stable", 1)
+    registry.set_label("DCN", "canary", 3)
+    arrays = _arrays()
+    req = build_predict_request(arrays, "DCN")
+    req.model_spec.version_label = "stable"
+    r = impl.predict(req)
+    assert r.model_spec.version.value == 1  # echoes the RESOLVED version
+    np.testing.assert_allclose(
+        codec.to_ndarray(r.outputs["prediction_node"]),
+        _golden(registry.resolve("DCN", 1), arrays), rtol=1e-6,
+    )
+    registry.set_label("DCN", "stable", 3)  # the flip: no client change
+    assert impl.predict(req).model_spec.version.value == 3
+    registry.set_label("DCN", "stable", 1)  # restore for other tests
+
+
+def test_version_label_errors(stack):
+    registry, impl, _ = stack
+    req = build_predict_request(_arrays(), "DCN")
+    req.model_spec.version_label = "nope"
+    with pytest.raises(ServiceError) as e:
+        impl.predict(req)
+    assert e.value.code == "NOT_FOUND"
+
+    # version AND label together violate the upstream oneof.
+    both = build_predict_request(_arrays(), "DCN", version=1)
+    both.model_spec.version_label = "stable"
+    with pytest.raises(ServiceError) as e2:
+        impl.predict(both)
+    assert e2.value.code == "INVALID_ARGUMENT"
+
+    # Labels may only name LOADED versions (config typos fail at
+    # assignment time, not at request time).
+    from distributed_tf_serving_tpu.models.registry import VersionNotFoundError
+
+    with pytest.raises(VersionNotFoundError):
+        registry.set_label("DCN", "broken", 99)
+
+
+def test_unload_drops_labels():
+    registry = ServableRegistry()
+    registry.load(_servable(version=1, seed=0))
+    registry.load(_servable(version=2, seed=1))
+    registry.set_label("DCN", "stable", 1)
+    registry.unload("DCN", 1)
+    assert registry.labels("DCN") == {}  # no dangling label
+    registry.set_label("DCN", "stable", 2)
+    registry.unload("DCN")
+    from distributed_tf_serving_tpu.models.registry import ModelNotFoundError
+
+    with pytest.raises(ModelNotFoundError):
+        registry.resolve("DCN", label="stable")
+
+
 def test_predict_output_filter(stack):
     _, impl, _ = stack
     resp = impl.predict(build_predict_request(_arrays(), "DCN", output_filter=("logits",)))

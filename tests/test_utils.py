@@ -86,6 +86,8 @@ port = 8500
 buckets = [64, 256]
 model_kind = "dlrm"
 
+version_labels = {stable = 2, canary = 3}
+
 [client]
 hosts = ["a:1", "b:2", "c:3"]
 candidate_num = 500
@@ -95,6 +97,9 @@ candidate_num = 500
     assert cfg["server"].port == 8500
     assert cfg["server"].buckets == (64, 256)
     assert cfg["server"].model_kind == "dlrm"
+    # Inline table -> sorted hashable pairs (the registry/watcher contract).
+    assert cfg["server"].version_labels == (("canary", 3), ("stable", 2))
+    hash(cfg["server"])  # frozen config must stay hashable with labels set
     assert cfg["client"].hosts == ("a:1", "b:2", "c:3")
     assert cfg["client"].candidate_num == 500
     assert cfg["client"].num_fields == 43  # untouched default

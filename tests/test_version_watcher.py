@@ -80,6 +80,38 @@ def test_load_retire_and_latest_flip(tmp_path):
     assert registry.resolve("DCN", version=2).version == 2
 
 
+def test_desired_labels_applied_and_pin_survives_retention(tmp_path):
+    """desired_labels assign as versions land, retry while pending, and a
+    labeled version is exempt from retention (blue-green: 'stable' pinned
+    at an old version must survive newer rollouts)."""
+    registry = ServableRegistry()
+    _write_version(tmp_path, 1, seed=1)
+    w = VersionWatcher(
+        tmp_path, registry,
+        VersionWatcherConfig(
+            poll_interval_s=3600, keep_versions=2, model_name="DCN",
+            desired_labels=(("canary", 3), ("stable", 1)),
+        ),
+    )
+    w.poll_once()
+    # v1 labeled immediately; v3 not on disk yet -> pending, not fatal.
+    assert registry.labels("DCN") == {"stable": 1}
+
+    _write_version(tmp_path, 2, seed=2)
+    _write_version(tmp_path, 3, seed=3)
+    w.poll_once()
+    # canary landed with v3; stable's v1 is OUTSIDE the newest-2 window but
+    # pinned by its label, so retention keeps it.
+    assert registry.labels("DCN") == {"stable": 1, "canary": 3}
+    assert registry.models() == {"DCN": [1, 2, 3]}
+
+    _write_version(tmp_path, 4, seed=4)
+    w.poll_once()
+    # v2 (unlabeled, outside newest-2) retires; 1 and 3 stay pinned.
+    assert registry.models() == {"DCN": [1, 3, 4]}
+    assert registry.resolve("DCN", label="stable").version == 1
+
+
 def test_partial_version_dir_skipped_then_loaded(tmp_path):
     registry = ServableRegistry()
     (tmp_path / "7").mkdir()  # writer created the dir, content not yet there
