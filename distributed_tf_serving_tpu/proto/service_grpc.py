@@ -102,3 +102,58 @@ def add_PredictionServiceServicer_to_server(servicer, server) -> None:
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
     )
+
+
+# --- tensorflow.serving.ModelService --------------------------------------
+# The model server's second service (model_service.proto upstream): version
+# status for readiness probes + runtime config reload (version-label
+# retargeting here). Same hand-written pattern as PredictionService.
+
+MODEL_SERVICE_NAME = "tensorflow.serving.ModelService"
+
+_MODEL_METHODS = {
+    "GetModelStatus": (apis.GetModelStatusRequest, apis.GetModelStatusResponse),
+    "HandleReloadConfigRequest": (apis.ReloadConfigRequest, apis.ReloadConfigResponse),
+}
+
+
+class ModelServiceStub:
+    """Client stub for ModelService (unary-unary callables per RPC)."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, (req_cls, resp_cls) in _MODEL_METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{MODEL_SERVICE_NAME}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+class ModelServiceServicer:
+    """Service base class; override the RPCs the server implements."""
+
+    def GetModelStatus(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetModelStatus not implemented")
+
+    def HandleReloadConfigRequest(self, request, context):
+        context.abort(
+            grpc.StatusCode.UNIMPLEMENTED, "HandleReloadConfigRequest not implemented"
+        )
+
+
+def add_ModelServiceServicer_to_server(servicer, server) -> None:
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+        for name, (req_cls, resp_cls) in _MODEL_METHODS.items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(MODEL_SERVICE_NAME, handlers),)
+    )

@@ -103,6 +103,8 @@ class RestGateway:
                 "/v1/models/{model}/labels/{label}:regress", self.regress
             ),
             web.get("/v1/models/{model}", self.status),
+            web.get("/v1/models/{model}/versions/{version}", self.status),
+            web.get("/v1/models/{model}/labels/{label}", self.status),
             web.get("/v1/models/{model}/metadata", self.metadata),
             web.get("/monitoring/prometheus/metrics", self.prometheus),
         ])
@@ -414,18 +416,39 @@ class RestGateway:
         )
 
     async def status(self, request: web.Request) -> web.Response:
+        # ONE status implementation: delegate to the ModelService RPC body
+        # (impl.get_model_status) and translate to TF-Serving's REST JSON —
+        # the gRPC and REST surfaces cannot drift (and the /versions and
+        # /labels pinning arrives for free).
         model = request.match_info["model"]
-        versions = self.impl.registry.models().get(model)
-        if not versions:
-            return _json_error("NOT_FOUND", f"model {model!r} not found")
+        try:
+            req = apis.GetModelStatusRequest()
+            self._fill_model_spec(
+                req.model_spec,
+                model,
+                self._parse_version(request.match_info.get("version")),
+                request.match_info.get("label"),
+            )
+            resp = self.impl.get_model_status(req)
+        except ServiceError as e:
+            return _json_error(e.code, str(e))
+        state_name = apis.ModelVersionStatus.State.Name
         return web.json_response({
             "model_version_status": [
                 {
-                    "version": str(v),
-                    "state": "AVAILABLE",
-                    "status": {"error_code": "OK", "error_message": ""},
+                    "version": str(s.version),
+                    "state": state_name(s.state),
+                    # proto3-JSON enum-name convention, like the metadata
+                    # route's dtypes: ecosystem parsers match "OK".
+                    "status": {
+                        "error_code": (
+                            "OK" if s.status.error_code == 0
+                            else s.status.error_code
+                        ),
+                        "error_message": s.status.error_message,
+                    },
                 }
-                for v in sorted(versions)
+                for s in resp.model_version_status
             ]
         })
 

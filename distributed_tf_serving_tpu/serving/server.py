@@ -27,7 +27,10 @@ import jax
 
 from ..models import ModelConfig, Servable, ServableRegistry, build_model, ctr_signatures
 from ..proto.service_grpc import LARGE_MESSAGE_CHANNEL_OPTIONS
-from ..proto import add_PredictionServiceServicer_to_server
+from ..proto import (
+    add_ModelServiceServicer_to_server,
+    add_PredictionServiceServicer_to_server,
+)
 from ..utils.config import ServerConfig, load_config
 from ..utils.metrics import ServerMetrics
 from ..utils.tracing import request_trace
@@ -41,8 +44,9 @@ def _status(code_name: str) -> grpc.StatusCode:
     return getattr(grpc.StatusCode, code_name, grpc.StatusCode.UNKNOWN)
 
 
-class GrpcPredictionService:
-    """grpc servicer adapter: error mapping + per-RPC metrics."""
+class _SyncServicerBase:
+    """Shared adapter plumbing for sync servicers: ServiceError -> grpc
+    status mapping + per-RPC metrics."""
 
     def __init__(self, impl: PredictionServiceImpl, metrics: ServerMetrics | None = None):
         self.impl = impl
@@ -63,6 +67,10 @@ class GrpcPredictionService:
         finally:
             self.metrics.observe(name, time.perf_counter() - t0, ok)
 
+
+class GrpcPredictionService(_SyncServicerBase):
+    """grpc servicer adapter: error mapping + per-RPC metrics."""
+
     def Predict(self, request, context):
         return self._call("Predict", self.impl.predict, request, context)
 
@@ -79,6 +87,19 @@ class GrpcPredictionService:
         return self._call("GetModelMetadata", self.impl.get_model_metadata, request, context)
 
 
+class GrpcModelService(_SyncServicerBase):
+    """tensorflow.serving.ModelService adapter (sync): status + reload.
+    Shares the impl's registry and the server's metrics/error mapping."""
+
+    def GetModelStatus(self, request, context):
+        return self._call("GetModelStatus", self.impl.get_model_status, request, context)
+
+    def HandleReloadConfigRequest(self, request, context):
+        return self._call(
+            "HandleReloadConfigRequest", self.impl.handle_reload_config, request, context
+        )
+
+
 def create_server(
     impl: PredictionServiceImpl,
     address: str = "127.0.0.1:0",
@@ -92,26 +113,18 @@ def create_server(
     )
     servicer = GrpcPredictionService(impl, metrics)
     add_PredictionServiceServicer_to_server(servicer, server)
+    # Same port, second service — exactly tensorflow_model_server's layout.
+    add_ModelServiceServicer_to_server(GrpcModelService(impl, servicer.metrics), server)
     port = server.add_insecure_port(address)
     if port == 0:
         raise RuntimeError(f"could not bind {address}")
     return server, port
 
 
-class AioGrpcPredictionService:
-    """grpc.aio servicer adapter: one event-loop thread carries every
-    in-flight RPC instead of a handler thread each.
-
-    On a single-core serving host the thread-per-RPC model's GIL hand-offs
-    and context switches are a first-order cost (round-3 load experiment:
-    ~15% of achievable QPS at 64-way concurrency); the coroutine model keeps
-    the hot paths on one thread and awaits the batcher future:
-    Predict/Classify/Regress all ride their _async impl variants.
-    MultiInference and GetModelMetadata run their (cheap, synchronous)
-    bodies inline — MultiInference's sub-calls block the loop for their
-    batch, acceptable for its diagnostic traffic share (the reference's
-    entire workload is Predict, DCNClient.java:111-112).
-    """
+class _AioServicerBase:
+    """Shared adapter plumbing for grpc.aio servicers: ServiceError ->
+    status mapping (coroutine- and plain-callable-aware) + per-RPC
+    metrics. Mirrors _SyncServicerBase."""
 
     def __init__(self, impl: PredictionServiceImpl, metrics: ServerMetrics | None = None):
         self.impl = impl
@@ -136,6 +149,22 @@ class AioGrpcPredictionService:
         finally:
             self.metrics.observe(name, time.perf_counter() - t0, ok)
 
+
+class AioGrpcPredictionService(_AioServicerBase):
+    """grpc.aio servicer adapter: one event-loop thread carries every
+    in-flight RPC instead of a handler thread each.
+
+    On a single-core serving host the thread-per-RPC model's GIL hand-offs
+    and context switches are a first-order cost (round-3 load experiment:
+    ~15% of achievable QPS at 64-way concurrency); the coroutine model keeps
+    the hot paths on one thread and awaits the batcher future:
+    Predict/Classify/Regress all ride their _async impl variants.
+    MultiInference and GetModelMetadata run their (cheap, synchronous)
+    bodies inline — MultiInference's sub-calls block the loop for their
+    batch, acceptable for its diagnostic traffic share (the reference's
+    entire workload is Predict, DCNClient.java:111-112).
+    """
+
     async def Predict(self, request, context):
         return await self._call("Predict", self.impl.predict_async, request, context)
 
@@ -152,6 +181,20 @@ class AioGrpcPredictionService:
         return await self._call("GetModelMetadata", self.impl.get_model_metadata, request, context)
 
 
+class AioGrpcModelService(_AioServicerBase):
+    """ModelService on the coroutine server: both RPCs are cheap registry
+    reads/writes (no batch wait), so they run inline on the loop through
+    the shared _call error mapping."""
+
+    async def GetModelStatus(self, request, context):
+        return await self._call("GetModelStatus", self.impl.get_model_status, request, context)
+
+    async def HandleReloadConfigRequest(self, request, context):
+        return await self._call(
+            "HandleReloadConfigRequest", self.impl.handle_reload_config, request, context
+        )
+
+
 def create_server_async(
     impl: PredictionServiceImpl,
     address: str = "127.0.0.1:0",
@@ -164,6 +207,10 @@ def create_server_async(
     )
     servicer = AioGrpcPredictionService(impl, metrics)
     add_PredictionServiceServicer_to_server(servicer, server)
+    # Same port, second service — exactly tensorflow_model_server's layout.
+    add_ModelServiceServicer_to_server(
+        AioGrpcModelService(impl, servicer.metrics), server
+    )
     port = server.add_insecure_port(address)
     if port == 0:
         raise RuntimeError(f"could not bind {address}")

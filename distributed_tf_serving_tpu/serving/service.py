@@ -456,6 +456,87 @@ class PredictionServiceImpl:
                 )
         return resp
 
+    # ---------------------------------------------------------- ModelService
+
+    def get_model_status(
+        self, request: apis.GetModelStatusRequest
+    ) -> apis.GetModelStatusResponse:
+        """tensorflow.serving.ModelService/GetModelStatus (get_model_status
+        .proto upstream): version states for readiness probes. Loaded
+        versions are AVAILABLE by construction — the registry flips
+        atomically after load+warmup, so the upstream LOADING/UNLOADING
+        transients are never externally observable here."""
+        name = request.model_spec.name
+        if not name:
+            raise ServiceError("INVALID_ARGUMENT", "model_spec.name is required")
+        loaded = self.registry.models().get(name)
+        if not loaded:
+            raise ServiceError("NOT_FOUND", f"model {name!r} not found")
+        version, label = self._version_choice(request.model_spec)
+        if label is not None:
+            servable = _wrap_lookup(
+                lambda: self.registry.resolve(name, None, label)
+            )
+            loaded = [servable.version]
+        elif version is not None:
+            if version not in loaded:
+                raise ServiceError(
+                    "NOT_FOUND",
+                    f"model {name!r} has no version {version}; have {loaded}",
+                )
+            loaded = [version]
+        resp = apis.GetModelStatusResponse()
+        for v in sorted(loaded):
+            st = resp.model_version_status.add()
+            st.version = v
+            st.state = apis.ModelVersionStatus.AVAILABLE
+            st.status.error_code = 0
+        return resp
+
+    def handle_reload_config(
+        self, request: apis.ReloadConfigRequest
+    ) -> apis.ReloadConfigResponse:
+        """tensorflow.serving.ModelService/HandleReloadConfigRequest
+        (model_management.proto upstream), scoped to the config surface
+        this server owns at runtime: the version_labels maps — the
+        blue-green flip over the wire. Each named model's supplied map is
+        the DECLARATIVE label state (upstream semantics): labels absent
+        from it are unassigned, so dropping a finished canary is one
+        request. Model-list lifecycle (add/remove/base-path moves) belongs
+        to the version watcher's filesystem convention, so a config naming
+        an unserved model is NOT_FOUND rather than a partial reload.
+        Validation+application ride one registry lock acquisition
+        (replace_label_maps), so a concurrent unload can never leave the
+        reload half-applied."""
+        cfg = request.config
+        if cfg.WhichOneof("config") != "model_config_list":
+            raise ServiceError(
+                "INVALID_ARGUMENT",
+                "only model_config_list reloads are supported "
+                "(custom_model_config has no meaning here)",
+            )
+        maps: dict[str, dict[str, int]] = {}
+        for mc in cfg.model_config_list.config:
+            if not mc.name:
+                raise ServiceError("INVALID_ARGUMENT", "model config missing name")
+            if not self.registry.models().get(mc.name):
+                raise ServiceError(
+                    "NOT_FOUND",
+                    f"model {mc.name!r} is not served here; reload applies "
+                    "version_labels to already-served models (model-list "
+                    "lifecycle rides the --model-base-path watcher)",
+                )
+            maps[mc.name] = {label: int(v) for label, v in mc.version_labels.items()}
+        try:
+            self.registry.replace_label_maps(maps)
+        except (ModelNotFoundError, VersionNotFoundError) as e:
+            # Labels may only name loaded versions; a vanished model or
+            # version is a precondition failure, applied-nothing.
+            raise ServiceError("FAILED_PRECONDITION", str(e)) from e
+        resp = apis.ReloadConfigResponse()
+        resp.status.error_code = 0
+        return resp
+
     # ------------------------------------------------------- GetModelMetadata
 
     def get_model_metadata(

@@ -105,10 +105,11 @@ class VersionWatcherConfig:
     # plausible-scores/wrong-math surprise an auto-rollout must not spring.
     # Explicit import_savedmodel calls (operator present) default it on.
     allow_generic_fallback: bool = False
-    # Desired (label, version) assignments, applied as versions become
-    # loadable (tensorflow_model_server's version_labels semantics: a label
-    # can only point at an available version, so assignment is retried each
-    # poll until the version lands).
+    # Startup (label, version) assignments, applied ONCE each as their
+    # version becomes loadable (retried while pending). Seed-once, not
+    # continuous enforcement: after a label is assigned, runtime owners
+    # (ModelService HandleReloadConfigRequest) may retarget or drop it and
+    # the watcher must not fight them back every poll.
     desired_labels: tuple[tuple[str, int], ...] = ()
 
 
@@ -146,6 +147,7 @@ class VersionWatcher:
         self._attempts: dict[int, int] = {}  # version -> failed load count
         self._attempt_mtime: dict[int, int] = {}  # version -> mtime at last failure
         self._label_warned: set[str] = set()  # once-per-label pending warning
+        self._labels_applied: set[str] = set()  # seed-once bookkeeping
 
     # ----------------------------------------------------------------- API
 
@@ -208,9 +210,12 @@ class VersionWatcher:
         # Retention: keep the newest K of the union PLUS any labeled
         # version — a pinned "stable" must not be retired out from under
         # its label by newer rollouts (blue-green would silently break).
+        # Pins follow the registry's LIVE label state (runtime retargets
+        # release old pins) plus not-yet-seeded startup labels.
         loaded = set(self.registry.models().get(name, ()))
         pinned = set(self.registry.labels(name).values()) | {
-            v for _l, v in self.config.desired_labels
+            v for l, v in self.config.desired_labels
+            if l not in self._labels_applied
         }
         keep = set(sorted(loaded, reverse=True)[: self.config.keep_versions])
         keep |= pinned & loaded
@@ -219,13 +224,15 @@ class VersionWatcher:
             log.info("retired %s v%d (retention window %d)",
                      name, version, self.config.keep_versions)
 
-        # Label reconciliation: point each desired label at its version the
-        # moment that version is loaded; idempotent, re-tried every poll.
+        # Startup-label seeding: assign each desired label the moment its
+        # version is loaded, ONCE (retrying only while pending) — from then
+        # on the label belongs to runtime control (reload-config RPC).
         for label, version in self.config.desired_labels:
-            if self.registry.labels(name).get(label) == version:
+            if label in self._labels_applied:
                 continue
             try:
                 self.registry.set_label(name, label, version)
+                self._labels_applied.add(label)
                 log.info("label %r -> %s v%d", label, name, version)
             except (ModelNotFoundError, VersionNotFoundError):
                 if label not in self._label_warned:

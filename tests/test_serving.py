@@ -150,6 +150,94 @@ def test_version_label_errors(stack):
         registry.set_label("DCN", "broken", 99)
 
 
+def test_model_service_get_model_status(stack):
+    """tensorflow.serving.ModelService/GetModelStatus over the wire: all
+    loaded versions AVAILABLE, version/label pinning, NOT_FOUND taxonomy."""
+    registry, _impl, port = stack
+    from distributed_tf_serving_tpu.proto import ModelServiceStub
+
+    registry.set_label("DCN", "status_label", 1)
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        stub = ModelServiceStub(ch)
+        req = apis.GetModelStatusRequest()
+        req.model_spec.name = "DCN"
+        resp = stub.GetModelStatus(req, timeout=30)
+        assert [s.version for s in resp.model_version_status] == [1, 3]
+        assert all(
+            s.state == apis.ModelVersionStatus.AVAILABLE
+            and s.status.error_code == 0
+            for s in resp.model_version_status
+        )
+
+        req.model_spec.version.value = 3
+        resp = stub.GetModelStatus(req, timeout=30)
+        assert [s.version for s in resp.model_version_status] == [3]
+
+        req.model_spec.ClearField("version")
+        req.model_spec.version_label = "status_label"
+        resp = stub.GetModelStatus(req, timeout=30)
+        assert [s.version for s in resp.model_version_status] == [1]
+
+        req.model_spec.name = "NOPE"
+        req.model_spec.ClearField("version_label")
+        with pytest.raises(grpc.RpcError) as e:
+            stub.GetModelStatus(req, timeout=30)
+        assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_model_service_reload_config_label_flip(stack):
+    """HandleReloadConfigRequest retargets version labels over the wire —
+    the blue-green flip — atomically: a request with any invalid label
+    applies nothing."""
+    registry, impl, port = stack
+    from distributed_tf_serving_tpu.proto import ModelServiceStub
+
+    registry.set_label("DCN", "reload_label", 1)
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        stub = ModelServiceStub(ch)
+        req = apis.ReloadConfigRequest()
+        mc = req.config.model_config_list.config.add()
+        mc.name = "DCN"
+        mc.version_labels["reload_label"] = 3
+        resp = stub.HandleReloadConfigRequest(req, timeout=30)
+        assert resp.status.error_code == 0
+        # DECLARATIVE: the supplied map IS the label state — labels from
+        # earlier tests/assignments absent from it are unassigned (upstream
+        # reload semantics; dropping a finished canary is one request).
+        assert registry.labels("DCN") == {"reload_label": 3}
+
+        # Routed traffic follows the flip.
+        preq = build_predict_request(_arrays(), "DCN")
+        preq.model_spec.version_label = "reload_label"
+        assert impl.predict(preq).model_spec.version.value == 3
+
+        # Atomicity: one good + one bad label -> FAILED_PRECONDITION and
+        # NOTHING applied (the good label must not move).
+        bad = apis.ReloadConfigRequest()
+        mc = bad.config.model_config_list.config.add()
+        mc.name = "DCN"
+        mc.version_labels["reload_label"] = 1
+        mc.version_labels["zz_broken"] = 99
+        with pytest.raises(grpc.RpcError) as e:
+            stub.HandleReloadConfigRequest(bad, timeout=30)
+        assert e.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert registry.labels("DCN")["reload_label"] == 3  # unchanged
+        assert "zz_broken" not in registry.labels("DCN")
+
+        # Unknown model -> NOT_FOUND; custom config -> INVALID_ARGUMENT.
+        unknown = apis.ReloadConfigRequest()
+        unknown.config.model_config_list.config.add().name = "NOPE"
+        with pytest.raises(grpc.RpcError) as e:
+            stub.HandleReloadConfigRequest(unknown, timeout=30)
+        assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+        custom = apis.ReloadConfigRequest()
+        custom.config.custom_model_config.type_url = "type.googleapis.com/x"
+        with pytest.raises(grpc.RpcError) as e:
+            stub.HandleReloadConfigRequest(custom, timeout=30)
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
 def test_unload_drops_labels():
     registry = ServableRegistry()
     registry.load(_servable(version=1, seed=0))
