@@ -86,6 +86,17 @@ class ShardedExecutor:
 
     def __call__(self, servable: Servable, arrays: dict[str, np.ndarray]):
         (fn, spec), params = self._prepare(servable)
+        rows = next(iter(arrays.values())).shape[0]
+        data = self.mesh.shape[DATA_AXIS]
+        if rows % data:
+            # Candidate-dim sharding splits rows contiguously across the
+            # data axis; a non-multiple batch cannot be placed. Surface the
+            # configuration fix instead of XLA's divisibility error.
+            raise ValueError(
+                f"batch of {rows} rows is not divisible by the mesh data "
+                f"axis ({data}); configure the batcher bucket ladder with "
+                f"multiples of {data} when serving over this mesh"
+            )
         packed = pack_host(arrays, spec) if spec else arrays
         packed = jax.device_put(packed, batch_shardings(packed, self.mesh))
         return fn(params, packed)

@@ -432,6 +432,13 @@ class RestGateway:
             resp = self.impl.get_model_status(req)
         except ServiceError as e:
             return _json_error(e.code, str(e))
+        except ValueError as e:
+            # e.g. a /versions/{v} segment past int64: client error, same
+            # JSON taxonomy as every other route.
+            return _json_error("INVALID_ARGUMENT", str(e))
+        except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
+            log.exception("internal error serving REST status")
+            return _json_error("INTERNAL", f"internal error: {e}")
         state_name = apis.ModelVersionStatus.State.Name
         return web.json_response({
             "model_version_status": [

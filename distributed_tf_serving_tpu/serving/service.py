@@ -516,10 +516,11 @@ class PredictionServiceImpl:
                 "(custom_model_config has no meaning here)",
             )
         maps: dict[str, dict[str, int]] = {}
+        served = self.registry.models()  # one snapshot for the advisory check
         for mc in cfg.model_config_list.config:
             if not mc.name:
                 raise ServiceError("INVALID_ARGUMENT", "model config missing name")
-            if not self.registry.models().get(mc.name):
+            if not served.get(mc.name):
                 raise ServiceError(
                     "NOT_FOUND",
                     f"model {mc.name!r} is not served here; reload applies "
@@ -529,6 +530,9 @@ class PredictionServiceImpl:
             maps[mc.name] = {label: int(v) for label, v in mc.version_labels.items()}
         try:
             self.registry.replace_label_maps(maps)
+        except ValueError as e:
+            # e.g. an empty-string label key — a malformed request.
+            raise ServiceError("INVALID_ARGUMENT", str(e)) from e
         except (ModelNotFoundError, VersionNotFoundError) as e:
             # Labels may only name loaded versions; a vanished model or
             # version is a precondition failure, applied-nothing.

@@ -196,6 +196,11 @@ def test_status_and_metadata_routes(stack):
             meta = (r.status, await r.json())
         async with session.get("/v1/models/NOPE") as r:
             missing = r.status
+        # Past-int64 version segment: JSON 400, not a text/plain 500.
+        async with session.get(
+            "/v1/models/DCN/versions/99999999999999999999"
+        ) as r:
+            assert r.status == 400 and "error" in await r.json()
         return status, meta, missing
 
     (s_code, s_body), (m_code, m_body), missing = _run(impl, handler)

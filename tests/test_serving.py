@@ -237,6 +237,16 @@ def test_model_service_reload_config_label_flip(stack):
             stub.HandleReloadConfigRequest(custom, timeout=30)
         assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
 
+        # Empty-string label key (legal proto3 map key, malformed request):
+        # INVALID_ARGUMENT, not INTERNAL.
+        empty = apis.ReloadConfigRequest()
+        mc = empty.config.model_config_list.config.add()
+        mc.name = "DCN"
+        mc.version_labels[""] = 1
+        with pytest.raises(grpc.RpcError) as e:
+            stub.HandleReloadConfigRequest(empty, timeout=30)
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
 
 def test_unload_drops_labels():
     registry = ServableRegistry()

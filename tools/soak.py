@@ -14,7 +14,11 @@ Traffic mix on ONE event loop (the deployed topology):
   dtypes);
 - REST workers alternating :predict (columnar) with :classify Examples
   (exercises the JSON plane and the Example decode path into the same
-  batcher).
+  batcher);
+- a control-plane worker hammering GetModelStatus and flipping a version
+  label via HandleReloadConfigRequest every ~200 ms (the registry lock
+  under data-plane pressure; labels route no soak traffic, so flips must
+  never perturb scores or error counts).
 
 Reports one JSON line: per-surface request/error counts, error taxonomy,
 RSS start/end (leak watch), batcher + input-cache counters, wall/QPS.
@@ -126,6 +130,7 @@ def main() -> None:
     counts = {
         "grpc_ok": 0, "grpc_err": 0,
         "rest_ok": 0, "rest_err": 0,
+        "control_ok": 0, "control_err": 0,
         "errors": {},
     }
     rss_start = rss_gb()
@@ -177,6 +182,35 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
                 note_error("rest", f"{type(e).__name__}: {e}")
 
+    async def control_worker(gport: int):
+        import grpc as grpc_mod
+
+        from distributed_tf_serving_tpu.proto import ModelServiceStub
+        from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+
+        async with grpc_mod.aio.insecure_channel(f"127.0.0.1:{gport}") as ch:
+            stub = ModelServiceStub(ch)
+            i = 0
+            while time.perf_counter() < deadline:
+                i += 1
+                try:
+                    sreq = apis.GetModelStatusRequest()
+                    sreq.model_spec.name = "DCN"
+                    resp = await stub.GetModelStatus(sreq, timeout=30)
+                    state = resp.model_version_status[0].state
+                    if state != apis.ModelVersionStatus.AVAILABLE:
+                        raise RuntimeError(f"unexpected model state {state}")
+                    rreq = apis.ReloadConfigRequest()
+                    mc = rreq.config.model_config_list.config.add()
+                    mc.name = "DCN"
+                    if i % 2:  # alternate: label present / declared away
+                        mc.version_labels["soak"] = 1
+                    await stub.HandleReloadConfigRequest(rreq, timeout=30)
+                    counts["control_ok"] += 1
+                except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
+                    note_error("control", f"{type(e).__name__}: {e}")
+                await asyncio.sleep(0.2)
+
     async def drive():
         server, gport = create_server_async(impl, "127.0.0.1:0")
         await server.start()
@@ -190,6 +224,7 @@ def main() -> None:
                 await asyncio.gather(
                     *(grpc_worker(client, w) for w in range(grpc_workers)),
                     *(rest_worker(session, w) for w in range(rest_workers)),
+                    control_worker(gport),
                 )
         finally:
             await runner.cleanup()
