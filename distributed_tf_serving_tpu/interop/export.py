@@ -138,6 +138,7 @@ def export_servable(checkpoint_dir: str, out_dir: str, validate: bool = True) ->
     shutil.rmtree(tmp_dir, ignore_errors=True)
     try:
         tf.saved_model.save(module, tmp_dir, signatures={"serving_default": serve})
+        _write_warmup_assets(tmp_dir, servable.name, F, dense_dim)
         summary = {
             "out": out_dir,
             "model": servable.name,
@@ -184,6 +185,61 @@ def export_servable(checkpoint_dir: str, out_dir: str, validate: bool = True) ->
         shutil.rmtree(tmp_dir, ignore_errors=True)
         raise
     return summary
+
+
+def _write_warmup_assets(artifact_dir: str, model_name: str, num_fields: int,
+                         dense_dim: int | None) -> None:
+    """Give the artifact TF-Serving's warmup convention: a representative
+    predict request in assets.extra/tf_serving_warmup_requests, so
+    tensorflow_model_server (and our own version watcher) compile/warm the
+    serving signature at load instead of on the first real request.
+
+    Written by a TF-FREE subprocess: the PredictionLog record needs our
+    vendored tensorflow.serving bindings, which cannot share this
+    process's descriptor pool with TensorFlow (module docstring).
+    """
+    import os
+    import subprocess
+
+    import numpy as np
+
+    rng = np.random.RandomState(11)
+    warm = {
+        "feat_ids": rng.randint(0, 1 << 40, size=(16, num_fields)).astype(np.int64),
+        "feat_wts": rng.rand(16, num_fields).astype(np.float32),
+    }
+    if dense_dim is not None:
+        warm["dense_features"] = rng.rand(16, dense_dim).astype(np.float32)
+    extra_dir = os.path.join(artifact_dir, "assets.extra")
+    os.makedirs(extra_dir, exist_ok=True)
+    npz = os.path.join(extra_dir, "_warm_inputs.npz")
+    np.savez(npz, **warm)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # never let the child touch a device
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            env.get("PYTHONPATH"),
+        ) if p
+    )
+    try:
+        subprocess.run(
+            [sys.executable, "-c", (
+                "import sys, numpy as np\n"
+                "from distributed_tf_serving_tpu.serving.warmup import (\n"
+                "    make_warmup_record, write_tfrecords)\n"
+                "arrays = dict(np.load(sys.argv[1]))\n"
+                "write_tfrecords(sys.argv[2], [make_warmup_record(arrays, sys.argv[3])])\n"
+            ), npz, os.path.join(extra_dir, "tf_serving_warmup_requests"),
+             model_name],
+            check=True, capture_output=True, text=True, timeout=300, env=env,
+        )
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"warmup-asset writer failed: {e.stderr[-1000:]}"
+        ) from e
+    finally:
+        os.remove(npz)
 
 
 def main(argv=None) -> None:

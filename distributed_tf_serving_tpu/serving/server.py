@@ -245,6 +245,12 @@ def load_demo_servable(
     return servable
 
 
+def _replay_warmup(warmup_file, servable, batcher) -> int:
+    from .warmup import replay_warmup_file
+
+    return replay_warmup_file(warmup_file, servable, batcher)
+
+
 def build_stack(
     cfg: ServerConfig,
     checkpoint: str | None = None,
@@ -276,6 +282,7 @@ def build_stack(
         run_fn=run_fn,
         pipeline_depth=cfg.pipeline_depth,
         queue_capacity_candidates=cfg.queue_capacity_candidates,
+        completion_workers=cfg.completion_workers,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
 
@@ -298,6 +305,10 @@ def build_stack(
             # warmup_via_queue: compilation rides the batching thread, so a
             # hot-load never races the jit caches with live traffic.
             warmup=batcher.warmup_via_queue if cfg.warmup else None,
+            warmup_replay=(
+                (lambda sv, wf: _replay_warmup(wf, sv, batcher))
+                if cfg.warmup else None
+            ),
             model_config=model_config
             or ModelConfig(name=cfg.model_name, num_fields=cfg.num_fields),
             mesh=mesh,
@@ -313,6 +324,7 @@ def build_stack(
         return registry, batcher, impl, servable, mesh, watcher
     if savedmodel:
         from ..interop import import_savedmodel
+        from .warmup import warmup_file_for
 
         servable = import_savedmodel(
             savedmodel,
@@ -321,6 +333,10 @@ def build_stack(
             or ModelConfig(name=cfg.model_name, num_fields=cfg.num_fields),
             name=cfg.model_name,
         )
+        wf = warmup_file_for(savedmodel)
+        if wf is not None and cfg.warmup:
+            n = _replay_warmup(wf, servable, batcher)
+            log.info("replayed %d warmup records from %s", n, wf)
         registry.load(servable)
         log.info("imported SavedModel %s: %s v%d", savedmodel, servable.name, servable.version)
     elif checkpoint:
@@ -391,6 +407,13 @@ def serve(argv=None) -> None:
     parser.add_argument("--metrics-every-s", type=float, default=0.0,
                         help="periodically log a metrics snapshot")
     parser.add_argument(
+        "--batching-parameters-file", dest="batching_parameters_file",
+        help="tensorflow_model_server-format batching config (text-format "
+        "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
+        "batch_timeout_micros -> max_wait_us, etc. (utils/config.py "
+        "apply_batching_parameters); applied over [server] TOML values",
+    )
+    parser.add_argument(
         "--version-label", dest="version_label_args", action="append",
         metavar="LABEL=VERSION", default=None,
         help="assign a version label (repeatable), e.g. --version-label "
@@ -435,6 +458,10 @@ def serve(argv=None) -> None:
         overrides["version_labels"] = tuple(sorted(pairs))
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
+    if args.batching_parameters_file:
+        from ..utils.config import apply_batching_parameters
+
+        cfg = apply_batching_parameters(cfg, args.batching_parameters_file)
 
     logging.basicConfig(level=logging.INFO)
     registry, batcher, impl, servable, mesh, watcher = build_stack(

@@ -128,6 +128,11 @@ class VersionWatcher:
         config: VersionWatcherConfig | None = None,
         loader: Callable[[int, pathlib.Path], Servable] | None = None,
         warmup: Callable[[Servable], None] | None = None,
+        # warmup_replay(servable, warmup_file) replays the version's own
+        # assets.extra/tf_serving_warmup_requests records (serving/warmup
+        # .py) after the synthetic bucket warmup, still BEFORE the registry
+        # flip; a corrupt/failing file fails the load like upstream.
+        warmup_replay: Callable[[Servable, pathlib.Path], int] | None = None,
         model_config=None,  # ModelConfig for SavedModel version dirs
         mesh=None,  # restore-time placement for native checkpoints
         tensor_parallel: bool = False,
@@ -137,6 +142,7 @@ class VersionWatcher:
         self.config = config or VersionWatcherConfig()
         self.loader = loader or self._default_loader
         self.warmup = warmup
+        self.warmup_replay = warmup_replay
         self.model_config = model_config
         self.mesh = mesh
         self.tensor_parallel = tensor_parallel
@@ -194,6 +200,15 @@ class VersionWatcher:
                 servable = self.loader(version, path)
                 if self.warmup is not None:
                     self.warmup(servable)  # cold-cache work BEFORE the flip
+                if self.warmup_replay is not None:
+                    from .warmup import warmup_file_for
+
+                    wf = warmup_file_for(path)
+                    if wf is not None:
+                        n = self.warmup_replay(servable, wf)
+                        log.info(
+                            "replayed %d warmup records for %s v%d", n, name, version
+                        )
                 self.registry.load(servable)
                 self._attempts.pop(version, None)
                 self._attempt_mtime.pop(version, None)

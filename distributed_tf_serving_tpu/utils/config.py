@@ -27,6 +27,7 @@ class ServerConfig:
     num_fields: int = 43  # FIELD_NUM, DCNClient.java:25
     buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048, 4096)
     max_wait_us: int = 200
+    completion_workers: int = 4  # threads finishing readback+delivery
     compress_transfer: bool = True
     warmup: bool = True
     # Coalescing keeps filling past max_wait while this many batches are in
@@ -98,6 +99,69 @@ def _coerce(cls, data: dict[str, Any]):
             value = tuple(sorted((str(k), int(v)) for k, v in value.items()))
         kwargs[key] = value
     return cls(**kwargs)
+
+
+def apply_batching_parameters(cfg: ServerConfig, path) -> ServerConfig:
+    """Map a tensorflow_model_server --batching_parameters_file (text-format
+    BatchingParameters, session_bundle_config.proto upstream) onto the
+    ServerConfig's batcher knobs, so existing TF-Serving deployments bring
+    their tuning file unchanged:
+
+    - allowed_batch_sizes        -> the bucket ladder (upstream rule kept:
+                                    when both are set, the largest allowed
+                                    size must equal max_batch_size);
+    - max_batch_size             -> max_batch_candidates (top bucket);
+    - batch_timeout_micros       -> max_wait_us;
+    - max_enqueued_batches       -> queue_capacity_candidates (upstream
+                                    bounds queued BATCHES; ours bounds
+                                    queued candidates, so x max_batch);
+    - num_batch_threads          -> completion_workers (upstream's batch
+                                    compute threads; device compute here is
+                                    the XLA stream, so threads go to
+                                    readback/delivery);
+    - thread_pool_name, pad_variable_length_inputs: no analog (a named
+      shared pool / ragged inputs don't exist here) — ignored, logged.
+    """
+    import logging
+
+    from google.protobuf import text_format
+
+    from ..proto import serving_apis_pb2 as apis
+
+    log = logging.getLogger("dts_tpu.config")
+    bp = text_format.Parse(
+        pathlib.Path(path).read_text(), apis.BatchingParameters()
+    )
+    updates: dict[str, Any] = {}
+    max_batch = bp.max_batch_size.value if bp.HasField("max_batch_size") else None
+    if max_batch is not None and max_batch <= 0:
+        raise ValueError(f"max_batch_size must be positive, got {max_batch}")
+    if bp.allowed_batch_sizes:
+        buckets = tuple(sorted(int(b) for b in bp.allowed_batch_sizes))
+        if any(b <= 0 for b in buckets):
+            raise ValueError(f"allowed_batch_sizes must be positive, got {buckets}")
+        if max_batch is not None and buckets[-1] != max_batch:
+            raise ValueError(
+                f"largest allowed_batch_sizes entry ({buckets[-1]}) must equal "
+                f"max_batch_size ({max_batch}) — the upstream batching rule"
+            )
+        updates["buckets"] = buckets
+    elif max_batch is not None:
+        kept = tuple(b for b in cfg.buckets if b < max_batch)
+        updates["buckets"] = kept + (int(max_batch),)
+    if bp.HasField("batch_timeout_micros"):
+        updates["max_wait_us"] = int(bp.batch_timeout_micros.value)
+    if bp.HasField("max_enqueued_batches"):
+        top = max_batch or (updates.get("buckets") or cfg.buckets)[-1]
+        updates["queue_capacity_candidates"] = int(
+            bp.max_enqueued_batches.value * top
+        )
+    if bp.HasField("num_batch_threads"):
+        updates["completion_workers"] = int(bp.num_batch_threads.value)
+    for field in ("thread_pool_name", "pad_variable_length_inputs"):
+        if bp.HasField(field):
+            log.info("batching parameter %s has no analog here; ignored", field)
+    return dataclasses.replace(cfg, **updates)
 
 
 def load_config(path) -> dict[str, Any]:

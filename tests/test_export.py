@@ -84,6 +84,39 @@ def test_export_carries_reference_wire_contract(exported):
     assert (out / "variables").exists()
 
 
+def test_export_writes_warmup_assets(exported):
+    """The artifact carries TF-Serving's warmup convention
+    (assets.extra/tf_serving_warmup_requests): our reader validates the
+    framing, the record targets the exported model's signature, and the
+    replay path warms a live batcher with it."""
+    sv, out, _summary = exported
+    from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+    from distributed_tf_serving_tpu.serving import DynamicBatcher
+    from distributed_tf_serving_tpu.serving.warmup import (
+        read_tfrecords,
+        replay_warmup_file,
+        warmup_file_for,
+    )
+
+    wf = warmup_file_for(out)
+    assert wf is not None
+    assert not (out / "assets.extra" / "_warm_inputs.npz").exists()  # cleaned
+    records = list(read_tfrecords(wf))
+    assert len(records) == 1
+    log = apis.PredictionLog()
+    log.ParseFromString(records[0])
+    assert log.WhichOneof("log_type") == "predict_log"
+    req = log.predict_log.request
+    assert set(req.inputs) == {"feat_ids", "feat_wts"}
+    assert req.model_spec.name == "DCN"
+
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        assert replay_warmup_file(wf, sv, batcher) == 1
+    finally:
+        batcher.stop()
+
+
 def test_export_dlrm_dense_features(tmp_path):
     """The 3-input DLRM contract (dense_features) exports too, with the
     same TF-side validation."""

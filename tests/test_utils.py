@@ -105,6 +105,50 @@ candidate_num = 500
     assert cfg["client"].num_fields == 43  # untouched default
 
 
+def test_batching_parameters_file(tmp_path):
+    """A tensorflow_model_server batching_parameters_file maps onto the
+    batcher knobs (text-format BatchingParameters, upstream field set)."""
+    from distributed_tf_serving_tpu.utils.config import apply_batching_parameters
+
+    p = tmp_path / "batching.pbtxt"
+    p.write_text(
+        "max_batch_size { value: 2048 }\n"
+        "batch_timeout_micros { value: 5000 }\n"
+        "max_enqueued_batches { value: 8 }\n"
+        "num_batch_threads { value: 6 }\n"
+        "allowed_batch_sizes: 256\n"
+        "allowed_batch_sizes: 1024\n"
+        "allowed_batch_sizes: 2048\n"
+        "pad_variable_length_inputs { value: true }\n"
+    )
+    cfg = apply_batching_parameters(ServerConfig(), p)
+    assert cfg.buckets == (256, 1024, 2048)
+    assert cfg.max_wait_us == 5000
+    assert cfg.queue_capacity_candidates == 8 * 2048
+    assert cfg.completion_workers == 6
+
+    # Upstream rule: largest allowed size must equal max_batch_size.
+    bad = tmp_path / "bad.pbtxt"
+    bad.write_text(
+        "max_batch_size { value: 4096 }\nallowed_batch_sizes: 2048\n"
+    )
+    with pytest.raises(ValueError, match="must equal max_batch_size"):
+        apply_batching_parameters(ServerConfig(), bad)
+
+    # max_batch_size alone: default ladder truncated and capped at it.
+    only_max = tmp_path / "max.pbtxt"
+    only_max.write_text("max_batch_size { value: 1000 }\n")
+    cfg = apply_batching_parameters(ServerConfig(), only_max)
+    assert cfg.buckets[-1] == 1000
+    assert all(b < 1000 for b in cfg.buckets[:-1])
+
+    # Degenerate max_batch_size: clear error, not a 0-bucket ladder.
+    zero = tmp_path / "zero.pbtxt"
+    zero.write_text("max_batch_size { value: 0 }\n")
+    with pytest.raises(ValueError, match="must be positive"):
+        apply_batching_parameters(ServerConfig(), zero)
+
+
 def test_toml_unknown_key_rejected(tmp_path):
     p = tmp_path / "bad.toml"
     p.write_text("[server]\nprot = 1\n")
