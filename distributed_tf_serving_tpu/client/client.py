@@ -103,13 +103,20 @@ def build_predict_request(
     signature_name: str = "serving_default",
     output_filter: tuple[str, ...] = (),
     version: int | None = None,
+    version_label: str | None = None,
     use_tensor_content: bool = True,
 ) -> apis.PredictRequest:
+    if version is not None and version_label is not None:
+        raise ValueError(
+            "version and version_label are a oneof upstream; choose one"
+        )
     req = apis.PredictRequest()
     req.model_spec.name = model_name
     req.model_spec.signature_name = signature_name
     if version is not None:
         req.model_spec.version.value = version
+    if version_label is not None:
+        req.model_spec.version_label = version_label
     for key, arr in arrays.items():
         # In-place into the map entry: skips CopyFrom's second half-MB copy.
         codec.from_ndarray(arr, use_tensor_content=use_tensor_content, out=req.inputs[key])
@@ -135,12 +142,17 @@ class ShardedPredictClient:
         channels_per_host: int = 1,
         full_async: bool = True,
         failover_attempts: int = 0,
+        version_label: str | None = None,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
         self.hosts = list(hosts)
         self.model_name = model_name
         self.signature_name = signature_name
+        # Route by version label ("stable"/"canary") instead of latest —
+        # the server resolves it per request, so a label retarget flips
+        # this client's traffic with no reconnect.
+        self.version_label = version_label
         self.output_key = output_key
         self.timeout_s = timeout_s
         self.use_tensor_content = use_tensor_content
@@ -219,6 +231,7 @@ class ShardedPredictClient:
             self.model_name,
             self.signature_name,
             output_filter=(self.output_key,),
+            version_label=self.version_label,
             use_tensor_content=self.use_tensor_content,
         )
         return await self._shard_call(
@@ -275,6 +288,7 @@ class ShardedPredictClient:
                 self.model_name,
                 self.signature_name,
                 output_filter=(self.output_key,),
+                version_label=self.version_label,
                 use_tensor_content=self.use_tensor_content,
             ).SerializeToString()
             for s in shards
@@ -316,6 +330,7 @@ def client_from_config(cfg) -> ShardedPredictClient:
         use_tensor_content=cfg.use_tensor_content,
         full_async=cfg.full_async_mode,
         failover_attempts=cfg.failover_attempts,
+        version_label=cfg.version_label or None,
     )
 
 

@@ -73,6 +73,9 @@ class ClientConfig:
     # UNAVAILABLE/DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED, up to this many
     # extra attempts (0 = the reference's fail-fast behavior).
     failover_attempts: int = 0
+    # Route by version label instead of latest ("" = unset; upstream
+    # ModelSpec.version_label routing, e.g. "stable"/"canary").
+    version_label: str = ""
 
 
 def _model_config_cls():
@@ -157,7 +160,10 @@ def apply_batching_parameters(cfg: ServerConfig, path) -> ServerConfig:
             bp.max_enqueued_batches.value * top
         )
     if bp.HasField("num_batch_threads"):
-        updates["completion_workers"] = int(bp.num_batch_threads.value)
+        threads = int(bp.num_batch_threads.value)
+        if threads <= 0:
+            raise ValueError(f"num_batch_threads must be positive, got {threads}")
+        updates["completion_workers"] = threads
     for field in ("thread_pool_name", "pad_variable_length_inputs"):
         if bp.HasField(field):
             log.info("batching parameter %s has no analog here; ignored", field)

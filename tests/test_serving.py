@@ -127,6 +127,34 @@ def test_predict_version_label_routing(stack):
     registry.set_label("DCN", "stable", 1)  # restore for other tests
 
 
+def test_client_routes_by_version_label(stack):
+    """ShardedPredictClient(version_label=...) resolves the labeled version
+    over the wire, on both the per-call and prepared-bytes paths."""
+    import asyncio
+
+    from distributed_tf_serving_tpu.client import ShardedPredictClient
+
+    registry, _impl, port = stack
+    registry.set_label("DCN", "client_label", 1)
+    arrays = _arrays(seed=21)
+    want = np.sort(_golden(registry.resolve("DCN", 1), arrays))
+
+    async def go():
+        async with ShardedPredictClient(
+            [f"127.0.0.1:{port}"], "DCN", version_label="client_label"
+        ) as c:
+            live = await c.predict(arrays, sort_scores=True)
+            prepared = await c.predict_prepared(c.prepare(arrays), sort_scores=True)
+            return live, prepared
+
+    live, prepared = asyncio.run(go())
+    np.testing.assert_allclose(live, want, rtol=1e-6)
+    np.testing.assert_allclose(prepared, want, rtol=1e-6)
+
+    with pytest.raises(ValueError, match="oneof"):
+        build_predict_request(arrays, "DCN", version=1, version_label="x")
+
+
 def test_version_label_errors(stack):
     registry, impl, _ = stack
     req = build_predict_request(_arrays(), "DCN")
