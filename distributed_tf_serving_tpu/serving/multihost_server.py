@@ -165,6 +165,13 @@ def build_multihost_stack(
 
 def serve(argv=None) -> None:
     import argparse
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Honor an explicit CPU request over this image's sitecustomize
+        # accelerator pin (config-level override required before backend
+        # init — same guard as the single-host CLI, serving/server.py).
+        jax.config.update("jax_platforms", "cpu")
 
     from .server import create_server
 
@@ -183,6 +190,9 @@ def serve(argv=None) -> None:
                         help="comma-separated multihost bucket ladder")
     parser.add_argument("--model-parallel", type=int, default=1)
     parser.add_argument("--max-workers", type=int, default=32)
+    parser.add_argument("--rest-port", type=int, default=0,
+                        help="leader also serves the TF-Serving REST API "
+                        "(:8501 surface) on this port")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -203,8 +213,29 @@ def serve(argv=None) -> None:
         log.info("follower %d released", args.process_id)
         return
 
-    server, port = create_server(impl, f"{args.host}:{args.port}", args.max_workers)
+    from ..utils.metrics import ServerMetrics
+
+    # ONE metrics instance across gRPC and REST (the monitoring-endpoint
+    # aggregation contract, same as the single-host CLI).
+    metrics = ServerMetrics()
+    server, port = create_server(
+        impl, f"{args.host}:{args.port}", args.max_workers, metrics
+    )
     server.start()
+    if args.rest_port:
+        from .server import start_rest_in_thread
+
+        try:
+            bound = start_rest_in_thread(impl, args.host, args.rest_port, metrics)
+        except RuntimeError as exc:
+            # Same teardown ORDER as the normal path: watcher first, so no
+            # RELOAD broadcast can interleave with the slice shutdown.
+            watcher.stop()
+            server.stop(0)
+            batcher.stop()
+            runner.shutdown()
+            raise SystemExit(str(exc)) from exc
+        log.info("REST gateway on %s:%d (/v1/models/...)", args.host, bound)
     log.info("multihost PredictionService on %s:%d (mesh %s, version %s)",
              args.host, port, dict(runner.mesh.shape), runner.version)
     try:

@@ -245,6 +245,47 @@ def load_demo_servable(
     return servable
 
 
+def start_rest_in_thread(impl, host: str, port: int, metrics=None) -> int:
+    """Run the REST gateway (:8501 surface) on its own event loop in a
+    daemon thread, next to a THREADED gRPC server — the gateway only
+    touches the (thread-safe) impl/batcher. Startup is SYNCHRONIZED: an
+    operator who asked for the surface gets a live port back or a
+    RuntimeError, never a healthy-looking process with a dead thread
+    (tensorflow_model_server exits on REST bind failure too; a wait()
+    timeout counts as failure — the gateway state would be unknown).
+    Shared by the single-host CLI and the multihost leader."""
+    import asyncio
+    import threading
+
+    from .rest import start_rest_gateway
+
+    rest_ready: dict = {}
+    rest_up = threading.Event()
+
+    def run_rest():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        try:
+            _runner, bound = loop.run_until_complete(
+                start_rest_gateway(impl, host, port, metrics)
+            )
+            rest_ready["port"] = bound
+        except BaseException as exc:  # noqa: BLE001 — reported to caller
+            rest_ready["error"] = exc
+            return
+        finally:
+            rest_up.set()
+        loop.run_forever()
+
+    threading.Thread(target=run_rest, name="rest", daemon=True).start()
+    if not rest_up.wait(timeout=30) or "error" in rest_ready:
+        raise RuntimeError(
+            f"REST gateway failed to start on {host}:{port}: "
+            f"{rest_ready.get('error', 'startup timed out after 30s')}"
+        )
+    return rest_ready["port"]
+
+
 def _replay_warmup(warmup_file, servable, batcher) -> int:
     from .warmup import replay_warmup_file
 
@@ -475,50 +516,13 @@ def serve(argv=None) -> None:
     server, port = create_server(impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics)
     server.start()
     if args.rest_port:
-        # REST rides its own event loop in a daemon thread: the gRPC
-        # server here is the threaded variant, and the gateway only
-        # touches the (thread-safe) impl/batcher. Startup is SYNCHRONIZED:
-        # an operator who asked for the :8501 surface must get a fatal
-        # error on bind failure, not a healthy-looking gRPC server plus a
-        # dead thread (tensorflow_model_server exits on REST bind failure
-        # too).
-        import asyncio
-        import threading
-
-        from .rest import start_rest_gateway
-
-        rest_ready: dict = {}
-        rest_up = threading.Event()
-
-        def run_rest():
-            loop = asyncio.new_event_loop()
-            asyncio.set_event_loop(loop)
-            try:
-                _runner, bound = loop.run_until_complete(
-                    start_rest_gateway(impl, cfg.host, args.rest_port, metrics)
-                )
-                rest_ready["port"] = bound
-            except BaseException as exc:  # noqa: BLE001 — reported to main
-                rest_ready["error"] = exc
-                return
-            finally:
-                rest_up.set()
-            loop.run_forever()
-
-        threading.Thread(target=run_rest, name="rest", daemon=True).start()
-        # A wait() timeout (gateway thread hung before setting the event)
-        # is a startup failure too: the fail-fast contract promises the
-        # operator a live :8501 or a fatal exit, never a healthy-looking
-        # log line over an unknown gateway state.
-        if not rest_up.wait(timeout=30) or "error" in rest_ready:
+        try:
+            bound = start_rest_in_thread(impl, cfg.host, args.rest_port, metrics)
+        except RuntimeError as exc:
             server.stop(0)
             batcher.stop()
-            raise SystemExit(
-                f"REST gateway failed to start on {cfg.host}:{args.rest_port}: "
-                f"{rest_ready.get('error', 'startup timed out after 30s')}"
-            )
-        log.info("REST gateway on %s:%d (/v1/models/...)",
-                 cfg.host, rest_ready.get("port", args.rest_port))
+            raise SystemExit(str(exc)) from exc
+        log.info("REST gateway on %s:%d (/v1/models/...)", cfg.host, bound)
     log.info(
         "PredictionService on %s:%d (model=%s kind=%s mesh=%s devices=%s)",
         cfg.host, port, servable.name if servable else "<awaiting versions>",
