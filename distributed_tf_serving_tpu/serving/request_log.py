@@ -1,0 +1,115 @@
+"""Sampled request logging (TF-Serving's LoggingConfig surface).
+
+`tensorflow_model_server` can log a sample of live traffic as
+PredictionLog records (model_config LoggingConfig: a log-collector sink +
+sampling_config.sampling_rate); the logs are the standard input for
+building warmup files, offline replay, and drift analysis. This is the
+in-tree equivalent: sampled requests are framed as PredictionLog TFRecords
+(serving/warmup.py writes the framing, so the output is DIRECTLY usable as
+`assets.extra/tf_serving_warmup_requests` — serve traffic today, warm
+tomorrow's version with it).
+
+Design constraints, in order:
+- The hot path must never block on disk: sampling serializes the request
+  (bytes it may already have) and enqueues onto a BOUNDED queue; a full
+  queue drops the record and counts it (`dropped`), the way upstream's
+  log collector sheds rather than backpressures serving.
+- The writer thread owns the file and the PredictionLog assembly (the
+  proto wrap is deferred off the request thread).
+- Request-only logs (PredictLog.response left empty): warmup replay
+  ignores responses by design, and doubling the bytes for a field the
+  consumers skip is the wrong default. (Upstream can log both; the
+  schema here is identical, so adding responses later is additive.)
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+
+from .warmup import frame_tfrecord
+
+log = logging.getLogger("dts_tpu.request_log")
+
+_KIND_FIELDS = {
+    "predict": "predict_log",
+    "classify": "classify_log",
+    "regress": "regress_log",
+    "multi_inference": "multi_inference_log",
+}
+
+
+class RequestLogger:
+    """Sampled PredictionLog TFRecord writer with a bounded queue."""
+
+    def __init__(
+        self,
+        path,
+        sampling_rate: float = 0.01,
+        max_queued: int = 256,
+        rng: random.Random | None = None,
+    ):
+        if not (0.0 <= sampling_rate <= 1.0):
+            raise ValueError(f"sampling_rate must be in [0, 1], got {sampling_rate}")
+        self.path = path
+        self.sampling_rate = sampling_rate
+        self.written = 0
+        self.dropped = 0
+        self._rng = rng or random.Random()
+        self._queue: queue.Queue = queue.Queue(maxsize=max_queued)
+        self._file = open(path, "ab")
+        self._thread = threading.Thread(
+            target=self._loop, name="request-log", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- hot path
+
+    def maybe_log(self, kind: str, request) -> None:
+        """Sample and enqueue one request; never blocks, never raises."""
+        try:
+            if self._rng.random() >= self.sampling_rate:
+                return
+            payload = request.SerializeToString()
+            try:
+                self._queue.put_nowait((kind, payload))
+            except queue.Full:
+                self.dropped += 1
+        except Exception:  # noqa: BLE001 — logging must never cost a request
+            log.exception("request sampling failed")
+
+    # --------------------------------------------------------------- writer
+
+    def _loop(self) -> None:
+        from ..proto import serving_apis_pb2 as apis
+
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            kind, payload = item
+            try:
+                plog = apis.PredictionLog()
+                getattr(plog, _KIND_FIELDS[kind]).request.MergeFromString(payload)
+                # One write + flush per record: a crash/SIGKILL can
+                # truncate at most the FINAL record, never interleave.
+                self._file.write(frame_tfrecord(plog.SerializeToString()))
+                self._file.flush()
+                self.written += 1
+            except Exception:  # noqa: BLE001 — keep draining
+                log.exception("request-log write failed")
+
+    def close(self) -> None:
+        """Drain and close; idempotent."""
+        if self._thread.is_alive():
+            self._queue.put(None)
+            self._thread.join(timeout=10)
+        if not self._file.closed:
+            self._file.close()
+        if self.dropped:
+            log.warning(
+                "request log %s dropped %d records (queue full)",
+                self.path, self.dropped,
+            )

@@ -455,6 +455,16 @@ def serve(argv=None) -> None:
         "apply_batching_parameters); applied over [server] TOML values",
     )
     parser.add_argument(
+        "--request-log-file", dest="request_log_file",
+        help="log a sample of requests as PredictionLog TFRecords (the "
+        "upstream LoggingConfig surface; output is directly usable as an "
+        "assets.extra/tf_serving_warmup_requests file)",
+    )
+    parser.add_argument(
+        "--request-log-sampling", dest="request_log_sampling", type=float,
+        help="sampling rate in [0,1] for --request-log-file (default 0.01)",
+    )
+    parser.add_argument(
         "--version-label", dest="version_label_args", action="append",
         metavar="LABEL=VERSION", default=None,
         help="assign a version label (repeatable), e.g. --version-label "
@@ -512,6 +522,16 @@ def serve(argv=None) -> None:
         model_config=model_config,
         model_base_path=args.model_base_path,
     )
+    request_logger = None
+    if cfg.request_log_file:
+        from .request_log import RequestLogger
+
+        request_logger = RequestLogger(
+            cfg.request_log_file, sampling_rate=cfg.request_log_sampling
+        )
+        impl.request_logger = request_logger
+        log.info("request logging to %s (sampling %.4f)",
+                 cfg.request_log_file, cfg.request_log_sampling)
     metrics = ServerMetrics()
     server, port = create_server(impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics)
     server.start()
@@ -546,6 +566,8 @@ def serve(argv=None) -> None:
             watcher.stop()
         server.stop(2).wait()
         batcher.stop()
+        if request_logger is not None:
+            request_logger.close()
 
 
 if __name__ == "__main__":

@@ -62,6 +62,14 @@ class PredictionServiceImpl:
     def __init__(self, registry: ServableRegistry, batcher: DynamicBatcher):
         self.registry = registry
         self.batcher = batcher
+        # Optional sampled PredictionLog writer (serving/request_log.py);
+        # assign a RequestLogger to enable — both transports and all four
+        # RPC families flow through these entry points.
+        self.request_logger = None
+
+    def _log_request(self, kind: str, request) -> None:
+        if self.request_logger is not None:
+            self.request_logger.maybe_log(kind, request)
 
     # ------------------------------------------------------------ resolution
 
@@ -309,7 +317,12 @@ class PredictionServiceImpl:
         servable, arrays, out_names = self._predict_prepare(request)
         with request_trace.span("predict.execute"):
             outputs = self._run(servable, arrays, output_keys=tuple(out_names))
-        return self._predict_finish(request, servable, out_names, outputs)
+        resp = self._predict_finish(request, servable, out_names, outputs)
+        # Log only SUCCEEDED requests: the file's contract is direct
+        # usability as a warmup file, and one malformed client request
+        # must never poison a future version rollout (review finding).
+        self._log_request("predict", request)
+        return resp
 
     async def predict_async(self, request: apis.PredictRequest) -> apis.PredictResponse:
         """Predict for coroutine servers: identical semantics, awaits the
@@ -317,7 +330,9 @@ class PredictionServiceImpl:
         servable, arrays, out_names = self._predict_prepare(request)
         with request_trace.span("predict.execute"):
             outputs = await self._run_async(servable, arrays, output_keys=tuple(out_names))
-        return self._predict_finish(request, servable, out_names, outputs)
+        resp = self._predict_finish(request, servable, out_names, outputs)
+        self._log_request("predict", request)
+        return resp
 
     def _predict_finish(
         self, request: apis.PredictRequest, servable: Servable, out_names, outputs
@@ -399,15 +414,25 @@ class PredictionServiceImpl:
             cls.classes.add(label="1", score=float(p))
         return resp
 
-    def classify(self, request: apis.ClassificationRequest) -> apis.ClassificationResponse:
+    def _classify_impl(self, request: apis.ClassificationRequest) -> apis.ClassificationResponse:
+        """classify() minus request logging (multi_inference sub-calls ride
+        this so a logged MultiInference record is not double-counted as its
+        constituent classifications)."""
         servable, outputs = self._run_examples(request)
         return self._classify_finish(request, servable, outputs)
+
+    def classify(self, request: apis.ClassificationRequest) -> apis.ClassificationResponse:
+        resp = self._classify_impl(request)
+        self._log_request("classify", request)
+        return resp
 
     async def classify_async(
         self, request: apis.ClassificationRequest
     ) -> apis.ClassificationResponse:
         servable, outputs = await self._run_examples_async(request)
-        return self._classify_finish(request, servable, outputs)
+        resp = self._classify_finish(request, servable, outputs)
+        self._log_request("classify", request)
+        return resp
 
     def _regress_finish(self, request, servable, outputs) -> apis.RegressionResponse:
         resp = apis.RegressionResponse()
@@ -418,15 +443,22 @@ class PredictionServiceImpl:
             resp.result.regressions.add(value=float(p))
         return resp
 
-    def regress(self, request: apis.RegressionRequest) -> apis.RegressionResponse:
+    def _regress_impl(self, request: apis.RegressionRequest) -> apis.RegressionResponse:
         servable, outputs = self._run_examples(request)
         return self._regress_finish(request, servable, outputs)
+
+    def regress(self, request: apis.RegressionRequest) -> apis.RegressionResponse:
+        resp = self._regress_impl(request)
+        self._log_request("regress", request)
+        return resp
 
     async def regress_async(
         self, request: apis.RegressionRequest
     ) -> apis.RegressionResponse:
         servable, outputs = await self._run_examples_async(request)
-        return self._regress_finish(request, servable, outputs)
+        resp = self._regress_finish(request, servable, outputs)
+        self._log_request("regress", request)
+        return resp
 
     # --------------------------------------------------------- MultiInference
 
@@ -438,13 +470,13 @@ class PredictionServiceImpl:
             method = task.method_name
             if method == "tensorflow/serving/classify":
                 sub = apis.ClassificationRequest(model_spec=task.model_spec, input=request.input)
-                out = self.classify(sub)
+                out = self._classify_impl(sub)
                 r = resp.results.add()
                 r.model_spec.CopyFrom(out.model_spec)
                 r.classification_result.CopyFrom(out.result)
             elif method == "tensorflow/serving/regress":
                 sub = apis.RegressionRequest(model_spec=task.model_spec, input=request.input)
-                out = self.regress(sub)
+                out = self._regress_impl(sub)
                 r = resp.results.add()
                 r.model_spec.CopyFrom(out.model_spec)
                 r.regression_result.CopyFrom(out.result)
@@ -454,6 +486,7 @@ class PredictionServiceImpl:
                     f"unsupported MultiInference method {method!r} "
                     "(expected tensorflow/serving/classify or .../regress)",
                 )
+        self._log_request("multi_inference", request)
         return resp
 
     # ---------------------------------------------------------- ModelService

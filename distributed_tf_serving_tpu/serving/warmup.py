@@ -96,15 +96,24 @@ def read_tfrecords(path):
         yield data
 
 
+def frame_tfrecord(data: bytes) -> bytes:
+    """One record's full framing as a single bytes object — the ONE
+    framing producer (write_tfrecords + the request logger), and a single
+    write() so a crash can truncate at most the final record."""
+    header = struct.pack("<Q", len(data))
+    return b"".join((
+        header,
+        struct.pack("<I", masked_crc32c(header)),
+        data,
+        struct.pack("<I", masked_crc32c(data)),
+    ))
+
+
 def write_tfrecords(path, payloads) -> None:
     """Write TFRecord framing (producer util for tests and export)."""
     with open(path, "wb") as f:
         for data in payloads:
-            header = struct.pack("<Q", len(data))
-            f.write(header)
-            f.write(struct.pack("<I", masked_crc32c(header)))
-            f.write(data)
-            f.write(struct.pack("<I", masked_crc32c(data)))
+            f.write(frame_tfrecord(data))
 
 
 # ------------------------------------------------------------------- replay

@@ -49,6 +49,10 @@ class ServerConfig:
     # = 2, canary = 3}; stored as sorted (label, version) pairs so the
     # frozen config stays hashable.
     version_labels: tuple[tuple[str, int], ...] = ()
+    # Sampled request logging (upstream LoggingConfig): PredictionLog
+    # TFRecords usable directly as warmup files. "" = disabled.
+    request_log_file: str = ""
+    request_log_sampling: float = 0.01
 
 
 @dataclasses.dataclass(frozen=True)

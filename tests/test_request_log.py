@@ -1,0 +1,161 @@
+"""Sampled request logging (serving/request_log.py): PredictionLog
+TFRecord output, kind coverage without double-counting, and the full loop
+— logged traffic replays as a warmup file."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_tf_serving_tpu.client import build_predict_request
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+from distributed_tf_serving_tpu.serving import DynamicBatcher, PredictionServiceImpl
+from distributed_tf_serving_tpu.serving.example_codec import make_example
+from distributed_tf_serving_tpu.serving.request_log import RequestLogger
+from distributed_tf_serving_tpu.serving.warmup import (
+    read_tfrecords,
+    replay_warmup_file,
+)
+
+F = 6
+CFG = ModelConfig(
+    name="DCN", num_fields=F, vocab_size=1 << 12, embed_dim=8,
+    mlp_dims=(16,), num_cross_layers=1, compute_dtype="float32",
+)
+
+
+@pytest.fixture()
+def impl():
+    model = build_model("dcn_v2", CFG)
+    sv = Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(F),
+    )
+    registry = ServableRegistry()
+    registry.load(sv)
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    yield PredictionServiceImpl(registry, batcher), sv
+    batcher.stop()
+
+
+def _arrays(n=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, 1 << 40, size=(n, F)).astype(np.int64),
+        "feat_wts": rng.rand(n, F).astype(np.float32),
+    }
+
+
+def test_logged_traffic_replays_as_warmup(impl, tmp_path):
+    """The loop the feature exists for: serve sampled traffic, use the log
+    file as a warmup file, replay it."""
+    service, sv = impl
+    p = tmp_path / "requests.log"
+    logger = RequestLogger(p, sampling_rate=1.0)
+    service.request_logger = logger
+    for seed in range(4):
+        service.predict(build_predict_request(_arrays(seed=seed), "DCN"))
+    logger.close()
+    assert logger.written == 4 and logger.dropped == 0
+
+    logs = []
+    for payload in read_tfrecords(p):
+        pl = apis.PredictionLog()
+        pl.ParseFromString(payload)
+        logs.append(pl)
+    assert [pl.WhichOneof("log_type") for pl in logs] == ["predict_log"] * 4
+    assert logs[0].predict_log.request.model_spec.name == "DCN"
+
+    batcher2 = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        assert replay_warmup_file(p, sv, batcher2) == 4
+    finally:
+        batcher2.stop()
+
+
+def test_kind_coverage_without_double_count(impl, tmp_path):
+    service, _sv = impl
+    p = tmp_path / "mixed.log"
+    logger = RequestLogger(p, sampling_rate=1.0)
+    service.request_logger = logger
+
+    service.predict(build_predict_request(_arrays(), "DCN"))
+
+    creq = apis.ClassificationRequest()
+    creq.model_spec.name = "DCN"
+    arrays = _arrays(2, seed=3)
+    for i in range(2):
+        creq.input.example_list.examples.append(
+            make_example(arrays["feat_ids"][i], arrays["feat_wts"][i])
+        )
+    service.classify(creq)
+
+    # MultiInference logs ONE multi_inference record, not its sub-calls.
+    mreq = apis.MultiInferenceRequest()
+    for method in ("classify", "regress"):
+        task = mreq.tasks.add()
+        task.model_spec.name = "DCN"
+        task.method_name = f"tensorflow/serving/{method}"
+    mreq.input.CopyFrom(creq.input)
+    service.multi_inference(mreq)
+
+    logger.close()
+    kinds = []
+    for payload in read_tfrecords(p):
+        pl = apis.PredictionLog()
+        pl.ParseFromString(payload)
+        kinds.append(pl.WhichOneof("log_type"))
+    assert sorted(kinds) == ["classify_log", "multi_inference_log", "predict_log"]
+
+
+def test_failed_requests_are_not_logged(impl, tmp_path):
+    """The log's contract is direct warmup-file usability: a malformed
+    request must never land in it (it would poison a future rollout)."""
+    from distributed_tf_serving_tpu.serving import ServiceError
+
+    service, sv = impl
+    p = tmp_path / "clean.log"
+    logger = RequestLogger(p, sampling_rate=1.0)
+    service.request_logger = logger
+
+    bad = build_predict_request(_arrays(), "DCN", signature_name="nope")
+    with pytest.raises(ServiceError):
+        service.predict(bad)
+    service.predict(build_predict_request(_arrays(), "DCN"))
+    logger.close()
+    assert logger.written == 1  # only the good one
+
+    batcher2 = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        assert replay_warmup_file(p, sv, batcher2) == 1
+    finally:
+        batcher2.stop()
+
+
+def test_sampling_zero_and_validation(impl, tmp_path):
+    service, _sv = impl
+    p = tmp_path / "empty.log"
+    logger = RequestLogger(p, sampling_rate=0.0)
+    service.request_logger = logger
+    for _ in range(5):
+        service.predict(build_predict_request(_arrays(), "DCN"))
+    logger.close()
+    assert logger.written == 0
+    assert list(read_tfrecords(p)) == []
+
+    with pytest.raises(ValueError, match="sampling_rate"):
+        RequestLogger(tmp_path / "x", sampling_rate=1.5)
+
+
+def test_close_is_idempotent(tmp_path):
+    logger = RequestLogger(tmp_path / "c.log", sampling_rate=1.0)
+    logger.close()
+    logger.close()
