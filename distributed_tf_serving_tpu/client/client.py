@@ -143,6 +143,7 @@ class ShardedPredictClient:
         full_async: bool = True,
         failover_attempts: int = 0,
         version_label: str | None = None,
+        channel_credentials: "grpc.ChannelCredentials | None" = None,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -175,11 +176,16 @@ class ShardedPredictClient:
         # window throttles a half-MB-per-request load at high concurrency.
         self.channels_per_host = max(1, channels_per_host)
         opts = list(LARGE_MESSAGE_CHANNEL_OPTIONS)
+        # TLS when the server runs --ssl-config-file: pass
+        # grpc.ssl_channel_credentials(root_certificates=..., [+ client key/
+        # cert for mTLS]); None keeps the reference's plaintext channels.
+        make_channel = (
+            (lambda h: grpc.aio.secure_channel(h, channel_credentials, options=opts))
+            if channel_credentials is not None
+            else (lambda h: grpc.aio.insecure_channel(h, options=opts))
+        )
         self._channels = [
-            [
-                grpc.aio.insecure_channel(h, options=opts)
-                for _ in range(self.channels_per_host)
-            ]
+            [make_channel(h) for _ in range(self.channels_per_host)]
             for h in self.hosts
         ]
         self._stubs = [
@@ -331,6 +337,23 @@ def client_from_config(cfg) -> ShardedPredictClient:
         full_async=cfg.full_async_mode,
         failover_attempts=cfg.failover_attempts,
         version_label=cfg.version_label or None,
+        channel_credentials=_credentials_from_config(cfg),
+    )
+
+
+def _credentials_from_config(cfg):
+    """grpc.ssl_channel_credentials from the ClientConfig tls_* file paths
+    (None when unset — plaintext, the reference default)."""
+    if not (cfg.tls_root_certs_file or cfg.tls_client_cert_file):
+        return None
+
+    def read(path):
+        return open(path, "rb").read() if path else None
+
+    return grpc.ssl_channel_credentials(
+        root_certificates=read(cfg.tls_root_certs_file),
+        private_key=read(cfg.tls_client_key_file),
+        certificate_chain=read(cfg.tls_client_cert_file),
     )
 
 

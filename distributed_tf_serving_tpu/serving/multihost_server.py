@@ -193,7 +193,16 @@ def serve(argv=None) -> None:
     parser.add_argument("--rest-port", type=int, default=0,
                         help="leader also serves the TF-Serving REST API "
                         "(:8501 surface) on this port")
+    parser.add_argument("--ssl-config-file", dest="ssl_config_file",
+                        help="secure the leader's gRPC port (SSLConfig "
+                        "textproto, same format as the single-host CLI)")
     args = parser.parse_args(argv)
+    # Fail-fast like the single-host CLI: validate before slice init.
+    credentials = None
+    if args.ssl_config_file:
+        from .server import load_ssl_credentials
+
+        credentials = load_ssl_credentials(args.ssl_config_file)
 
     logging.basicConfig(level=logging.INFO)
     runner, registry, batcher, impl, watcher = build_multihost_stack(
@@ -219,7 +228,8 @@ def serve(argv=None) -> None:
     # aggregation contract, same as the single-host CLI).
     metrics = ServerMetrics()
     server, port = create_server(
-        impl, f"{args.host}:{args.port}", args.max_workers, metrics
+        impl, f"{args.host}:{args.port}", args.max_workers, metrics,
+        credentials=credentials,
     )
     server.start()
     if args.rest_port:

@@ -105,8 +105,11 @@ def create_server(
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
     metrics: ServerMetrics | None = None,
+    credentials: "grpc.ServerCredentials | None" = None,
 ) -> tuple[grpc.Server, int]:
-    """Build (not start) a server; returns (server, bound_port)."""
+    """Build (not start) a server; returns (server, bound_port).
+    `credentials` switches the port to TLS (ssl_server_credentials — the
+    --ssl-config-file surface; see load_ssl_credentials)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="rpc"),
         options=list(LARGE_MESSAGE_CHANNEL_OPTIONS),
@@ -115,10 +118,45 @@ def create_server(
     add_PredictionServiceServicer_to_server(servicer, server)
     # Same port, second service — exactly tensorflow_model_server's layout.
     add_ModelServiceServicer_to_server(GrpcModelService(impl, servicer.metrics), server)
-    port = server.add_insecure_port(address)
+    if credentials is not None:
+        port = server.add_secure_port(address, credentials)
+    else:
+        port = server.add_insecure_port(address)
     if port == 0:
         raise RuntimeError(f"could not bind {address}")
     return server, port
+
+
+def load_ssl_credentials(path) -> "grpc.ServerCredentials":
+    """tensorflow_model_server's --ssl_config_file: a text-format SSLConfig
+    whose fields carry the PEM CONTENTS inline (upstream convention).
+    client_verify=true demands a client certificate chained to custom_ca
+    (mTLS); custom_ca without client_verify merely offers it."""
+    import pathlib
+
+    from google.protobuf import text_format
+
+    from ..proto import serving_apis_pb2 as apis
+
+    cfg = text_format.Parse(pathlib.Path(path).read_text(), apis.SSLConfig())
+    if not cfg.server_key or not cfg.server_cert:
+        raise ValueError(
+            f"{path}: SSLConfig requires both server_key and server_cert "
+            "(PEM contents inline)"
+        )
+    if cfg.client_verify and not cfg.custom_ca:
+        # grpc-python itself rejects require_client_auth without root
+        # certificates ("Illegal to require client auth without providing
+        # root certificates!"); surface the config-level fix instead.
+        raise ValueError(
+            f"{path}: client_verify requires custom_ca (the CA that signs "
+            "client certificates; grpc refuses client auth without roots)"
+        )
+    return grpc.ssl_server_credentials(
+        [(cfg.server_key.encode(), cfg.server_cert.encode())],
+        root_certificates=cfg.custom_ca.encode() if cfg.custom_ca else None,
+        require_client_auth=cfg.client_verify,
+    )
 
 
 class _AioServicerBase:
@@ -455,6 +493,12 @@ def serve(argv=None) -> None:
         "apply_batching_parameters); applied over [server] TOML values",
     )
     parser.add_argument(
+        "--ssl-config-file", dest="ssl_config_file",
+        help="serve gRPC over TLS: a tensorflow_model_server-format "
+        "SSLConfig textproto (PEM contents inline; client_verify=true "
+        "for mTLS) — load_ssl_credentials",
+    )
+    parser.add_argument(
         "--request-log-file", dest="request_log_file",
         help="log a sample of requests as PredictionLog TFRecords (the "
         "upstream LoggingConfig surface; output is directly usable as an "
@@ -513,6 +557,12 @@ def serve(argv=None) -> None:
         from ..utils.config import apply_batching_parameters
 
         cfg = apply_batching_parameters(cfg, args.batching_parameters_file)
+    # Parse/validate BEFORE the (expensive) stack build: a typo'd PEM must
+    # fail in milliseconds, not after checkpoint load + warmup compiles.
+    credentials = (
+        load_ssl_credentials(args.ssl_config_file)
+        if args.ssl_config_file else None
+    )
 
     logging.basicConfig(level=logging.INFO)
     registry, batcher, impl, servable, mesh, watcher = build_stack(
@@ -533,8 +583,13 @@ def serve(argv=None) -> None:
         log.info("request logging to %s (sampling %.4f)",
                  cfg.request_log_file, cfg.request_log_sampling)
     metrics = ServerMetrics()
-    server, port = create_server(impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics)
+    server, port = create_server(
+        impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics,
+        credentials=credentials,
+    )
     server.start()
+    if credentials is not None:
+        log.info("gRPC port is TLS-secured (--ssl-config-file)")
     if args.rest_port:
         try:
             bound = start_rest_in_thread(impl, cfg.host, args.rest_port, metrics)

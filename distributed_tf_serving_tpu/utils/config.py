@@ -80,6 +80,12 @@ class ClientConfig:
     # Route by version label instead of latest ("" = unset; upstream
     # ModelSpec.version_label routing, e.g. "stable"/"canary").
     version_label: str = ""
+    # TLS toward an --ssl-config-file server ("" = plaintext). PATHS here
+    # (unlike the server's inline-PEM textproto): client configs name the
+    # deployed cert files. key+cert both set => mTLS identity.
+    tls_root_certs_file: str = ""
+    tls_client_key_file: str = ""
+    tls_client_cert_file: str = ""
 
 
 def _model_config_cls():
