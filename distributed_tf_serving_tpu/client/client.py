@@ -343,9 +343,18 @@ def client_from_config(cfg) -> ShardedPredictClient:
 
 def _credentials_from_config(cfg):
     """grpc.ssl_channel_credentials from the ClientConfig tls_* file paths
-    (None when unset — plaintext, the reference default)."""
-    if not (cfg.tls_root_certs_file or cfg.tls_client_cert_file):
+    (None when ALL unset — plaintext, the reference default). Any tls_*
+    key set means the operator intended TLS: a partial identity pair is a
+    config error, never a silent plaintext downgrade."""
+    if not (cfg.tls_root_certs_file or cfg.tls_client_cert_file
+            or cfg.tls_client_key_file):
         return None
+    if bool(cfg.tls_client_key_file) != bool(cfg.tls_client_cert_file):
+        raise ValueError(
+            "tls_client_key_file and tls_client_cert_file must be set "
+            "together (the mTLS identity pair); got key="
+            f"{cfg.tls_client_key_file!r} cert={cfg.tls_client_cert_file!r}"
+        )
 
     def read(path):
         return open(path, "rb").read() if path else None

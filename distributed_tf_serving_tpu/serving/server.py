@@ -380,6 +380,8 @@ def build_stack(
                 model_name=cfg.model_name,
                 model_kind=cfg.model_kind,
                 desired_labels=cfg.version_labels,
+                poll_interval_s=cfg.file_system_poll_wait_seconds,
+                max_load_attempts=cfg.max_num_load_retries,
             ),
             # warmup_via_queue: compilation rides the batching thread, so a
             # hot-load never races the jit caches with live traffic.
@@ -491,6 +493,14 @@ def serve(argv=None) -> None:
         "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
         "batch_timeout_micros -> max_wait_us, etc. (utils/config.py "
         "apply_batching_parameters); applied over [server] TOML values",
+    )
+    parser.add_argument(
+        "--file-system-poll-wait-seconds", dest="file_system_poll_wait_seconds",
+        type=float, help="version-watcher poll interval (upstream flag name)",
+    )
+    parser.add_argument(
+        "--max-num-load-retries", dest="max_num_load_retries", type=int,
+        help="bounded retries for a failing version load (upstream flag name)",
     )
     parser.add_argument(
         "--ssl-config-file", dest="ssl_config_file",

@@ -53,6 +53,11 @@ class ServerConfig:
     # TFRecords usable directly as warmup files. "" = disabled.
     request_log_file: str = ""
     request_log_sampling: float = 0.01
+    # Version-watcher knobs (--model-base-path lifecycle), named for their
+    # tensorflow_model_server flags: --file_system_poll_wait_seconds and
+    # --max_num_load_retries.
+    file_system_poll_wait_seconds: float = 5.0
+    max_num_load_retries: int = 3
 
 
 @dataclasses.dataclass(frozen=True)

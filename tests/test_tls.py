@@ -180,6 +180,22 @@ def test_mtls_requires_client_certificate(pki, stack, tmp_path):
         server.stop(0)
 
 
+def test_client_config_partial_tls_is_an_error(pki):
+    """A half-set mTLS identity pair must be a config error, and a lone
+    key must not silently downgrade to plaintext."""
+    import dataclasses as dc
+
+    from distributed_tf_serving_tpu.client import client_from_config
+    from distributed_tf_serving_tpu.utils.config import ClientConfig
+
+    half = dc.replace(
+        ClientConfig(), hosts=("h:1",),
+        tls_client_key_file=str(pki / "client.key"),
+    )
+    with pytest.raises(ValueError, match="must be set together"):
+        client_from_config(half)
+
+
 def test_ssl_config_validation(pki, tmp_path):
     bad = tmp_path / "bad.pbtxt"
     bad.write_text('server_key: "k"\n')  # missing cert
