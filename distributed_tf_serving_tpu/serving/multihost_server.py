@@ -46,6 +46,7 @@ def build_multihost_stack(
     model_parallel: int = 1,
     max_wait_us: int = 2000,
     poll_interval_s: float = 5.0,
+    max_load_attempts: int = 3,
 ):
     """Initialize the distributed runtime and build the serving stack.
 
@@ -154,7 +155,10 @@ def build_multihost_stack(
         base_path,
         registry,
         VersionWatcherConfig(
-            poll_interval_s=poll_interval_s, model_name=model_name, model_kind=model_kind
+            poll_interval_s=poll_interval_s,
+            model_name=model_name,
+            model_kind=model_kind,
+            max_load_attempts=max_load_attempts,
         ),
         loader=runner.watcher_loader(
             lambda version, path: filter_signatures(load_servable(path, host=True), version)
@@ -196,6 +200,14 @@ def serve(argv=None) -> None:
     parser.add_argument("--ssl-config-file", dest="ssl_config_file",
                         help="secure the leader's gRPC port (SSLConfig "
                         "textproto, same format as the single-host CLI)")
+    parser.add_argument("--file-system-poll-wait-seconds",
+                        dest="file_system_poll_wait_seconds", type=float,
+                        default=5.0,
+                        help="version-watcher poll interval (upstream flag name)")
+    parser.add_argument("--max-num-load-retries", dest="max_num_load_retries",
+                        type=int, default=2,
+                        help="retries AFTER the first load attempt "
+                        "(upstream flag semantics)")
     args = parser.parse_args(argv)
     # Fail-fast like the single-host CLI: validate before slice init.
     credentials = None
@@ -214,6 +226,8 @@ def serve(argv=None) -> None:
         model_name=args.model_name,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         model_parallel=args.model_parallel,
+        poll_interval_s=args.file_system_poll_wait_seconds,
+        max_load_attempts=args.max_num_load_retries + 1,  # upstream: retries
     )
     if args.process_id != 0:
         log.info("follower %d/%d up (mesh %s); serving until leader shutdown",

@@ -381,7 +381,9 @@ def build_stack(
                 model_kind=cfg.model_kind,
                 desired_labels=cfg.version_labels,
                 poll_interval_s=cfg.file_system_poll_wait_seconds,
-                max_load_attempts=cfg.max_num_load_retries,
+                # Upstream semantics: N RETRIES after the first attempt,
+                # so total attempts = N + 1.
+                max_load_attempts=cfg.max_num_load_retries + 1,
             ),
             # warmup_via_queue: compilation rides the batching thread, so a
             # hot-load never races the jit caches with live traffic.

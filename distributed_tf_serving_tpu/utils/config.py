@@ -55,9 +55,10 @@ class ServerConfig:
     request_log_sampling: float = 0.01
     # Version-watcher knobs (--model-base-path lifecycle), named for their
     # tensorflow_model_server flags: --file_system_poll_wait_seconds and
-    # --max_num_load_retries.
+    # --max_num_load_retries (upstream semantics: retries AFTER the first
+    # attempt; 2 retries = the watcher's historical 3 total attempts).
     file_system_poll_wait_seconds: float = 5.0
-    max_num_load_retries: int = 3
+    max_num_load_retries: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

@@ -132,3 +132,28 @@ def test_dlrm_4k_on_mesh():
     got = np.asarray(ex(sv, prepared)["prediction_node"])
     assert got.shape == (4096,)
     np.testing.assert_allclose(got, _golden(sv, arrays), rtol=2e-5)
+
+
+def test_shipped_config_presets_load():
+    """The configs/ presets must stay loadable as the knobs evolve (they
+    are the documented operating points)."""
+    import pathlib
+
+    from distributed_tf_serving_tpu.utils.config import (
+        ServerConfig,
+        apply_batching_parameters,
+        load_config,
+    )
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "configs"
+    for name in ("throughput.toml", "latency.toml"):
+        cfg = load_config(root / name)
+        assert cfg["server"].buckets[-1] >= cfg["server"].buckets[0]
+        assert cfg["model"].num_fields == 43
+        assert cfg["client"].candidate_num == 1000
+    bp = apply_batching_parameters(
+        ServerConfig(), root / "batching.pbtxt.example"
+    )
+    assert bp.buckets == (1024, 2048, 4096, 8192, 16384)
+    assert bp.max_wait_us == 2000
+    assert bp.completion_workers == 12
