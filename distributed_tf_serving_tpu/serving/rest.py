@@ -106,6 +106,10 @@ class RestGateway:
             web.get("/v1/models/{model}/versions/{version}", self.status),
             web.get("/v1/models/{model}/labels/{label}", self.status),
             web.get("/v1/models/{model}/metadata", self.metadata),
+            web.get(
+                "/v1/models/{model}/versions/{version}/metadata", self.metadata
+            ),
+            web.get("/v1/models/{model}/labels/{label}/metadata", self.metadata),
             web.get("/monitoring/prometheus/metrics", self.prometheus),
         ])
 
@@ -462,9 +466,24 @@ class RestGateway:
     async def metadata(self, request: web.Request) -> web.Response:
         model = request.match_info["model"]
         try:
-            servable, _ = self._resolve_specs(model, None, "")
+            # Servable resolution ONLY — no signature lookup: this route
+            # enumerates ALL signatures, and a model serving purely by
+            # explicit signature names (no serving_default — a supported
+            # import shape, interop/savedmodel.py) must still answer.
+            from .service import _wrap_lookup
+
+            servable = _wrap_lookup(
+                lambda: self.impl.registry.resolve(
+                    model,
+                    self._parse_version(request.match_info.get("version")),
+                    request.match_info.get("label"),
+                )
+            )
         except ServiceError as e:
             return _json_error(e.code, str(e))
+        except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
+            log.exception("internal error serving REST metadata")
+            return _json_error("INTERNAL", f"internal error: {e}")
 
         from ..proto import tf_framework_pb2 as fw
 

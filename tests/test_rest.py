@@ -186,6 +186,55 @@ def test_label_routes(stack):
     assert unknown[0] == 404 and "error" in unknown[1]
 
 
+def test_metadata_version_and_label_variants(stack):
+    impl, _sv = stack
+    impl.registry.set_label("DCN", "meta_label", 1)
+
+    async def handler(session):
+        out = {}
+        for path in ("/v1/models/DCN/versions/1/metadata",
+                     "/v1/models/DCN/labels/meta_label/metadata"):
+            async with session.get(path) as r:
+                out[path] = (r.status, await r.json())
+        async with session.get("/v1/models/DCN/labels/nope/metadata") as r:
+            out["unknown"] = (r.status, await r.json())
+        return out
+
+    res = _run(impl, handler)
+    for path in ("/v1/models/DCN/versions/1/metadata",
+                 "/v1/models/DCN/labels/meta_label/metadata"):
+        code, body = res[path]
+        assert code == 200
+        assert body["model_spec"]["version"] == "1"
+        assert "serving_default" in body["metadata"]["signature_def"]["signature_def"]
+    assert res["unknown"][0] == 404
+
+
+def test_metadata_without_serving_default(stack):
+    """A model serving purely by explicit signature names (a supported
+    import shape) must still answer /metadata with its signature set."""
+    import dataclasses as dc
+
+    from distributed_tf_serving_tpu.models import Servable, build_model
+
+    impl, sv = stack
+    only_custom = Servable(
+        name="CUSTOM_SIG", version=1, model=sv.model, params=sv.params,
+        signatures={"score_items": sv.signatures["serving_default"]},
+    )
+    impl.registry.load(only_custom)
+    try:
+        async def handler(session):
+            async with session.get("/v1/models/CUSTOM_SIG/metadata") as r:
+                return r.status, await r.json()
+
+        code, body = _run(impl, handler)
+        assert code == 200
+        assert list(body["metadata"]["signature_def"]["signature_def"]) == ["score_items"]
+    finally:
+        impl.registry.unload("CUSTOM_SIG")
+
+
 def test_status_and_metadata_routes(stack):
     impl, _sv = stack
 
