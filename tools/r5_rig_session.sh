@@ -62,6 +62,27 @@ SOAK_SECONDS=300 python tools/soak.py \
   > "artifacts/soak_r5_${TS}.json" 2>"artifacts/soak_r5_${TS}.log" \
   || echo "[session] soak failed; see artifacts/soak_r5_${TS}.log"
 
+if [ "${SKIP_ZOO:-0}" != "1" ]; then
+  echo "[session] bonus: zoo bench refresh (SKIP_ZOO=1 to skip)"
+  if python tools/zoo_bench.py --out "artifacts/zoo_r5_${TS}.json" \
+      > "artifacts/zoo_r5_${TS}.log" 2>&1; then
+    # Only a TPU-device run may replace the committed on-chip artifact —
+    # a CPU-fallback run exits 0 too and must never masquerade as chip
+    # numbers (same gating posture as step 1's live-measurement check).
+    if python -c "import json,sys; d=json.load(open('artifacts/zoo_r5_${TS}.json')); sys.exit(0 if 'tpu' in str(d.get('device','')).lower() else 1)"; then
+      cp "artifacts/zoo_r5_${TS}.json" ZOO_BENCH_TPU.json
+      git add ZOO_BENCH_TPU.json "artifacts/zoo_r5_${TS}.json"
+      git commit -q -m "Refresh on-chip zoo bench (round-5 rig session)
+
+No-Verification-Needed: measurement artifact only" || true
+    else
+      echo "[session] zoo run was not on a TPU device; committed artifact kept"
+    fi
+  else
+    echo "[session] zoo bench failed; see artifacts/zoo_r5_${TS}.log"
+  fi
+fi
+
 python - <<EOF
 import glob, json
 for p in sorted(glob.glob('artifacts/exp_r5_${TS}_*.json')):
