@@ -21,9 +21,15 @@ Traffic mix on ONE event loop (the deployed topology):
   never perturb scores or error counts).
 
 Reports one JSON line: per-surface request/error counts, error taxonomy,
-RSS start/end (leak watch), batcher + input-cache counters, wall/QPS.
+RSS start/end (leak watch), batcher + input-cache counters, wall/QPS, and
+(when sampling is enabled) a request_log block with written/dropped/
+parsed-back counts.
 Env knobs: SOAK_SECONDS (default 300), SOAK_GRPC_WORKERS (8),
-SOAK_REST_WORKERS (4), SOAK_CANDIDATES (1000).
+SOAK_REST_WORKERS (4), SOAK_CANDIDATES (1000),
+SOAK_REQUEST_LOG_SAMPLING (default 0 = logging off; >0 stresses the
+bounded-queue request logger under the mixed load — note it adds a
+SerializeToString per sampled request, so A/Bs against logging-off soaks
+are not apples-to-apples).
 """
 
 import asyncio
@@ -126,6 +132,21 @@ def main() -> None:
          "feat_wts": wide["feat_wts"][i].tolist()}
         for i in range(8)
     ]
+
+    # Sampled request logging under load (SOAK_REQUEST_LOG_SAMPLING > 0,
+    # OPT-IN so default soaks stay comparable to prior rounds' baselines):
+    # the bounded-queue writer must keep up or shed cleanly while every
+    # surface hammers the impl.
+    request_logger = None
+    log_sampling = float(os.environ.get("SOAK_REQUEST_LOG_SAMPLING", "0"))
+    if log_sampling > 0:
+        import tempfile
+
+        from distributed_tf_serving_tpu.serving.request_log import RequestLogger
+
+        log_path = os.path.join(tempfile.gettempdir(), f"soak_requests_{os.getpid()}.log")
+        request_logger = RequestLogger(log_path, sampling_rate=log_sampling)
+        impl.request_logger = request_logger
 
     counts = {
         "grpc_ok": 0, "grpc_err": 0,
@@ -231,9 +252,39 @@ def main() -> None:
             await server.stop(0)
 
     t0 = time.perf_counter()
-    asyncio.run(drive())
+    try:
+        asyncio.run(drive())
+    finally:
+        # Always drain/close (a crashed drive must not leak the writer or
+        # leave an append-mode file for a pid-recycled later run).
+        if request_logger is not None:
+            request_logger.close()
     wall = time.perf_counter() - t0
     total = counts["grpc_ok"] + counts["rest_ok"]
+    # Leak-watch RSS BEFORE the parse-back pass below reads the whole log
+    # file into memory (malloc arenas rarely shrink; sampling after would
+    # report a phantom leak).
+    rss_end = rss_gb()
+    request_log_block = None
+    if request_logger is not None:
+        from distributed_tf_serving_tpu.serving.warmup import read_tfrecords
+
+        try:
+            parsed = sum(1 for _ in read_tfrecords(log_path))
+            parse_err = None
+        except Exception as e:  # noqa: BLE001 — report, don't crash the line
+            parsed, parse_err = -1, f"{type(e).__name__}: {e}"[:200]
+        request_log_block = {
+            "sampling": log_sampling,
+            "written": request_logger.written,
+            "dropped": request_logger.dropped,
+            "parsed_back": parsed,
+            "parse_error": parse_err,
+        }
+        if parse_err is None:
+            os.remove(log_path)
+        else:
+            request_log_block["kept_file"] = log_path  # evidence for triage
     line = {
         "soak_seconds": round(wall, 1),
         "platform": str(jax.devices()[0]),
@@ -242,7 +293,8 @@ def main() -> None:
         **{k: v for k, v in counts.items() if k != "errors"},
         "error_taxonomy": counts["errors"],
         "rss_gb_start": rss_start,
-        "rss_gb_end": rss_gb(),
+        "rss_gb_end": rss_end,
+        "request_log": request_log_block,
         "batcher": {
             "batches": batcher.stats.batches,
             "fused_batches": batcher.stats.fused_batches,
