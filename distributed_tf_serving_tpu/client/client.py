@@ -372,11 +372,21 @@ def predict_sync(
     model_name: str = "DCN",
     signature_name: str = "serving_default",
     timeout_s: float = 10.0,
+    version: int | None = None,
+    version_label: str | None = None,
+    channel_credentials: "grpc.ChannelCredentials | None" = None,
 ) -> dict[str, np.ndarray]:
     """Single-backend blocking Predict (the DCNClientSimple smoke role,
     DCNClientSimple.java:25-62) returning all outputs."""
-    with grpc.insecure_channel(host) as ch:
+    with (
+        grpc.secure_channel(host, channel_credentials)
+        if channel_credentials is not None
+        else grpc.insecure_channel(host)
+    ) as ch:
         stub = PredictionServiceStub(ch)
-        req = build_predict_request(arrays, model_name, signature_name)
+        req = build_predict_request(
+            arrays, model_name, signature_name,
+            version=version, version_label=version_label,
+        )
         resp = stub.Predict(req, timeout=timeout_s)
     return {k: codec.to_ndarray(v) for k, v in resp.outputs.items()}

@@ -9,9 +9,25 @@ from .batcher import (
     bucket_for,
 )
 from .example_codec import ExampleDecodeError, decode_input, make_example
-from .server import GrpcPredictionService, create_server, load_demo_servable, serve
+from .request_log import RequestLogger
+from .server import (
+    GrpcModelService,
+    GrpcPredictionService,
+    create_server,
+    create_server_async,
+    load_demo_servable,
+    load_ssl_credentials,
+    serve,
+)
 from .service import PredictionServiceImpl, ServiceError
 from .version_watcher import VersionWatcher, VersionWatcherConfig, scan_versions
+from .warmup import (
+    WarmupError,
+    read_tfrecords,
+    replay_warmup_file,
+    warmup_file_for,
+    write_tfrecords,
+)
 
 __all__ = [
     "VersionWatcher",
@@ -29,7 +45,16 @@ __all__ = [
     "PredictionServiceImpl",
     "ServiceError",
     "GrpcPredictionService",
+    "GrpcModelService",
     "create_server",
+    "create_server_async",
     "load_demo_servable",
+    "load_ssl_credentials",
     "serve",
+    "RequestLogger",
+    "WarmupError",
+    "read_tfrecords",
+    "replay_warmup_file",
+    "warmup_file_for",
+    "write_tfrecords",
 ]
