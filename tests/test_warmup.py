@@ -184,6 +184,43 @@ def test_replay_failure_names_record(tmp_path):
         batcher.stop()
 
 
+def test_watcher_corrupt_warmup_fails_load_bounded(tmp_path):
+    """A corrupt warmup file fails the version load (upstream posture) —
+    the version never flips into the registry, retries are bounded, and
+    the failure is the named WarmupError, not a silent skip."""
+    from distributed_tf_serving_tpu.models import ServableRegistry
+    from distributed_tf_serving_tpu.serving import VersionWatcher, VersionWatcherConfig
+    from distributed_tf_serving_tpu.serving.warmup import WARMUP_DIRNAME, WARMUP_FILENAME
+    from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+    sv = _servable(version=1)
+    save_servable(tmp_path / "1", sv, kind="dcn_v2")
+    extra = tmp_path / "1" / WARMUP_DIRNAME
+    extra.mkdir()
+    (extra / WARMUP_FILENAME).write_bytes(b"not a tfrecord at all")
+
+    calls = []
+
+    def failing_replay(servable, wf):
+        calls.append(wf)
+        from distributed_tf_serving_tpu.serving.warmup import replay_warmup_file
+
+        return replay_warmup_file(wf, servable, None)  # raises before batcher use
+
+    registry = ServableRegistry()
+    w = VersionWatcher(
+        tmp_path, registry,
+        VersionWatcherConfig(
+            poll_interval_s=3600, model_name="DCN", max_load_attempts=2
+        ),
+        warmup_replay=failing_replay,
+    )
+    for _ in range(4):
+        w.poll_once()
+    assert registry.models() == {}  # never flipped
+    assert len(calls) == 2  # bounded by max_load_attempts, then blacklisted
+
+
 def test_watcher_replays_warmup_file(tmp_path):
     from distributed_tf_serving_tpu.models import ServableRegistry
     from distributed_tf_serving_tpu.serving import VersionWatcher, VersionWatcherConfig
