@@ -330,6 +330,97 @@ def _replay_warmup(warmup_file, servable, batcher) -> int:
     return replay_warmup_file(warmup_file, servable, batcher)
 
 
+class _WatcherGroup:
+    """One .stop() over the per-model watchers of a --model-config-file
+    deployment (build_stack returns it in the watcher slot)."""
+
+    def __init__(self, watchers):
+        self.watchers = list(watchers)
+
+    def stop(self) -> None:
+        for w in self.watchers:  # signal everyone first: drain in parallel
+            w.request_stop()
+        for w in self.watchers:
+            w.stop()
+
+
+def _parse_model_server_config(path):
+    """Parse+validate a --model_config_file BEFORE any threads start, so a
+    typo'd config fails with nothing to tear down. Returns the validated
+    model_config_list entries."""
+    import pathlib
+
+    from google.protobuf import text_format
+
+    from ..proto import serving_apis_pb2 as apis
+
+    msc = text_format.Parse(
+        pathlib.Path(path).read_text(), apis.ModelServerConfig()
+    )
+    if msc.WhichOneof("config") != "model_config_list" or not msc.model_config_list.config:
+        raise ValueError(
+            f"{path}: a model_config_list with at least one model is required"
+        )
+    seen = set()
+    for mc in msc.model_config_list.config:
+        if not mc.name or not mc.base_path:
+            raise ValueError(
+                f"{path}: every model config needs name and base_path "
+                f"(got name={mc.name!r} base_path={mc.base_path!r})"
+            )
+        if mc.name in seen:
+            raise ValueError(f"{path}: duplicate model {mc.name!r}")
+        seen.add(mc.name)
+    return list(msc.model_config_list.config)
+
+
+def _start_model_config_watchers(cfg, model_configs, registry, batcher, model_config, mesh):
+    """tensorflow_model_server's --model_config_file: one version watcher
+    per model_config_list entry — multi-model serving over ONE registry/
+    batcher/impl (the registry keys servables by name, the batcher jit
+    caches per servable, so nothing else changes shape).
+
+    Upstream field mapping: `name` and `base_path` as-is; `model_platform`
+    carries the zoo family here (upstream's "tensorflow" means "use the
+    server's default family", since every model is a TF graph there);
+    `version_labels` seed per-model label maps. Per-model ARCHITECTURE
+    comes from each version's own artifact (native checkpoints carry a
+    manifest; SavedModel dirs infer or use the global [model] section), so
+    heterogeneous models need self-describing artifacts.
+    """
+    from .version_watcher import VersionWatcher, VersionWatcherConfig
+
+    watchers = []
+    for mc in model_configs:
+        kind = mc.model_platform or cfg.model_kind
+        if kind == "tensorflow":  # upstream's only platform string
+            kind = cfg.model_kind
+        watchers.append(
+            VersionWatcher(
+                mc.base_path,
+                registry,
+                VersionWatcherConfig(
+                    model_name=mc.name,
+                    model_kind=kind,
+                    desired_labels=tuple(
+                        sorted((l, int(v)) for l, v in mc.version_labels.items())
+                    ),
+                    poll_interval_s=cfg.file_system_poll_wait_seconds,
+                    max_load_attempts=cfg.max_num_load_retries + 1,
+                ),
+                warmup=batcher.warmup_via_queue if cfg.warmup else None,
+                warmup_replay=(
+                    (lambda sv, wf: _replay_warmup(wf, sv, batcher))
+                    if cfg.warmup else None
+                ),
+                model_config=model_config,
+                mesh=mesh,
+                tensor_parallel=cfg.tensor_parallel,
+            ).start()
+        )
+    return _WatcherGroup(watchers)
+
+
 def build_stack(
     cfg: ServerConfig,
     checkpoint: str | None = None,
@@ -341,7 +432,26 @@ def build_stack(
     model_config (the TOML [model] section) pins the architecture for the
     demo and SavedModel-import paths; checkpoints carry their own.
     model_base_path switches to TF-Serving's versioned-directory lifecycle
-    (serving/version_watcher.py) instead of a fixed artifact."""
+    (serving/version_watcher.py) instead of a fixed artifact;
+    cfg.model_config_file switches to MULTI-model serving (one watcher per
+    model_config_list entry)."""
+    # Validate the multi-model config (and its exclusivity) BEFORE any
+    # threads exist — a typo'd file must leave nothing to tear down.
+    model_configs = None
+    if cfg.model_config_file:
+        if model_base_path or checkpoint or savedmodel:
+            raise ValueError(
+                "--model-config-file is mutually exclusive with "
+                "--model-base-path/--checkpoint/--savedmodel (the config "
+                "file owns the model list)"
+            )
+        if cfg.version_labels:
+            raise ValueError(
+                "--version-label / [server] version_labels have no meaning "
+                "with --model-config-file; put per-model version_labels "
+                "maps in the config file's model entries instead"
+            )
+        model_configs = _parse_model_server_config(cfg.model_config_file)
     registry = ServableRegistry()
     run_fn = None
     mesh = None
@@ -365,6 +475,25 @@ def build_stack(
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
 
+    if model_configs is not None:
+        watchers = _start_model_config_watchers(
+            cfg, model_configs, registry, batcher, model_config, mesh
+        )
+        served = registry.models()
+        if served:
+            log.info("serving %d model(s) from %s: %s",
+                     len(served), cfg.model_config_file,
+                     {k: v for k, v in sorted(served.items())})
+        else:
+            log.warning("no ready versions for any configured model yet; watching")
+        # Representative servable for the startup banner: the configured
+        # default name when it is served, else any ready model — 'awaiting
+        # versions' must mean NOTHING is ready, not 'DCN isn't configured'.
+        ready = cfg.model_name if cfg.model_name in served else (
+            sorted(served)[0] if served else None
+        )
+        servable = registry.resolve(ready) if ready else None
+        return registry, batcher, impl, servable, mesh, watchers
     if model_base_path:
         if checkpoint or savedmodel:
             raise ValueError(
@@ -495,6 +624,12 @@ def serve(argv=None) -> None:
         "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
         "batch_timeout_micros -> max_wait_us, etc. (utils/config.py "
         "apply_batching_parameters); applied over [server] TOML values",
+    )
+    parser.add_argument(
+        "--model-config-file", dest="model_config_file",
+        help="multi-model serving: a tensorflow_model_server-format "
+        "ModelServerConfig textproto (model_config_list of name/base_path/"
+        "model_platform/version_labels; one version watcher per model)",
     )
     parser.add_argument(
         "--file-system-poll-wait-seconds", dest="file_system_poll_wait_seconds",

@@ -162,6 +162,11 @@ class VersionWatcher:
         self._thread.start()
         return self
 
+    def request_stop(self) -> None:
+        """Signal without joining (multi-watcher shutdown signals ALL
+        first so total drain time is the max, not the sum)."""
+        self._stop.set()
+
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=10)

@@ -59,6 +59,11 @@ class ServerConfig:
     # attempt; 2 retries = the watcher's historical 3 total attempts).
     file_system_poll_wait_seconds: float = 5.0
     max_num_load_retries: int = 2
+    # Multi-model serving (upstream --model_config_file): a text-format
+    # ModelServerConfig whose model_config_list entries each get their own
+    # version watcher (name, base_path, optional model_platform = zoo
+    # family, version_labels). "" = single-model modes.
+    model_config_file: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

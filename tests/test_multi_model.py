@@ -1,0 +1,138 @@
+"""Multi-model serving (--model-config-file): several models with their
+own base paths, families, and labels behind ONE registry/batcher/impl —
+the tensorflow_model_server model_config_list deployment shape."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import grpc
+
+from distributed_tf_serving_tpu.client import predict_sync
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.serving.server import build_stack, create_server
+from distributed_tf_serving_tpu.train.checkpoint import save_servable
+from distributed_tf_serving_tpu.utils.config import ServerConfig
+
+
+def _write_model(base, name, kind, num_fields, version=1, seed=0):
+    cfg = ModelConfig(
+        name=name, num_fields=num_fields, vocab_size=1 << 10, embed_dim=4,
+        mlp_dims=(8,), num_cross_layers=1, compute_dtype="float32",
+    )
+    model = build_model(kind, cfg)
+    sv = Servable(
+        name=name, version=version, model=model,
+        params=model.init(jax.random.PRNGKey(seed)),
+        signatures=ctr_signatures(num_fields),
+    )
+    save_servable(base / str(version), sv, kind=kind)
+    return sv
+
+
+def test_model_config_file_serves_multiple_models(tmp_path):
+    """Two models, different families AND field counts (architecture from
+    each version's own manifest), labels seeded per model from the file;
+    both answer by name over a real socket."""
+    _write_model(tmp_path / "ctr", "CTR", "dcn_v2", num_fields=6)
+    _write_model(tmp_path / "ranker", "RANKER", "dcn", num_fields=4, seed=7)
+    cfg_file = tmp_path / "models.pbtxt"
+    cfg_file.write_text(
+        'model_config_list {\n'
+        f'  config {{ name: "CTR" base_path: "{tmp_path / "ctr"}" '
+        'model_platform: "dcn_v2" version_labels { key: "stable" value: 1 } }\n'
+        f'  config {{ name: "RANKER" base_path: "{tmp_path / "ranker"}" '
+        'model_platform: "dcn" }\n'
+        '}\n'
+    )
+    cfg = dataclasses.replace(
+        ServerConfig(),
+        model_config_file=str(cfg_file),
+        buckets=(32,),
+        warmup=False,
+    )
+    registry, batcher, impl, _sv, _mesh, watchers = build_stack(cfg)
+    server, port = create_server(impl, "127.0.0.1:0")
+    server.start()
+    try:
+        assert registry.models() == {"CTR": [1], "RANKER": [1]}
+        assert registry.labels("CTR") == {"stable": 1}
+
+        out_ctr = predict_sync(
+            f"127.0.0.1:{port}",
+            {"feat_ids": np.ones((2, 6), np.int64),
+             "feat_wts": np.ones((2, 6), np.float32)},
+            model_name="CTR", version_label="stable",
+        )
+        out_rank = predict_sync(
+            f"127.0.0.1:{port}",
+            {"feat_ids": np.ones((2, 4), np.int64),
+             "feat_wts": np.ones((2, 4), np.float32)},
+            model_name="RANKER",
+        )
+        assert out_ctr["prediction_node"].shape == (2,)
+        assert out_rank["prediction_node"].shape == (2,)
+        # Wrong-arity cross-talk is rejected per model signature.
+        from distributed_tf_serving_tpu.proto import PredictionServiceStub
+        from distributed_tf_serving_tpu.client import build_predict_request
+
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            with pytest.raises(grpc.RpcError) as e:
+                PredictionServiceStub(ch).Predict(
+                    build_predict_request(
+                        {"feat_ids": np.ones((2, 6), np.int64),
+                         "feat_wts": np.ones((2, 6), np.float32)},
+                        "RANKER",
+                    ),
+                    timeout=30,
+                )
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop(0)
+        watchers.stop()
+        batcher.stop()
+
+
+def test_model_config_file_validation(tmp_path):
+    bad = tmp_path / "bad.pbtxt"
+    bad.write_text("model_config_list { config { name: \"X\" } }\n")
+    cfg = dataclasses.replace(
+        ServerConfig(), model_config_file=str(bad), buckets=(32,), warmup=False
+    )
+    with pytest.raises(ValueError, match="name and base_path"):
+        build_stack(cfg)
+
+    dup = tmp_path / "dup.pbtxt"
+    dup.write_text(
+        'model_config_list {\n'
+        f'  config {{ name: "A" base_path: "{tmp_path}" }}\n'
+        f'  config {{ name: "A" base_path: "{tmp_path}" }}\n'
+        '}\n'
+    )
+    cfg = dataclasses.replace(
+        ServerConfig(), model_config_file=str(dup), buckets=(32,), warmup=False
+    )
+    with pytest.raises(ValueError, match="duplicate model"):
+        build_stack(cfg)
+
+    cfg = dataclasses.replace(
+        ServerConfig(), model_config_file=str(dup), buckets=(32,), warmup=False
+    )
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        build_stack(cfg, checkpoint="/nope")
+
+    # Global labels are per-model config-file business in this mode —
+    # rejected loudly, never silently dropped.
+    cfg = dataclasses.replace(
+        ServerConfig(), model_config_file=str(dup), buckets=(32,),
+        warmup=False, version_labels=(("stable", 1),),
+    )
+    with pytest.raises(ValueError, match="version_labels"):
+        build_stack(cfg)
