@@ -330,17 +330,128 @@ def _replay_warmup(warmup_file, servable, batcher) -> int:
     return replay_warmup_file(warmup_file, servable, batcher)
 
 
-class _WatcherGroup:
-    """One .stop() over the per-model watchers of a --model-config-file
-    deployment (build_stack returns it in the watcher slot)."""
+class ModelLifecycle:
+    """The model LIST as a runtime-reconcilable object (--model-config-file
+    deployments): one version watcher per served model, plus `apply()` —
+    the full HandleReloadConfigRequest semantics, where the supplied
+    model_config_list REPLACES the model list (upstream behavior):
 
-    def __init__(self, watchers):
-        self.watchers = list(watchers)
+    - new entries start a watcher (whose synchronous first poll loads any
+      ready version — the RPC returns with new models REGISTERED, like
+      upstream's reload, which equally blocks on load);
+    - entries absent from the new config stop their watcher and unload
+      the model; an entry whose base_path or model_platform CHANGED is a
+      remove+add (the watcher restarts on the new source);
+    - unchanged entries get their version_labels applied DECLARATIVELY
+      now (a label naming an unloaded version is FAILED_PRECONDITION;
+      labels of restarted/new models seed via desired_labels as versions
+      land).
+
+    Reloads serialize on one lock — two concurrent conflicting reloads
+    must not interleave — which also means a reload loading large models
+    holds off shutdown until it completes (document-level trade-off,
+    matching the blocking upstream RPC).
+
+    build_stack returns it in the watcher slot (.stop() tears everything
+    down, signalling all watchers before joining so drain time is the
+    max, not the sum)."""
+
+    def __init__(self, cfg, registry, batcher, model_config, mesh):
+        import threading
+
+        self._cfg = cfg
+        self._registry = registry
+        self._batcher = batcher
+        self._model_config = model_config
+        self._mesh = mesh
+        self._watchers: dict[str, object] = {}
+        self._sources: dict[str, tuple[str, str]] = {}  # name -> (path, platform)
+        self._lock = threading.Lock()  # reloads arrive on RPC threads
+
+    @property
+    def watchers(self):
+        with self._lock:
+            return list(self._watchers.values())
+
+    def _make_watcher(self, mc):
+        from .version_watcher import VersionWatcher, VersionWatcherConfig
+
+        cfg, batcher = self._cfg, self._batcher
+        kind = mc.model_platform or cfg.model_kind
+        if kind == "tensorflow":  # upstream's only platform string
+            kind = cfg.model_kind
+        return VersionWatcher(
+            mc.base_path,
+            self._registry,
+            VersionWatcherConfig(
+                model_name=mc.name,
+                model_kind=kind,
+                desired_labels=tuple(
+                    sorted((l, int(v)) for l, v in mc.version_labels.items())
+                ),
+                poll_interval_s=cfg.file_system_poll_wait_seconds,
+                max_load_attempts=cfg.max_num_load_retries + 1,
+            ),
+            warmup=batcher.warmup_via_queue if cfg.warmup else None,
+            warmup_replay=(
+                (lambda sv, wf: _replay_warmup(wf, sv, batcher))
+                if cfg.warmup else None
+            ),
+            model_config=self._model_config,
+            mesh=self._mesh,
+            tensor_parallel=cfg.tensor_parallel,
+        ).start()
+
+    @staticmethod
+    def _source_of(mc) -> tuple[str, str]:
+        return (mc.base_path, mc.model_platform)
+
+    def apply(self, model_configs) -> None:
+        """Reconcile toward `model_configs` (validated entries). Raises
+        registry label errors (ModelNotFound/VersionNotFound/ValueError)
+        BEFORE mutating anything for the label changes it applies now."""
+        with self._lock:
+            wanted = {mc.name: mc for mc in model_configs}
+            # An entry whose SOURCE changed is not "existing" — its
+            # watcher must restart on the new base_path/platform
+            # (upstream applies base-path moves on this same RPC).
+            unchanged = {
+                name for name in set(self._watchers) & set(wanted)
+                if self._sources.get(name) == self._source_of(wanted[name])
+            }
+            # Declarative labels for UNCHANGED models: validate+apply
+            # atomically first, so a bad label aborts the reload before
+            # any watcher is started or stopped.
+            existing_label_maps = {
+                name: {l: int(v) for l, v in wanted[name].version_labels.items()}
+                for name in unchanged
+            }
+            if existing_label_maps:
+                self._registry.replace_label_maps(existing_label_maps)
+            for name in sorted(set(self._watchers) - unchanged):
+                w = self._watchers.pop(name)
+                self._sources.pop(name, None)
+                w.stop()
+                try:
+                    self._registry.unload(name)
+                except KeyError:
+                    pass  # never had a ready version
+                log.info(
+                    "reload: %s model %r",
+                    "restarting" if name in wanted else "removed", name,
+                )
+            for name in sorted(set(wanted) - unchanged):
+                self._watchers[name] = self._make_watcher(wanted[name])
+                self._sources[name] = self._source_of(wanted[name])
+                log.info("reload: added model %r (base_path=%s)",
+                         name, wanted[name].base_path)
 
     def stop(self) -> None:
-        for w in self.watchers:  # signal everyone first: drain in parallel
+        with self._lock:
+            watchers = list(self._watchers.values())
+        for w in watchers:  # signal everyone first: drain in parallel
             w.request_stop()
-        for w in self.watchers:
+        for w in watchers:
             w.stop()
 
 
@@ -361,17 +472,9 @@ def _parse_model_server_config(path):
         raise ValueError(
             f"{path}: a model_config_list with at least one model is required"
         )
-    seen = set()
-    for mc in msc.model_config_list.config:
-        if not mc.name or not mc.base_path:
-            raise ValueError(
-                f"{path}: every model config needs name and base_path "
-                f"(got name={mc.name!r} base_path={mc.base_path!r})"
-            )
-        if mc.name in seen:
-            raise ValueError(f"{path}: duplicate model {mc.name!r}")
-        seen.add(mc.name)
-    return list(msc.model_config_list.config)
+    from ..utils.config import validate_model_config_entries
+
+    return validate_model_config_entries(msc.model_config_list.config, str(path))
 
 
 def _start_model_config_watchers(cfg, model_configs, registry, batcher, model_config, mesh):
@@ -388,37 +491,9 @@ def _start_model_config_watchers(cfg, model_configs, registry, batcher, model_co
     manifest; SavedModel dirs infer or use the global [model] section), so
     heterogeneous models need self-describing artifacts.
     """
-    from .version_watcher import VersionWatcher, VersionWatcherConfig
-
-    watchers = []
-    for mc in model_configs:
-        kind = mc.model_platform or cfg.model_kind
-        if kind == "tensorflow":  # upstream's only platform string
-            kind = cfg.model_kind
-        watchers.append(
-            VersionWatcher(
-                mc.base_path,
-                registry,
-                VersionWatcherConfig(
-                    model_name=mc.name,
-                    model_kind=kind,
-                    desired_labels=tuple(
-                        sorted((l, int(v)) for l, v in mc.version_labels.items())
-                    ),
-                    poll_interval_s=cfg.file_system_poll_wait_seconds,
-                    max_load_attempts=cfg.max_num_load_retries + 1,
-                ),
-                warmup=batcher.warmup_via_queue if cfg.warmup else None,
-                warmup_replay=(
-                    (lambda sv, wf: _replay_warmup(wf, sv, batcher))
-                    if cfg.warmup else None
-                ),
-                model_config=model_config,
-                mesh=mesh,
-                tensor_parallel=cfg.tensor_parallel,
-            ).start()
-        )
-    return _WatcherGroup(watchers)
+    lifecycle = ModelLifecycle(cfg, registry, batcher, model_config, mesh)
+    lifecycle.apply(model_configs)
+    return lifecycle
 
 
 def build_stack(
@@ -479,6 +554,9 @@ def build_stack(
         watchers = _start_model_config_watchers(
             cfg, model_configs, registry, batcher, model_config, mesh
         )
+        # Runtime model-list reloads (HandleReloadConfigRequest) reconcile
+        # through the same lifecycle object.
+        impl.model_lifecycle = watchers
         served = registry.models()
         if served:
             log.info("serving %d model(s) from %s: %s",

@@ -66,6 +66,11 @@ class PredictionServiceImpl:
         # assign a RequestLogger to enable — both transports and all four
         # RPC families flow through these entry points.
         self.request_logger = None
+        # Optional runtime model-list reconciler (server.ModelLifecycle,
+        # set by --model-config-file deployments): when present,
+        # HandleReloadConfigRequest carries upstream's FULL semantics —
+        # the supplied model list replaces the served set.
+        self.model_lifecycle = None
 
     def _log_request(self, kind: str, request) -> None:
         if self.request_logger is not None:
@@ -530,17 +535,23 @@ class PredictionServiceImpl:
         self, request: apis.ReloadConfigRequest
     ) -> apis.ReloadConfigResponse:
         """tensorflow.serving.ModelService/HandleReloadConfigRequest
-        (model_management.proto upstream), scoped to the config surface
-        this server owns at runtime: the version_labels maps — the
-        blue-green flip over the wire. Each named model's supplied map is
-        the DECLARATIVE label state (upstream semantics): labels absent
-        from it are unassigned, so dropping a finished canary is one
-        request. Model-list lifecycle (add/remove/base-path moves) belongs
-        to the version watcher's filesystem convention, so a config naming
-        an unserved model is NOT_FOUND rather than a partial reload.
-        Validation+application ride one registry lock acquisition
-        (replace_label_maps), so a concurrent unload can never leave the
-        reload half-applied."""
+        (model_management.proto upstream).
+
+        Two modes, by deployment shape:
+        - multi-model (--model-config-file set `model_lifecycle`): the
+          FULL upstream semantics — the supplied model_config_list
+          REPLACES the served set (new entries start watchers, absent
+          entries stop+unload, existing entries get declarative labels).
+          An empty list is refused rather than interpreted as "unload
+          everything".
+        - single-model modes: scoped to the version_labels maps — the
+          blue-green flip over the wire. Each named model's supplied map
+          is the DECLARATIVE label state (labels absent from it are
+          unassigned); a config naming an unserved model is NOT_FOUND
+          (model-list lifecycle belongs to the startup artifact flags).
+          Validation+application ride one registry lock acquisition
+          (replace_label_maps), so a concurrent unload can never leave
+          the reload half-applied."""
         cfg = request.config
         if cfg.WhichOneof("config") != "model_config_list":
             raise ServiceError(
@@ -548,6 +559,34 @@ class PredictionServiceImpl:
                 "only model_config_list reloads are supported "
                 "(custom_model_config has no meaning here)",
             )
+        if self.model_lifecycle is not None:
+            # Multi-model mode: upstream's FULL reload — the supplied list
+            # REPLACES the served model set (add/remove watchers,
+            # declarative labels on existing models). Same entry
+            # validation as startup.
+            from ..utils.config import validate_model_config_entries
+
+            try:
+                entries = validate_model_config_entries(
+                    cfg.model_config_list.config, "reload config"
+                )
+            except ValueError as e:
+                raise ServiceError("INVALID_ARGUMENT", str(e)) from e
+            if not entries:
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    "refusing an empty model_config_list (it would unload "
+                    "every model; unload explicitly per model instead)",
+                )
+            try:
+                self.model_lifecycle.apply(entries)
+            except ValueError as e:
+                raise ServiceError("INVALID_ARGUMENT", str(e)) from e
+            except (ModelNotFoundError, VersionNotFoundError) as e:
+                raise ServiceError("FAILED_PRECONDITION", str(e)) from e
+            resp = apis.ReloadConfigResponse()
+            resp.status.error_code = 0
+            return resp
         maps: dict[str, dict[str, int]] = {}
         served = self.registry.models()  # one snapshot for the advisory check
         for mc in cfg.model_config_list.config:

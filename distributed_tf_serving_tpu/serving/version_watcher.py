@@ -187,6 +187,12 @@ class VersionWatcher:
         ready = {v: p for v, p in on_disk.items() if _version_ready(p)}
         candidates = sorted(ready, reverse=True)[: self.config.keep_versions]
         for version in sorted(v for v in candidates if v not in loaded):
+            if self._stop.is_set():
+                # A mid-load stop (runtime model removal) must not let this
+                # thread register versions AFTER the caller unloads the
+                # model — a timed-out join would otherwise race a zombie
+                # load back into the registry.
+                return
             path = ready[version]
             if self._attempts.get(version, 0) >= self.config.max_load_attempts:
                 # Blacklisted — but a writer that finished late changes the
@@ -214,6 +220,8 @@ class VersionWatcher:
                         log.info(
                             "replayed %d warmup records for %s v%d", n, name, version
                         )
+                if self._stop.is_set():
+                    return  # stopped while loading: never register (above)
                 self.registry.load(servable)
                 self._attempts.pop(version, None)
                 self._attempt_mtime.pop(version, None)

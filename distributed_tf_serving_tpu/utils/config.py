@@ -125,6 +125,24 @@ def _coerce(cls, data: dict[str, Any]):
     return cls(**kwargs)
 
 
+def validate_model_config_entries(entries, source: str):
+    """Shared shape validation for model_config_list entries — ONE rule
+    set for startup (--model-config-file) and runtime reloads
+    (HandleReloadConfigRequest), so the two paths cannot drift. Raises
+    ValueError; returns the entries as a list."""
+    seen = set()
+    for mc in entries:
+        if not mc.name or not mc.base_path:
+            raise ValueError(
+                f"{source}: every model config needs name and base_path "
+                f"(got name={mc.name!r} base_path={mc.base_path!r})"
+            )
+        if mc.name in seen:
+            raise ValueError(f"{source}: duplicate model {mc.name!r}")
+        seen.add(mc.name)
+    return list(entries)
+
+
 def apply_batching_parameters(cfg: ServerConfig, path) -> ServerConfig:
     """Map a tensorflow_model_server --batching_parameters_file (text-format
     BatchingParameters, session_bundle_config.proto upstream) onto the

@@ -100,6 +100,131 @@ def test_model_config_file_serves_multiple_models(tmp_path):
         batcher.stop()
 
 
+def test_reload_config_adds_removes_and_relabels_models(tmp_path):
+    """Runtime HandleReloadConfigRequest in multi-model mode carries the
+    full upstream semantics: the supplied list REPLACES the served set."""
+    from distributed_tf_serving_tpu.proto import ModelServiceStub
+    from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+
+    _write_model(tmp_path / "a", "A", "dcn_v2", num_fields=6)
+    _write_model(tmp_path / "b", "B", "dcn_v2", num_fields=6, seed=3)
+    cfg_file = tmp_path / "models.pbtxt"
+    cfg_file.write_text(
+        'model_config_list {\n'
+        f'  config {{ name: "A" base_path: "{tmp_path / "a"}" '
+        'version_labels { key: "stable" value: 1 } }\n'
+        '}\n'
+    )
+    cfg = dataclasses.replace(
+        ServerConfig(), model_config_file=str(cfg_file), buckets=(32,),
+        warmup=False,
+    )
+    registry, batcher, impl, _sv, _mesh, lifecycle = build_stack(cfg)
+    server, port = create_server(impl, "127.0.0.1:0")
+    server.start()
+    try:
+        assert registry.models() == {"A": [1]}
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stub = ModelServiceStub(ch)
+
+            # ADD model B + flip A's labels, one declarative reload.
+            req = apis.ReloadConfigRequest()
+            mc = req.config.model_config_list.config.add()
+            mc.name = "A"
+            mc.base_path = str(tmp_path / "a")
+            mc.version_labels["prod"] = 1  # stable dropped, prod added
+            mc = req.config.model_config_list.config.add()
+            mc.name = "B"
+            mc.base_path = str(tmp_path / "b")
+            assert stub.HandleReloadConfigRequest(req, timeout=60).status.error_code == 0
+            assert registry.models() == {"A": [1], "B": [1]}  # sync first poll
+            assert registry.labels("A") == {"prod": 1}
+            out = predict_sync(
+                f"127.0.0.1:{port}",
+                {"feat_ids": np.ones((2, 6), np.int64),
+                 "feat_wts": np.ones((2, 6), np.float32)},
+                model_name="B",
+            )
+            assert out["prediction_node"].shape == (2,)
+
+            # REMOVE A: only B remains; A's requests 404.
+            req2 = apis.ReloadConfigRequest()
+            mc = req2.config.model_config_list.config.add()
+            mc.name = "B"
+            mc.base_path = str(tmp_path / "b")
+            stub.HandleReloadConfigRequest(req2, timeout=60)
+            assert registry.models() == {"B": [1]}
+            with pytest.raises(grpc.RpcError) as e:
+                predict_sync(
+                    f"127.0.0.1:{port}",
+                    {"feat_ids": np.ones((2, 6), np.int64),
+                     "feat_wts": np.ones((2, 6), np.float32)},
+                    model_name="A",
+                )
+            assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+            # Empty list refused (would unload everything).
+            with pytest.raises(grpc.RpcError) as e:
+                stub.HandleReloadConfigRequest(apis.ReloadConfigRequest(
+                    config=apis.ModelServerConfig(
+                        model_config_list=apis.ModelConfigList()
+                    )
+                ), timeout=30)
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert registry.models() == {"B": [1]}
+    finally:
+        server.stop(0)
+        lifecycle.stop()
+        batcher.stop()
+
+
+def test_reload_base_path_move_restarts_watcher(tmp_path):
+    """A reload that changes an existing model's base_path must restart
+    its watcher on the new source (upstream applies base-path moves on
+    this RPC), not silently keep polling the old directory."""
+    from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+
+    _write_model(tmp_path / "old", "A", "dcn_v2", num_fields=6, seed=0)
+    _write_model(tmp_path / "new", "A", "dcn_v2", num_fields=6, seed=99)
+    cfg_file = tmp_path / "models.pbtxt"
+    cfg_file.write_text(
+        'model_config_list {\n'
+        f'  config {{ name: "A" base_path: "{tmp_path / "old"}" }}\n'
+        '}\n'
+    )
+    cfg = dataclasses.replace(
+        ServerConfig(), model_config_file=str(cfg_file), buckets=(32,),
+        warmup=False,
+    )
+    registry, batcher, impl, _sv, _mesh, lifecycle = build_stack(cfg)
+    try:
+        arrays = {"feat_ids": np.ones((2, 6), np.int64),
+                  "feat_wts": np.ones((2, 6), np.float32)}
+        before = np.asarray(
+            registry.resolve("A").model.apply(
+                registry.resolve("A").params,
+                {"feat_ids": arrays["feat_ids"] % (1 << 10),
+                 "feat_wts": arrays["feat_wts"]},
+            )["prediction_node"]
+        )
+        req = apis.ReloadConfigRequest()
+        mc = req.config.model_config_list.config.add()
+        mc.name = "A"
+        mc.base_path = str(tmp_path / "new")
+        impl.handle_reload_config(req)
+        after = np.asarray(
+            registry.resolve("A").model.apply(
+                registry.resolve("A").params,
+                {"feat_ids": arrays["feat_ids"] % (1 << 10),
+                 "feat_wts": arrays["feat_wts"]},
+            )["prediction_node"]
+        )
+        assert not np.allclose(before, after)  # params from the NEW path
+    finally:
+        lifecycle.stop()
+        batcher.stop()
+
+
 def test_model_config_file_validation(tmp_path):
     bad = tmp_path / "bad.pbtxt"
     bad.write_text("model_config_list { config { name: \"X\" } }\n")
