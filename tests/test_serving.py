@@ -178,6 +178,56 @@ def test_version_label_errors(stack):
         registry.set_label("DCN", "broken", 99)
 
 
+def test_aio_server_classify_regress_async_path(stack):
+    """Classify/Regress on the COROUTINE server ride their _async impl
+    variants (the event loop must not block on the batch): same scores as
+    the sync server, over a real aio socket."""
+    import asyncio
+
+    from distributed_tf_serving_tpu.proto import PredictionServiceStub
+    from distributed_tf_serving_tpu.serving.example_codec import make_example
+    from distributed_tf_serving_tpu.serving.server import create_server_async
+
+    registry, impl, _port = stack
+    rng = np.random.RandomState(31)
+    ids = rng.randint(0, 1 << 40, size=(3, CFG.num_fields)).astype(np.int64)
+    wts = rng.rand(3, CFG.num_fields).astype(np.float32)
+
+    creq = apis.ClassificationRequest()
+    creq.model_spec.name = "DCN"
+    for i in range(3):
+        creq.input.example_list.examples.append(make_example(ids[i], wts[i]))
+    rreq = apis.RegressionRequest()
+    rreq.model_spec.name = "DCN"
+    rreq.input.CopyFrom(creq.input)
+    sync_scores = [
+        c.classes[1].score for c in impl.classify(creq).result.classifications
+    ]
+    sync_reg = [r.value for r in impl.regress(rreq).result.regressions]
+
+    async def go():
+        server, port = create_server_async(impl, "127.0.0.1:0")
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                stub = PredictionServiceStub(ch)
+                # Concurrent: both await the batcher on ONE loop thread.
+                cresp, rresp = await asyncio.gather(
+                    stub.Classify(creq, timeout=60),
+                    stub.Regress(rreq, timeout=60),
+                )
+                return (
+                    [c.classes[1].score for c in cresp.result.classifications],
+                    [r.value for r in rresp.result.regressions],
+                )
+        finally:
+            await server.stop(0)
+
+    aio_scores, aio_reg = asyncio.run(go())
+    np.testing.assert_allclose(aio_scores, sync_scores, rtol=1e-6)
+    np.testing.assert_allclose(aio_reg, sync_reg, rtol=1e-6)
+
+
 def test_model_service_get_model_status(stack):
     """tensorflow.serving.ModelService/GetModelStatus over the wire: all
     loaded versions AVAILABLE, version/label pinning, NOT_FOUND taxonomy."""
