@@ -604,6 +604,9 @@ def build_stack(
             mesh=mesh,
             tensor_parallel=cfg.tensor_parallel,
         ).start()
+        # Label-only reloads may re-state this source verbatim (deploy
+        # tools replay full configs); anything ELSE is a rejected move.
+        impl.served_sources[cfg.model_name] = (str(model_base_path), cfg.model_kind)
         versions = registry.models().get(cfg.model_name, [])
         if not versions:
             log.warning("no ready versions under %s yet; watching", model_base_path)

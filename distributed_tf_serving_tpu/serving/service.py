@@ -71,6 +71,11 @@ class PredictionServiceImpl:
         # HandleReloadConfigRequest carries upstream's FULL semantics —
         # the supplied model list replaces the served set.
         self.model_lifecycle = None
+        # name -> (base_path, model_kind) for single-model watcher mode:
+        # lets label-only reloads accept a config that re-states the
+        # CURRENT source (deploy tools replay their full config) while
+        # rejecting an actual move this mode cannot honor.
+        self.served_sources: dict[str, tuple[str, str]] = {}
 
     def _log_request(self, kind: str, request) -> None:
         if self.request_logger is not None:
@@ -592,6 +597,28 @@ class PredictionServiceImpl:
         for mc in cfg.model_config_list.config:
             if not mc.name:
                 raise ServiceError("INVALID_ARGUMENT", "model config missing name")
+            if mc.base_path or mc.model_platform:
+                # A config may RE-STATE the served source (deploy tools
+                # replay their full config to flip a label) — but silently
+                # ignoring an actual base-path/platform CHANGE would let
+                # the config claim one artifact while the server serves
+                # another.
+                src = self.served_sources.get(mc.name)
+                moved = (
+                    src is None
+                    or (mc.base_path and mc.base_path != src[0])
+                    or (mc.model_platform
+                        and mc.model_platform not in ("tensorflow", src[1]))
+                )
+                if moved:
+                    raise ServiceError(
+                        "FAILED_PRECONDITION",
+                        f"model {mc.name!r}: this server was started in "
+                        "single-model mode and cannot apply base_path/"
+                        "model_platform changes; model-list reloads require "
+                        "--model-config-file (a config re-stating the "
+                        "CURRENT source is accepted for label retargeting)",
+                    )
             if not served.get(mc.name):
                 raise ServiceError(
                     "NOT_FOUND",

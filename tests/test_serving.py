@@ -315,6 +315,32 @@ def test_model_service_reload_config_label_flip(stack):
             stub.HandleReloadConfigRequest(custom, timeout=30)
         assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
 
+        # base_path in single-model mode: a config RE-STATING the served
+        # source is a legal label flip; an actual MOVE is an explicit
+        # FAILED_PRECONDITION, never a silent OK.
+        impl.served_sources["DCN"] = ("/models/dcn", "dcn_v2")
+        try:
+            restate = apis.ReloadConfigRequest()
+            mc = restate.config.model_config_list.config.add()
+            mc.name = "DCN"
+            mc.base_path = "/models/dcn"
+            mc.version_labels["reload_label"] = 3
+            assert stub.HandleReloadConfigRequest(
+                restate, timeout=30
+            ).status.error_code == 0
+            assert registry.labels("DCN") == {"reload_label": 3}
+
+            moved = apis.ReloadConfigRequest()
+            mc = moved.config.model_config_list.config.add()
+            mc.name = "DCN"
+            mc.base_path = "/models/somewhere-else"
+            with pytest.raises(grpc.RpcError) as e:
+                stub.HandleReloadConfigRequest(moved, timeout=30)
+            assert e.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+            assert "model-config-file" in e.value.details()
+        finally:
+            impl.served_sources.clear()
+
         # Empty-string label key (legal proto3 map key, malformed request):
         # INVALID_ARGUMENT, not INTERNAL.
         empty = apis.ReloadConfigRequest()
