@@ -1,0 +1,217 @@
+"""Grand tour: every round-5 surface on ONE real server process —
+multi-model config + version labels + TLS gRPC + REST + monitoring +
+warmup replay + request logging — exercised together over live sockets.
+Feature INTERACTIONS are the regression net here; each surface also has
+its own focused suite."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import grpc
+
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.serving.warmup import (
+    WARMUP_DIRNAME,
+    WARMUP_FILENAME,
+    make_warmup_record,
+    read_tfrecords,
+    write_tfrecords,
+)
+from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+GRPC_PORT, REST_PORT = 19921, 19922
+
+
+def _openssl(*args):
+    subprocess.run(["openssl", *args], check=True, capture_output=True)
+
+
+def _pem(p):
+    return p.read_text().replace("\n", "\\n")
+
+
+def test_all_surfaces_on_one_server(tmp_path):
+    # --- artifacts: two models, one with labels + a warmup file ---------
+    for name, nf, seed in (("CTR", 6, 0), ("RANKER", 4, 7)):
+        mcfg = ModelConfig(
+            name=name, num_fields=nf, vocab_size=1 << 10, embed_dim=4,
+            mlp_dims=(8,), num_cross_layers=1, compute_dtype="float32",
+        )
+        model = build_model("dcn_v2", mcfg)
+        sv = Servable(
+            name=name, version=1, model=model,
+            params=model.init(jax.random.PRNGKey(seed)),
+            signatures=ctr_signatures(nf),
+        )
+        save_servable(tmp_path / name.lower() / "1", sv, kind="dcn_v2")
+    extra = tmp_path / "ctr" / "1" / WARMUP_DIRNAME
+    extra.mkdir()
+    write_tfrecords(extra / WARMUP_FILENAME, [make_warmup_record(
+        {"feat_ids": np.ones((2, 6), np.int64),
+         "feat_wts": np.ones((2, 6), np.float32)}, "CTR",
+    )])
+
+    (tmp_path / "models.pbtxt").write_text(
+        'model_config_list {\n'
+        f'  config {{ name: "CTR" base_path: "{tmp_path / "ctr"}" '
+        'version_labels { key: "stable" value: 1 } }\n'
+        f'  config {{ name: "RANKER" base_path: "{tmp_path / "ranker"}" }}\n'
+        '}\n'
+    )
+
+    # --- PKI + ssl config ----------------------------------------------
+    _openssl("req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(tmp_path / "ca.key"), "-out", str(tmp_path / "ca.crt"),
+             "-days", "1", "-subj", "/CN=ca")
+    _openssl("req", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(tmp_path / "s.key"), "-out", str(tmp_path / "s.csr"),
+             "-subj", "/CN=localhost")
+    (tmp_path / "ext").write_text("subjectAltName=DNS:localhost,IP:127.0.0.1\n")
+    _openssl("x509", "-req", "-in", str(tmp_path / "s.csr"),
+             "-CA", str(tmp_path / "ca.crt"), "-CAkey", str(tmp_path / "ca.key"),
+             "-CAcreateserial", "-days", "1", "-extfile", str(tmp_path / "ext"),
+             "-out", str(tmp_path / "s.crt"))
+    (tmp_path / "ssl.pbtxt").write_text(
+        f'server_key: "{_pem(tmp_path / "s.key")}"\n'
+        f'server_cert: "{_pem(tmp_path / "s.crt")}"\n'
+    )
+
+    log_file = tmp_path / "requests.log"
+    # Warmup ON (the replay leg is part of the tour) with a tiny bucket
+    # ladder so the per-model compiles stay fast on the CPU platform.
+    (tmp_path / "server.toml").write_text("[server]\nbuckets = [32]\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_tf_serving_tpu.serving.server",
+         "--port", str(GRPC_PORT), "--rest-port", str(REST_PORT),
+         "--config", str(tmp_path / "server.toml"),
+         "--model-config-file", str(tmp_path / "models.pbtxt"),
+         "--ssl-config-file", str(tmp_path / "ssl.pbtxt"),
+         "--request-log-file", str(log_file), "--request-log-sampling", "1.0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        deadline = time.time() + 150
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{REST_PORT}/v1/models/CTR", timeout=2
+                ) as r:
+                    json.load(r)
+                break
+            except Exception:
+                time.sleep(1)
+        else:
+            raise AssertionError("server never came up")
+
+        creds = grpc.ssl_channel_credentials(
+            root_certificates=(tmp_path / "ca.crt").read_bytes()
+        )
+        from distributed_tf_serving_tpu.proto import (
+            ModelServiceStub,
+            PredictionServiceStub,
+        )
+        from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+        from distributed_tf_serving_tpu.client import build_predict_request
+
+        with grpc.secure_channel(f"localhost:{GRPC_PORT}", creds) as ch:
+            pstub, mstub = PredictionServiceStub(ch), ModelServiceStub(ch)
+            # TLS predict via version LABEL on the multi-model server.
+            resp = pstub.Predict(
+                build_predict_request(
+                    {"feat_ids": np.ones((2, 6), np.int64),
+                     "feat_wts": np.ones((2, 6), np.float32)},
+                    "CTR", version_label="stable",
+                ), timeout=60,
+            )
+            assert resp.model_spec.version.value == 1
+            # ModelService status over TLS sees BOTH models.
+            for name in ("CTR", "RANKER"):
+                sreq = apis.GetModelStatusRequest()
+                sreq.model_spec.name = name
+                st = mstub.GetModelStatus(sreq, timeout=30)
+                assert st.model_version_status[0].state == apis.ModelVersionStatus.AVAILABLE
+            # Runtime declarative relabel over TLS (multi-model reload).
+            rreq = apis.ReloadConfigRequest()
+            mc = rreq.config.model_config_list.config.add()
+            mc.name = "CTR"
+            mc.base_path = str(tmp_path / "ctr")
+            mc.version_labels["prod"] = 1  # stable -> prod
+            mc = rreq.config.model_config_list.config.add()
+            mc.name = "RANKER"
+            mc.base_path = str(tmp_path / "ranker")
+            assert mstub.HandleReloadConfigRequest(rreq, timeout=60).status.error_code == 0
+
+        # REST: the NEW label routes; the old one 404s; RANKER plain route.
+        body = json.dumps({"inputs": {"feat_ids": [[1, 2, 3, 4, 5, 6]],
+                                      "feat_wts": [[0.5] * 6]}}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{REST_PORT}/v1/models/CTR/labels/prod:predict",
+            data=body, headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert "outputs" in json.load(r)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{REST_PORT}/v1/models/CTR/labels/stable:predict",
+            data=body, headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 404
+        body4 = json.dumps({"inputs": {"feat_ids": [[1, 2, 3, 4]],
+                                       "feat_wts": [[0.5] * 4]}}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{REST_PORT}/v1/models/RANKER:predict",
+            data=body4, headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert "outputs" in json.load(r)
+
+        # Monitoring aggregates BOTH transports on one scrape.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{REST_PORT}/monitoring/prometheus/metrics",
+            timeout=10,
+        ) as r:
+            text = r.read().decode()
+        assert ':tensorflow:serving:request_count{entrypoint="Predict",status="OK"}' in text
+        assert ':tensorflow:serving:request_count{entrypoint="REST.Predict",status="OK"}' in text
+
+        # Warmup replayed at load (from the server's own log output later).
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            out, _ = proc.communicate(timeout=25)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+
+    assert "replayed 1 warmup records for CTR v1" in out, out[-2500:]
+    # Request log captured the successful predicts and parses back —
+    # directly usable as a warmup file for the next version.
+    from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+
+    kinds = []
+    for payload in read_tfrecords(log_file):
+        pl = apis.PredictionLog()
+        pl.ParseFromString(payload)
+        kinds.append(pl.WhichOneof("log_type"))
+    # Exactly the SUCCESSFUL predicts: TLS + REST/labels/prod + REST
+    # RANKER. The 404'd stale-label request and the warmup replay (which
+    # rides a logger-free throwaway impl) must NOT appear.
+    assert kinds == ["predict_log"] * 3
