@@ -225,6 +225,53 @@ def test_reload_base_path_move_restarts_watcher(tmp_path):
         batcher.stop()
 
 
+def test_concurrent_reloads_serialize(tmp_path):
+    """Racing HandleReloadConfigRequest calls must serialize on the
+    lifecycle lock: whatever interleaving wins, the end state is ONE of
+    the requested configs, never a blend."""
+    import threading
+
+    from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+
+    _write_model(tmp_path / "a", "A", "dcn_v2", num_fields=6)
+    _write_model(tmp_path / "b", "B", "dcn_v2", num_fields=6, seed=3)
+    cfg_file = tmp_path / "models.pbtxt"
+    cfg_file.write_text(
+        f'model_config_list {{ config {{ name: "A" base_path: "{tmp_path / "a"}" }} }}\n'
+    )
+    cfg = dataclasses.replace(
+        ServerConfig(), model_config_file=str(cfg_file), buckets=(32,),
+        warmup=False,
+    )
+    registry, batcher, impl, _sv, _mesh, lifecycle = build_stack(cfg)
+    try:
+        def reload_with(names):
+            req = apis.ReloadConfigRequest()
+            for name in names:
+                mc = req.config.model_config_list.config.add()
+                mc.name = name
+                mc.base_path = str(tmp_path / name.lower())
+                mc.version_labels["live"] = 1
+            impl.handle_reload_config(req)
+
+        threads = [
+            threading.Thread(target=reload_with, args=(names,))
+            for names in (("A",), ("A", "B"), ("B",)) * 4
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        served = set(registry.models())
+        assert served in ({"A"}, {"A", "B"}, {"B"}), served
+        for name in served:
+            assert registry.labels(name) == {"live": 1}
+            assert registry.resolve(name, label="live").version == 1
+    finally:
+        lifecycle.stop()
+        batcher.stop()
+
+
 def test_model_config_file_validation(tmp_path):
     bad = tmp_path / "bad.pbtxt"
     bad.write_text("model_config_list { config { name: \"X\" } }\n")
