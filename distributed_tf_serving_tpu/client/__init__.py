@@ -1,6 +1,12 @@
 """Client layer: candidate sharding, async fan-out Predict, bench harness."""
 
-from .bench import BenchReport, make_payload, run_closed_loop, run_closed_loop_mp
+from .bench import (
+    BenchReport,
+    make_payload,
+    run_closed_loop,
+    run_closed_loop_mp,
+    transfer_counters,
+)
 from .client import (
     PredictClientError,
     PreparedRequest,
@@ -34,4 +40,5 @@ __all__ = [
     "BenchReport",
     "make_payload",
     "run_closed_loop",
+    "transfer_counters",
 ]

@@ -77,12 +77,20 @@ class Model:
     precondition for the batcher's lossless bf16 weight-transfer compression.
     Models with a float32 sparse-linear term over the raw weights
     (wide_deep, deepfm) must leave it False.
+
+    score_output: the name of the per-candidate score vector in the apply()
+    output dict — the one tensor the serving path ultimately ranks on.
+    The batcher's output-compaction pipeline keys on it: wire-dtype
+    downcast applies to every f32 output, but top-k compaction (retrieval-
+    style servables, e.g. two_tower scoring a large candidate set) returns
+    only this vector's top-k (score, index) pairs over the D2H link.
     """
 
     config: ModelConfig
     init: Callable[[jax.Array], Params]
     apply: Callable[[Params, Batch], dict[str, jax.Array]]
     wts_in_compute_dtype: bool = True
+    score_output: str = "prediction_node"
     # False for graph-executor models (interop/graph_exec.py): the imported
     # graph consumes RAW int64 ids (its own hashing/mod/lookup semantics),
     # so the batcher must not vocab-fold them on host.

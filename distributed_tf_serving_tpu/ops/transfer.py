@@ -99,6 +99,86 @@ def unpack_device(packed: dict[str, jnp.ndarray], spec: dict[str, str]) -> dict[
 # slices + bitcasts (free: fuses with the consumers).
 
 
+# --------------------------------------------------- output compaction
+#
+# The inverse problem of the input spec above: the serving path must never
+# ship full fp32 output tensors synchronously back to the host (the
+# "300M predictions/s" paper attributes its serving wins to exactly this).
+# Scores are downcast to a wire dtype ON-DEVICE (traced into the jitted
+# entry, so the D2H transfer carries the small bytes) and widened back to
+# float32 on the host by the batch completer before anything user-visible
+# sees them; retrieval-style servables can go further and return only the
+# top-k (score, index) pairs.
+
+_WIRE_DTYPES = {"float32": None, "bfloat16": "bf16", "float16": "f16"}
+
+
+def output_wire_dtype(name: str) -> np.dtype | None:
+    """Validated numpy dtype for an output wire-dtype knob; None means
+    float32 (no downcast — the full-precision fallback path)."""
+    if name not in _WIRE_DTYPES:
+        raise ValueError(
+            f"unknown output wire dtype {name!r}; have {sorted(_WIRE_DTYPES)}"
+        )
+    if name == "float32":
+        return None
+    return np.dtype(ml_dtypes.bfloat16 if name == "bfloat16" else np.float16)
+
+
+def compact_outputs_device(
+    outputs: dict[str, jnp.ndarray], wire_dt
+) -> dict[str, jnp.ndarray]:
+    """Traced into the jitted entry: downcast float32 outputs to the wire
+    dtype on-device so only the compact bytes cross the D2H boundary.
+    Non-f32 outputs (int tensors, an imported graph's f64) pass through —
+    the transform must stay losslessly invertible by restore_outputs_host."""
+    if wire_dt is None:
+        return dict(outputs)
+    return {
+        k: v.astype(wire_dt) if v.dtype == jnp.float32 else v
+        for k, v in outputs.items()
+    }
+
+
+def restore_outputs_host(host: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Completer-side inverse of compact_outputs_device: widen wire-dtype
+    arrays back to float32 so every downstream consumer (codec encode,
+    Classify/Regress, request slicing) sees the signature dtype."""
+    out = {}
+    for k, v in host.items():
+        if v.dtype == ml_dtypes.bfloat16 or v.dtype == np.float16:
+            v = v.astype(np.float32)
+        out[k] = v
+    return out
+
+
+def topk_compact_device(scores: jnp.ndarray, n_valid, k: int, wire_dt) -> dict:
+    """Top-k output compaction, traced into the jitted entry: only the k
+    best (score, index) pairs of the first `n_valid` rows cross the wire
+    (padding rows are masked to -inf so they can never outrank a real
+    candidate). `n_valid` is a traced scalar — one executable per
+    (bucket, k), not per request size."""
+    import jax
+
+    mask = jnp.arange(scores.shape[0]) < n_valid
+    masked = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
+    vals, idx = jax.lax.top_k(masked, k)
+    if wire_dt is not None:
+        vals = vals.astype(wire_dt)
+    return {"topk_scores": vals, "topk_indices": idx.astype(jnp.int32)}
+
+
+def topk_restore_host(vals, idx, n: int, score_key: str) -> dict[str, np.ndarray]:
+    """Host-side inverse of topk_compact_device: scatter the k pairs back
+    into a full-length float32 vector with 0.0 off the head. Sigmoid CTR
+    scores are strictly positive, so ranking consumers (the reference
+    client sorts and takes the head) see the exact same top-k order; the
+    tail is explicitly "not ranked", not an approximation."""
+    scores = np.zeros(n, np.float32)
+    scores[np.asarray(idx)] = np.asarray(vals).astype(np.float32)
+    return {score_key: scores}
+
+
 def combined_supported(arrays: dict[str, np.ndarray]) -> bool:
     """True when every array can be reconstructed by the device-side
     bitcast: fixed-width numerics up to 4 bytes. ml_dtypes.bfloat16 is

@@ -21,7 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..utils.compat import shard_map
 
 from .mesh import DATA_AXIS, MODEL_AXIS
 

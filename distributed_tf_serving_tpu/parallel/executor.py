@@ -23,10 +23,16 @@ from typing import Any
 import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..utils.compat import shard_map
 
 from ..models.registry import Servable
-from ..ops.transfer import pack_host, transfer_spec, unpack_device
+from ..ops.transfer import (
+    compact_outputs_device,
+    output_wire_dtype as _wire_dtype_of,
+    pack_host,
+    transfer_spec,
+    unpack_device,
+)
 from .mesh import DATA_AXIS, candidate_sharding
 from .sharding import batch_shardings, param_shardings, place_params
 
@@ -38,14 +44,23 @@ class ShardedExecutor:
     axis, rest replicated); each batch is jit-executed with candidate-dim
     in_shardings so XLA scatters rows across the data axis and inserts the
     collectives the embedding sharding implies.
+
+    output_wire_dtype mirrors the batcher's output compaction: f32 outputs
+    are downcast on-device before the (gathered) D2H readback; the
+    batcher's completer widens them back to f32 transparently.
     """
 
     def __init__(
-        self, mesh: Mesh, compress_transfer: bool = True, tensor_parallel: bool = False
+        self,
+        mesh: Mesh,
+        compress_transfer: bool = True,
+        tensor_parallel: bool = False,
+        output_wire_dtype: str = "float32",
     ):
         self.mesh = mesh
         self.compress_transfer = compress_transfer
         self.tensor_parallel = tensor_parallel
+        self._wire_dt = _wire_dtype_of(output_wire_dtype)
         # Weak keys: an unloaded servable must not pin its placed params or
         # compiled executable (same rationale as DynamicBatcher._jitted).
         self._placed: weakref.WeakKeyDictionary[Servable, Any] = weakref.WeakKeyDictionary()
@@ -65,6 +80,8 @@ class ShardedExecutor:
             apply = servable.model.apply
             mesh = self.mesh
 
+            wire = self._wire_dt
+
             def run(params, packed):
                 batch = unpack_device(packed, spec)
                 # Pin candidate-dim layout inside the computation too, so the
@@ -75,7 +92,10 @@ class ShardedExecutor:
                     )
                     for k, v in batch.items()
                 }
-                return apply(params, batch)
+                # On-device output compaction: the gathered scores cross
+                # the D2H link in the wire dtype; the batcher's completer
+                # restores f32.
+                return compact_outputs_device(apply(params, batch), wire)
 
             self._placed[key] = (
                 servable.params,

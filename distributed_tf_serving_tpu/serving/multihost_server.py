@@ -150,6 +150,12 @@ def build_multihost_stack(
         buckets=runner.buckets, max_wait_us=max_wait_us, run_fn=runner.as_run_fn()
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
+    # Label-only reloads may re-state this source verbatim (deploy tools
+    # replay their full config to flip a label); without this entry the
+    # single-model reload gate reads the re-statement as a base-path MOVE
+    # and rejects it FAILED_PRECONDITION — same wiring as build_stack's
+    # --model-base-path mode.
+    impl.served_sources[model_name] = (str(base_path), model_kind)
 
     watcher = VersionWatcher(
         base_path,

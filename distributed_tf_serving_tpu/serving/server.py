@@ -220,17 +220,31 @@ class AioGrpcPredictionService(_AioServicerBase):
 
 
 class AioGrpcModelService(_AioServicerBase):
-    """ModelService on the coroutine server: both RPCs are cheap registry
-    reads/writes (no batch wait), so they run inline on the loop through
-    the shared _call error mapping."""
+    """ModelService on the coroutine server: GetModelStatus is a cheap
+    registry read and runs inline on the loop through the shared _call
+    error mapping. Reload is inline ONLY for the label-flip mode; a
+    multi-model lifecycle reload loads/warms whole models, which would
+    stall every in-flight RPC on the single event-loop thread — it rides
+    a worker thread instead (the lifecycle lock already serializes
+    concurrent reloads, so off-loop dispatch adds no new interleaving)."""
 
     async def GetModelStatus(self, request, context):
         return await self._call("GetModelStatus", self.impl.get_model_status, request, context)
 
     async def HandleReloadConfigRequest(self, request, context):
-        return await self._call(
-            "HandleReloadConfigRequest", self.impl.handle_reload_config, request, context
-        )
+        import asyncio
+
+        fn = self.impl.handle_reload_config
+        if self.impl.model_lifecycle is not None:
+            loop = asyncio.get_running_loop()
+
+            def dispatch(req, _fn=fn):
+                # run_in_executor returns an awaitable future; _call awaits
+                # it, keeping the loop free while the reload loads models.
+                return loop.run_in_executor(None, _fn, req)
+
+            fn = dispatch
+        return await self._call("HandleReloadConfigRequest", fn, request, context)
 
 
 def create_server_async(
@@ -372,6 +386,13 @@ class ModelLifecycle:
     def watchers(self):
         with self._lock:
             return list(self._watchers.values())
+
+    def configured_models(self) -> set[str]:
+        """Names this lifecycle owns a watcher for — configured, whether or
+        not a version has landed yet (GetModelStatus reports START for the
+        not-yet-ready ones instead of NOT_FOUND)."""
+        with self._lock:
+            return set(self._watchers)
 
     def _make_watcher(self, mc):
         from .version_watcher import VersionWatcher, VersionWatcherConfig
@@ -538,6 +559,7 @@ def build_stack(
             mesh,
             compress_transfer=cfg.compress_transfer,
             tensor_parallel=cfg.tensor_parallel,
+            output_wire_dtype=cfg.output_wire_dtype,
         )
     batcher = DynamicBatcher(
         buckets=cfg.buckets,
@@ -547,6 +569,11 @@ def build_stack(
         pipeline_depth=cfg.pipeline_depth,
         queue_capacity_candidates=cfg.queue_capacity_candidates,
         completion_workers=cfg.completion_workers,
+        output_wire_dtype=cfg.output_wire_dtype,
+        output_top_k=cfg.output_top_k,
+        async_readback=cfg.async_readback,
+        pipelined_dispatch=cfg.pipelined_dispatch,
+        donate_buffers=cfg.donate_buffers,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
 

@@ -319,14 +319,22 @@ class PredictionServiceImpl:
             # encode APPENDS, so a duplicated filter name would otherwise
             # emit doubled float_val lists against a single-n shape.
             out_names = list(dict.fromkeys(request.output_filter))
+            # A filtered request pins the batcher's output selection: the
+            # jitted entry returns (and the D2H link carries) only these
+            # tensors — a score-only filter is what arms top-k compaction.
+            fetch_keys = tuple(out_names)
         else:
             out_names = sig_outputs
-        return servable, arrays, out_names
+            # None = all outputs: unfiltered requests share one executable
+            # variant instead of keying the jit cache on the signature's
+            # output list.
+            fetch_keys = None
+        return servable, arrays, out_names, fetch_keys
 
     def predict(self, request: apis.PredictRequest) -> apis.PredictResponse:
-        servable, arrays, out_names = self._predict_prepare(request)
+        servable, arrays, out_names, fetch_keys = self._predict_prepare(request)
         with request_trace.span("predict.execute"):
-            outputs = self._run(servable, arrays, output_keys=tuple(out_names))
+            outputs = self._run(servable, arrays, output_keys=fetch_keys)
         resp = self._predict_finish(request, servable, out_names, outputs)
         # Log only SUCCEEDED requests: the file's contract is direct
         # usability as a warmup file, and one malformed client request
@@ -337,9 +345,9 @@ class PredictionServiceImpl:
     async def predict_async(self, request: apis.PredictRequest) -> apis.PredictResponse:
         """Predict for coroutine servers: identical semantics, awaits the
         batch instead of blocking a handler thread on it."""
-        servable, arrays, out_names = self._predict_prepare(request)
+        servable, arrays, out_names, fetch_keys = self._predict_prepare(request)
         with request_trace.span("predict.execute"):
-            outputs = await self._run_async(servable, arrays, output_keys=tuple(out_names))
+            outputs = await self._run_async(servable, arrays, output_keys=fetch_keys)
         resp = self._predict_finish(request, servable, out_names, outputs)
         self._log_request("predict", request)
         return resp
@@ -376,9 +384,32 @@ class PredictionServiceImpl:
             mirror_content = any(
                 request.inputs[name].tensor_content for name in request.inputs
             )
+            half = (
+                codec.dtype_to_numpy(fw.DataType.DT_BFLOAT16),
+                np.dtype(np.float16),
+            )
+            sig_dtypes = None  # built lazily: the leak guard below almost
+            # never fires (the batcher completer already widened), and this
+            # encode path is microbenchmark-hot (~50 us/call at 500 QPS).
             for name in out_names:
+                arr = outputs[name]
+                if arr.dtype in half:
+                    # Wire-dtype leakage guard (custom run_fns returning the
+                    # compact transport encoding): responses stay signature-
+                    # typed DT_FLOAT. Genuinely half-precision signatures
+                    # (imported graphs declaring DT_HALF/DT_BFLOAT16) pass
+                    # through untouched.
+                    if sig_dtypes is None:
+                        sig_dtypes = {
+                            s.name: s.dtype
+                            for s in servable.signature(
+                                request.model_spec.signature_name
+                            ).outputs
+                        }
+                    if sig_dtypes.get(name) == fw.DataType.DT_FLOAT:
+                        arr = arr.astype(np.float32)
                 codec.from_ndarray(
-                    outputs[name],
+                    arr,
                     use_tensor_content=mirror_content,
                     out=resp.outputs[name],
                 )
@@ -508,13 +539,33 @@ class PredictionServiceImpl:
         .proto upstream): version states for readiness probes. Loaded
         versions are AVAILABLE by construction — the registry flips
         atomically after load+warmup, so the upstream LOADING/UNLOADING
-        transients are never externally observable here."""
+        transients are never externally observable here.
+
+        A model the server is CONFIGURED for (a watcher owns its base_path
+        via --model-base-path or --model-config-file) whose first version
+        has not landed yet reports state START — TF-Serving-style readiness
+        probes poll through the rollout instead of treating the transient
+        as an RPC error. NOT_FOUND remains the answer for names this server
+        was never told about."""
         name = request.model_spec.name
         if not name:
             raise ServiceError("INVALID_ARGUMENT", "model_spec.name is required")
         loaded = self.registry.models().get(name)
         if not loaded:
-            raise ServiceError("NOT_FOUND", f"model {name!r} not found")
+            lifecycle = self.model_lifecycle
+            configured = name in self.served_sources or (
+                lifecycle is not None
+                and name in getattr(lifecycle, "configured_models", lambda: ())()
+            )
+            if not configured:
+                raise ServiceError("NOT_FOUND", f"model {name!r} not found")
+            version, _label = self._version_choice(request.model_spec)
+            resp = apis.GetModelStatusResponse()
+            st = resp.model_version_status.add()
+            st.version = version or 0  # no version directory discovered yet
+            st.state = apis.ModelVersionStatus.START
+            st.status.error_code = 0
+            return resp
         version, label = self._version_choice(request.model_spec)
         if label is not None:
             servable = _wrap_lookup(

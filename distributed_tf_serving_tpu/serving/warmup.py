@@ -43,17 +43,26 @@ class WarmupError(RuntimeError):
 
 # ------------------------------------------------------------------ crc32c
 
-_CRC_TABLE: list[int] = []
+
+def _build_crc_table() -> list[int]:
+    poly = 0x82F63B78  # Castagnoli, reflected
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+# Built EAGERLY at import: the old lazy appender raced concurrent first
+# callers (request-log writer thread vs. warmup replay) — one thread could
+# read a partially filled table and CRC garbage (ADVICE round 5). A single
+# module-level assignment of a fully built list is safe to publish.
+_CRC_TABLE: list[int] = _build_crc_table()
 
 
 def _crc_table() -> list[int]:
-    if not _CRC_TABLE:
-        poly = 0x82F63B78  # Castagnoli, reflected
-        for n in range(256):
-            c = n
-            for _ in range(8):
-                c = (c >> 1) ^ poly if c & 1 else c >> 1
-            _CRC_TABLE.append(c)
     return _CRC_TABLE
 
 

@@ -1,0 +1,22 @@
+"""Version-bridging shims for the jax API surface.
+
+The repo targets the jax the image bakes in; APIs that moved between
+releases get ONE canonical import here so hot-path modules never repeat
+the try/except dance (and a future jax bump touches one file).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    enable_x64 = jax.enable_x64  # newer jax re-exports it at top level
+except AttributeError:  # jax 0.4.x keeps the context manager in experimental
+    from jax.experimental import enable_x64  # noqa: F401
+
+try:
+    from jax import shard_map  # newer jax exports it at top level
+except ImportError:  # jax 0.4.x keeps shard_map under experimental
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["enable_x64", "shard_map"]

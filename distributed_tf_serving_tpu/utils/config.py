@@ -11,8 +11,12 @@ from __future__ import annotations
 
 import dataclasses
 import pathlib
-import tomllib
 from typing import Any
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: the vendored-API backport
+    import tomli as tomllib
 
 
 @dataclasses.dataclass(frozen=True)
@@ -29,6 +33,32 @@ class ServerConfig:
     max_wait_us: int = 200
     completion_workers: int = 4  # threads finishing readback+delivery
     compress_transfer: bool = True
+    # ---- output-transfer pipeline (serving/batcher.py) -------------------
+    # Wire dtype for device->host score readback: scores are downcast
+    # ON-DEVICE before the D2H transfer and widened back to float32 on the
+    # host, so responses stay signature-typed. "float32" = the full-
+    # precision fallback (bit-exact); "bfloat16"/"float16" halve the
+    # readback bytes at <=1e-2 relative score error.
+    output_wire_dtype: str = "float32"
+    # >0: retrieval-style compaction — single-request batches return only
+    # the top-k (score, index) pairs over the wire; the host rebuilds a
+    # full-length score vector with 0.0 off the head (sigmoid scores are
+    # strictly positive, so ranking consumers see the same head). 0 = off.
+    output_top_k: int = 0
+    # Issue copy_to_host_async() at dispatch so the completer's fetch waits
+    # on an in-flight transfer (readback.issue / readback.wait phases)
+    # instead of starting one (batch.readback). False = the synchronous
+    # fallback path.
+    async_readback: bool = True
+    # Run the device stage (cache/pack/upload/jit-call) on a dedicated
+    # dispatch thread so the batching thread's collect+pad of batch k+1
+    # overlaps batch k's H2D upload and dispatch. False = the previous
+    # single-threaded dispatch.
+    pipelined_dispatch: bool = True
+    # Donate single-use combined input buffers to the jitted entry (XLA
+    # reuses their HBM for outputs). Only effective off-CPU and only for
+    # buffers the DeviceInputCache did not retain.
+    donate_buffers: bool = True
     warmup: bool = True
     # Coalescing keeps filling past max_wait while this many batches are in
     # flight (latency-free: the dispatch would queue behind device work

@@ -151,6 +151,20 @@ class ServerMetrics:
                 "mean_occupancy": round(batcher_stats.mean_occupancy, 3),
                 "mean_requests_per_batch": round(batcher_stats.mean_requests_per_batch, 2),
                 "max_queue_depth": batcher_stats.max_queue_depth,
+                # D2H transfer attribution (output compaction + async
+                # readback pipeline): actual wire bytes fetched, the
+                # full-fp32 all-outputs baseline they're charged against,
+                # and how much of the in-flight transfer window the
+                # completers actually blocked on.
+                "bytes_downloaded": batcher_stats.bytes_downloaded,
+                "bytes_download_full_f32": batcher_stats.bytes_download_full_f32,
+                "download_compaction_ratio": round(
+                    batcher_stats.download_compaction_ratio, 2
+                ),
+                "readback_overlap_fraction": round(
+                    batcher_stats.readback_overlap_fraction, 3
+                ),
+                "topk_batches": batcher_stats.topk_batches,
             }
         return out
 
@@ -189,6 +203,14 @@ class ServerMetrics:
                  round(batcher_stats.mean_requests_per_batch, 3)),
                 ("dts_tpu_batcher_max_queue_depth", "gauge",
                  batcher_stats.max_queue_depth),
+                ("dts_tpu_batcher_bytes_downloaded_total", "counter",
+                 batcher_stats.bytes_downloaded),
+                ("dts_tpu_batcher_bytes_download_full_f32_total", "counter",
+                 batcher_stats.bytes_download_full_f32),
+                ("dts_tpu_batcher_topk_batches_total", "counter",
+                 batcher_stats.topk_batches),
+                ("dts_tpu_batcher_readback_overlap_fraction", "gauge",
+                 round(batcher_stats.readback_overlap_fraction, 4)),
             ):
                 lines.append(f"# TYPE {metric} {kind}")
                 lines.append(f"{metric} {value}")

@@ -30,10 +30,17 @@ class PhaseTrace:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                self._totals[phase] += dt
-                self._counts[phase] += 1
+            self.add(phase, time.perf_counter() - t0)
+
+    def add(self, phase: str, seconds: float) -> None:
+        """Record an externally timed duration under `phase`. For callers
+        that already hold the wall time for their own accounting (the
+        batcher's readback-overlap bookkeeping times the fetch once and
+        feeds both this trace and the overlap counters) — a nested span
+        would pay a second pair of clock reads for the same interval."""
+        with self._lock:
+            self._totals[phase] += seconds
+            self._counts[phase] += 1
 
     def snapshot(self) -> dict[str, dict]:
         with self._lock:

@@ -19,6 +19,8 @@ import pytest
 
 jax = pytest.importorskip("jax")
 
+from distributed_tf_serving_tpu.utils.compat import enable_x64  # noqa: E402
+
 from distributed_tf_serving_tpu.client import ShardedPredictClient
 from distributed_tf_serving_tpu.interop.graph_exec import (
     GraphExecutor,
@@ -132,7 +134,7 @@ def test_graph_executor_matches_tf_forward(exotic_export):
     )
     assert sv.model.needs_x64 and not sv.model.folds_ids_on_host
     arrays = _payload(12, seed=5)
-    with jax.enable_x64():
+    with enable_x64():
         out = sv.model.apply(sv.params, arrays)
     got = np.asarray(out["prediction_node"], np.float32)
     want = _tf_golden(exotic_export, seed=5, n=12)
@@ -181,7 +183,7 @@ def test_fallback_chain_lands_on_graph_executor(exotic_export, caplog):
         )
     assert not sv.model.folds_ids_on_host  # graph executor, not a zoo family
     arrays = _payload(6, seed=13)
-    with jax.enable_x64():
+    with enable_x64():
         got = np.asarray(sv.model.apply(sv.params, arrays)["prediction_node"], np.float32)
     np.testing.assert_allclose(got, _tf_golden(exotic_export, seed=13, n=6),
                                rtol=2e-5, atol=1e-6)
@@ -387,7 +389,7 @@ def test_static_hashtable_export_matches_tf(tmp_path):
         pytest.skip(f"tensorflow export unavailable: {r.stderr[-800:]}")
     sv = import_savedmodel(out, "graph", ModelConfig(name="HT", num_fields=3), name="HT")
     ids = np.array([[5, 42, 999], [10**12, 3, 77], [1, 2, 10**6]], np.int64)
-    with jax.enable_x64():
+    with enable_x64():
         got = np.asarray(
             sv.model.apply(sv.params, {"feat_ids": ids})["prediction_node"],
             np.float32,
@@ -400,7 +402,7 @@ def test_static_hashtable_export_matches_tf(tmp_path):
     want = np.asarray(json.loads(g.stdout.strip().splitlines()[-1]), np.float32)
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
     # And under jit (the serving path), where the lookup must trace.
-    with jax.enable_x64():
+    with enable_x64():
         got_jit = np.asarray(
             jax.jit(sv.model.apply)(sv.params, {"feat_ids": ids})["prediction_node"],
             np.float32,
@@ -624,7 +626,7 @@ def test_keras_export_serves_via_graph_executor(tmp_path):
         "feat_ids": rng.randint(0, 1 << 40, size=(7, 5)).astype(np.int64),
         "feat_wts": rng.rand(7, 5).astype(np.float32),
     }
-    with jax.enable_x64():
+    with enable_x64():
         got = np.asarray(sv.model.apply(sv.params, arrays)["prediction_node"], np.float32)
     g = subprocess.run(
         [sys.executable, "-c", _GOLDEN_KERAS, str(out), "8", "7"],

@@ -422,6 +422,7 @@ _SERVER_WORKER = textwrap.dedent(
     from distributed_tf_serving_tpu.serving.server import create_server
 
     assert runner.version == 1 and registry.models()["DCN"] == [1]
+    assert impl.served_sources["DCN"] == (str(base), "dcn_v2")
     server, gport = create_server(impl, "127.0.0.1:0")
     server.start()
 
@@ -539,6 +540,19 @@ def test_multihost_stack_dlrm_carries_dense_features(tmp_path):
     try:
         assert "dense_features" in runner._keys  # signature-driven template
         assert registry.models()["DLRM"] == [1]
+        # The multihost stack registers its source like build_stack's
+        # --model-base-path mode, so a label-retarget reload RE-STATING the
+        # current base_path is accepted (deploy tools replay their full
+        # config) instead of being rejected as a base-path move.
+        assert impl.served_sources["DLRM"] == (str(base), "dcn_v2")
+        from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+        restate = apis.ReloadConfigRequest()
+        mc = restate.config.model_config_list.config.add()
+        mc.name = "DLRM"
+        mc.base_path = str(base)
+        mc.version_labels["stable"] = 1
+        assert impl.handle_reload_config(restate).status.error_code == 0
+        assert registry.labels("DLRM") == {"stable": 1}
 
         rng = np.random.RandomState(4)
         arrays = {

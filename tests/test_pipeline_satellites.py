@@ -1,0 +1,180 @@
+"""Satellite regression tests riding the output-pipeline PR (ISSUE 1):
+
+- GetModelStatus reports START (not NOT_FOUND) for a configured-but-not-
+  ready model, so TF-Serving-style readiness probes survive a rollout;
+- the aio ModelService dispatches lifecycle reloads off the event loop
+  (a model load must not stall every in-flight RPC);
+- the CRC32C table is built eagerly at import (the lazy appender raced
+  concurrent first callers, ADVICE round 5).
+"""
+
+import asyncio
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+from distributed_tf_serving_tpu.serving import (
+    DynamicBatcher,
+    PredictionServiceImpl,
+    ServiceError,
+)
+
+CFG = ModelConfig(
+    num_fields=8, vocab_size=1009, embed_dim=4, mlp_dims=(16,), num_cross_layers=1,
+    compute_dtype="float32",
+)
+
+
+def _impl():
+    registry = ServableRegistry()
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0)
+    return registry, PredictionServiceImpl(registry, batcher)
+
+
+def _status_request(name):
+    req = apis.GetModelStatusRequest()
+    req.model_spec.name = name
+    return req
+
+
+# ------------------------------------------------ GetModelStatus readiness
+
+
+def test_get_model_status_start_for_configured_not_ready():
+    """A model the server watches (single-model --model-base-path mode)
+    whose first version hasn't landed reports START, not NOT_FOUND."""
+    _registry, impl = _impl()
+    impl.served_sources["DCN"] = ("/models/dcn", "dcn_v2")
+    resp = impl.get_model_status(_status_request("DCN"))
+    assert len(resp.model_version_status) == 1
+    st = resp.model_version_status[0]
+    assert st.state == apis.ModelVersionStatus.START
+    assert st.version == 0  # no version directory discovered yet
+    assert st.status.error_code == 0
+
+
+def test_get_model_status_start_via_lifecycle():
+    """Multi-model mode: a name the ModelLifecycle owns a watcher for is
+    configured even before its first version loads."""
+
+    class Lifecycle:
+        def configured_models(self):
+            return {"PENDING"}
+
+    _registry, impl = _impl()
+    impl.model_lifecycle = Lifecycle()
+    resp = impl.get_model_status(_status_request("PENDING"))
+    assert resp.model_version_status[0].state == apis.ModelVersionStatus.START
+
+
+def test_get_model_status_unknown_model_stays_not_found():
+    _registry, impl = _impl()
+    impl.served_sources["DCN"] = ("/models/dcn", "dcn_v2")
+    with pytest.raises(ServiceError) as e:
+        impl.get_model_status(_status_request("NOPE"))
+    assert e.value.code == "NOT_FOUND"
+
+
+def test_get_model_status_loaded_still_available():
+    registry, impl = _impl()
+    model = build_model("dcn", CFG)
+    registry.load(
+        Servable(
+            name="DCN", version=1, model=model,
+            params=model.init(jax.random.PRNGKey(0)),
+            signatures=ctr_signatures(CFG.num_fields),
+        )
+    )
+    impl.served_sources["DCN"] = ("/models/dcn", "dcn_v2")  # configured AND ready
+    resp = impl.get_model_status(_status_request("DCN"))
+    assert resp.model_version_status[0].state == apis.ModelVersionStatus.AVAILABLE
+
+
+# --------------------------------------------- aio reload off the event loop
+
+
+def test_aio_lifecycle_reload_does_not_stall_event_loop():
+    """With model_lifecycle set, HandleReloadConfigRequest runs on a worker
+    thread: other coroutines keep making progress while the reload loads
+    models. Without a lifecycle, the cheap label flip stays inline."""
+    from distributed_tf_serving_tpu.serving.server import AioGrpcModelService
+
+    release = threading.Event()
+    applied = []
+
+    class SlowLifecycle:
+        def apply(self, entries):
+            # A real reload loads+warms a model here; a stalled loop would
+            # freeze the heartbeat coroutine below for the duration.
+            release.wait(timeout=30)
+            applied.append([mc.name for mc in entries])
+
+        def configured_models(self):
+            return {"DCN"}
+
+    _registry, impl = _impl()
+    impl.model_lifecycle = SlowLifecycle()
+    servicer = AioGrpcModelService(impl)
+
+    req = apis.ReloadConfigRequest()
+    mc = req.config.model_config_list.config.add()
+    mc.name = "DCN"
+    mc.base_path = "/models/dcn"
+
+    async def go():
+        beats = 0
+        reload_task = asyncio.ensure_future(
+            servicer.HandleReloadConfigRequest(req, context=None)
+        )
+        # The loop must keep beating while the reload blocks on `release`.
+        for _ in range(5):
+            await asyncio.sleep(0.01)
+            beats += 1
+        assert not reload_task.done()  # reload is parked on the worker thread
+        release.set()
+        resp = await asyncio.wait_for(reload_task, timeout=30)
+        return beats, resp
+
+    beats, resp = asyncio.run(go())
+    assert beats == 5
+    assert resp.status.error_code == 0
+    assert applied == [["DCN"]]
+
+
+# --------------------------------------------------------- CRC table safety
+
+
+def test_crc_table_eager_and_thread_consistent():
+    """The table exists fully-built at import; hammering crc32c from many
+    threads yields one consistent answer (the lazy-init race corrupted
+    first-call results when the request-log writer raced warmup replay)."""
+    from distributed_tf_serving_tpu.serving import warmup
+
+    assert len(warmup._CRC_TABLE) == 256
+    assert warmup._crc_table() is warmup._CRC_TABLE
+    # Known-answer check (CRC32C of b"123456789" is the classic vector).
+    assert warmup.crc32c(b"123456789") == 0xE3069283
+
+    data = np.random.RandomState(0).bytes(4096)
+    want = warmup.crc32c(data)
+    results = []
+    threads = [
+        threading.Thread(target=lambda: results.append(warmup.crc32c(data)))
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [want] * 8

@@ -112,20 +112,18 @@ def main() -> None:
         # Stall both dispatch paths identically so the A/B isolates the
         # host-side assembly cost, not the stall.
         orig_exec = batcher._execute
-        orig_fused = batcher._try_execute_fused
+        orig_fused = batcher._execute_fused
 
-        def slow_exec(sv, arrays):
+        def slow_exec(sv, arrays, *args, **kwargs):
             time.sleep(delay_s)
-            return orig_exec(sv, arrays)
+            return orig_exec(sv, arrays, *args, **kwargs)
 
-        def slow_fused(group, bucket):
-            out = orig_fused(group, bucket)
-            if out is not None:
-                time.sleep(delay_s)
-            return out
+        def slow_fused(ctx, bucket, *args, **kwargs):
+            time.sleep(delay_s)
+            return orig_fused(ctx, bucket, *args, **kwargs)
 
         batcher._execute = slow_exec
-        batcher._try_execute_fused = slow_fused
+        batcher._execute_fused = slow_fused
     servable = Servable(
         name="DCN", version=1, model=model, params=params,
         signatures=ctr_signatures(NUM_FIELDS),
