@@ -983,23 +983,41 @@ def child_main() -> None:
         request_trace.reset()  # warmup compiles out of the phase means
         res: dict = {}
 
+        def merge_resilience(counters: dict) -> None:
+            """Accumulate per-loop client resilience counters into the
+            report (event counts sum across loops; the scoreboard snapshot
+            keeps the latest)."""
+            agg = res.setdefault("resilience_client", {})
+            for k, v in counters.items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+                else:
+                    agg[k] = v
+
         def make_loop(port):
             async def loop(pool=None, rpw=scale.requests_per_worker,
                            prepared=False, conc=scale.concurrency):
                 async with ShardedPredictClient(
                     [f"127.0.0.1:{port}"], "DCN",
                     channels_per_host=scale.channels_per_host,
+                    # Scoreboard on: the resilience block reports real EWMA/
+                    # event counters for the headline windows (pure
+                    # bookkeeping — no hedging/failover unless configured).
+                    scoreboard=True,
                 ) as client:
-                    return await run_closed_loop(
-                        client,
-                        payload,
-                        concurrency=conc,
-                        requests_per_worker=rpw,
-                        sort_scores=True,
-                        warmup_requests=5,
-                        payload_pool=pool,
-                        prepared=prepared,
-                    )
+                    try:
+                        return await run_closed_loop(
+                            client,
+                            payload,
+                            concurrency=conc,
+                            requests_per_worker=rpw,
+                            sort_scores=True,
+                            warmup_requests=5,
+                            payload_pool=pool,
+                            prepared=prepared,
+                        )
+                    finally:
+                        merge_resilience(client.resilience_counters())
 
             return loop
 
@@ -1020,7 +1038,7 @@ def child_main() -> None:
                     d = dataclasses.replace(after)
                     for f in ("batches", "requests", "candidates",
                               "padded_candidates", "fill_waits",
-                              "fused_batches", "topk_batches",
+                              "fused_batches", "topk_batches", "deadline_sheds",
                               "bytes_downloaded", "bytes_download_full_f32",
                               "readback_window_s", "readback_blocked_s"):
                         setattr(d, f, getattr(after, f) - getattr(before, f))
@@ -1365,6 +1383,14 @@ def child_main() -> None:
                 "output_wire_dtype": batcher.output_wire_dtype,
                 "async_readback": batcher.async_readback,
                 "pipelined_dispatch": batcher.pipelined_dispatch,
+            },
+            # Resilience layer (ISSUE 2): server-side deadline sheds plus
+            # the headline client's scoreboard/hedge/partial counters —
+            # zero in a healthy closed loop; the chaos soak and the
+            # deterministic tests are where they move.
+            "resilience": {
+                "deadline_sheds": batcher.stats.deadline_sheds,
+                "client": res.get("resilience_client"),
             },
             # Measured latency operating point (VERDICT r4 task 4): the
             # minus-rtt variant is the architecture's p50 with the rig's

@@ -9,13 +9,17 @@ from .bench import (
 )
 from .client import (
     PredictClientError,
+    PredictResult,
     PreparedRequest,
+    ResilienceCounters,
     ShardedPredictClient,
     build_predict_request,
     client_from_config,
     compact_payload,
+    keepalive_channel_options,
     predict_sync,
 )
+from .health import BackendScoreboard, ScoreboardConfig
 from .partition import (
     merge_host_order,
     partition_bounds,
@@ -27,7 +31,12 @@ from .partition import (
 __all__ = [
     "ShardedPredictClient",
     "PredictClientError",
+    "PredictResult",
     "PreparedRequest",
+    "ResilienceCounters",
+    "BackendScoreboard",
+    "ScoreboardConfig",
+    "keepalive_channel_options",
     "build_predict_request",
     "client_from_config",
     "compact_payload",

@@ -19,12 +19,14 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import random
+import time
 
 import grpc
 import grpc.aio
 import numpy as np
 
-from .. import codec
+from .. import codec, faults
 from ..proto import serving_apis_pb2 as apis
 # LARGE_MESSAGE_CHANNEL_OPTIONS re-exported: transport tuning lives with
 # the grpc wiring, but callers historically reach it through the client.
@@ -32,7 +34,8 @@ from ..proto.service_grpc import (  # noqa: F401
     LARGE_MESSAGE_CHANNEL_OPTIONS,
     PredictionServiceStub,
 )
-from .partition import merge_host_order, shard_candidates
+from .health import HALF_OPEN, BackendScoreboard
+from .partition import merge_host_order, partition_bounds, shard_candidates
 
 
 class PredictClientError(RuntimeError):
@@ -40,6 +43,64 @@ class PredictClientError(RuntimeError):
         super().__init__(f"Predict to {host} failed: {code} {details}")
         self.host = host
         self.code = code
+
+
+def keepalive_channel_options(
+    keepalive_time_ms: int = 10_000, keepalive_timeout_ms: int = 5_000
+) -> tuple[tuple[str, int], ...]:
+    """HTTP/2 keepalive pings for the long-lived backend channels: a
+    silently-dead backend (power loss, network partition — no FIN, no RST)
+    is detected within time+timeout instead of hanging every in-flight RPC
+    until its full deadline. max_pings_without_data=0 +
+    permit_without_calls=1 keep the probe running on an idle channel too,
+    so the FIRST request after an idle period doesn't eat the discovery."""
+    return (
+        ("grpc.keepalive_time_ms", int(keepalive_time_ms)),
+        ("grpc.keepalive_timeout_ms", int(keepalive_timeout_ms)),
+        ("grpc.http2.max_pings_without_data", 0),
+        ("grpc.keepalive_permit_without_calls", 1),
+    )
+
+
+@dataclasses.dataclass
+class ResilienceCounters:
+    """Client-side resilience events (bench.py / soak report these)."""
+
+    hedges_fired: int = 0
+    hedges_won: int = 0
+    failovers: int = 0
+    backoff_sleeps: int = 0
+    partial_responses: int = 0
+
+
+@dataclasses.dataclass
+class PredictResult:
+    """predict()'s return shape in partial-results mode.
+
+    `scores` holds the merged candidates of every shard that ANSWERED, in
+    host order; `missing_ranges` are the [start, end) candidate ranges of
+    shards whose failover chain exhausted (empty when nothing failed);
+    `degraded` flags the partial case so callers cannot mistake a reduced
+    candidate set for a full ranking."""
+
+    scores: np.ndarray
+    missing_ranges: tuple[tuple[int, int], ...] = ()
+    degraded: bool = False
+
+
+class _ShardAttemptError(Exception):
+    """Internal: one failed shard attempt, tagged with the backend that
+    failed it (the failover loop and hedge arbiter route on this)."""
+
+    def __init__(self, host_idx: int, code, details: str):
+        super().__init__(details)
+        self.host_idx = host_idx
+        self.code = code  # grpc.StatusCode-like (has .name)
+        self.details = details
+
+    @property
+    def code_name(self) -> str:
+        return getattr(self.code, "name", str(self.code))
 
 
 @dataclasses.dataclass
@@ -144,6 +205,14 @@ class ShardedPredictClient:
         failover_attempts: int = 0,
         version_label: str | None = None,
         channel_credentials: "grpc.ChannelCredentials | None" = None,
+        scoreboard: "BackendScoreboard | bool | None" = None,
+        hedge_delay_s: float = 0.0,
+        backoff_initial_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        partial_results: bool = False,
+        health_probe: bool = False,
+        keepalive_time_ms: int = 10_000,
+        keepalive_timeout_ms: int = 5_000,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -170,12 +239,52 @@ class ShardedPredictClient:
         # so the host-order merge semantics are untouched. 0 = reference
         # fail-fast behavior.
         self.failover_attempts = max(0, failover_attempts)
+        # --- resilience layer (client/health.py) --------------------------
+        # scoreboard=True builds a default BackendScoreboard; an instance is
+        # used as-is (tests inject a deterministic clock); None/False keeps
+        # PR 1's blind next-host rotation.
+        if scoreboard is True:
+            scoreboard = BackendScoreboard(self.hosts)
+        self.scoreboard: BackendScoreboard | None = scoreboard or None
+        # Hedged shard RPCs: after this delay with no answer, fire a second
+        # attempt on another healthy host — first answer wins, the loser is
+        # cancelled. 0 = off. Tames the sick-backend tail at the cost of
+        # bounded duplicate work (the hedge only exists while the primary
+        # is already slower than the healthy-path p99 ought to be).
+        self.hedge_delay_s = max(0.0, hedge_delay_s)
+        # Jittered exponential backoff BETWEEN failover attempts: a backend
+        # failing under overload (RESOURCE_EXHAUSTED) must not receive the
+        # whole fleet's synchronized retry storm. Jitter is 0.5x-1.5x from
+        # an ENTROPY-seeded RNG — a fixed seed would hand every client the
+        # same draw sequence and re-synchronize the storm; tests that need
+        # determinism set backoff_initial_s=0 or replace _jitter.
+        self.backoff_initial_s = max(0.0, backoff_initial_s)
+        self.backoff_max_s = max(self.backoff_initial_s, backoff_max_s)
+        self._jitter = random.Random()
+        # Partial-result mode: a shard whose failover chain exhausts yields
+        # a DEGRADED merge (PredictResult.missing_ranges) instead of
+        # failing the whole request — every shard failing still raises.
+        self.partial_results = partial_results
+        # Half-open ejected backends get a grpc.health.v1 Check before any
+        # real traffic when enabled (needs a scoreboard to matter).
+        self.health_probe = health_probe
+        self.counters = ResilienceCounters()
+        self._health_stubs: list[object | None] = [None] * len(self.hosts)
         # Long-lived plaintext channels per host, created once and shared
         # (DCNClient.java:118-125). channels_per_host > 1 stripes requests
         # over several HTTP/2 connections — one connection's flow-control
         # window throttles a half-MB-per-request load at high concurrency.
         self.channels_per_host = max(1, channels_per_host)
         opts = list(LARGE_MESSAGE_CHANNEL_OPTIONS)
+        if keepalive_time_ms > 0:
+            # keepalive_time_ms=0 opts out entirely — for channels toward
+            # stock gRPC backends whose default ping-abuse policy (5-minute
+            # min interval, 2 strikes) would GOAWAY a 10s pinger. The
+            # in-tree servers carry KEEPALIVE_SERVER_OPTIONS and tolerate
+            # these pings.
+            opts += list(
+                keepalive_channel_options(keepalive_time_ms, keepalive_timeout_ms)
+            )
         # TLS when the server runs --ssl-config-file: pass
         # grpc.ssl_channel_credentials(root_certificates=..., [+ client key/
         # cert for mTLS]); None keeps the reference's plaintext channels.
@@ -204,32 +313,264 @@ class ShardedPredictClient:
     async def __aexit__(self, *exc):
         await self.close()
 
-    async def _shard_call(self, i: int, rr: int, invoke) -> np.ndarray:
-        """One shard's RPC with failover: `invoke(stub)` issues the call on
-        the chosen stub (message path uses stub.Predict, prepared-bytes path
-        stub.PredictRaw); host rotation, reroutable-status retry, and error
-        wrapping are shared here so the two paths cannot diverge."""
-        for attempt in range(self.failover_attempts + 1):
-            host_idx = (i + attempt) % len(self.hosts)
-            stubs = self._stubs[host_idx]
+    async def _one_rpc(self, i: int, rr: int, host_idx: int, invoke):
+        """One attempt on one backend: fault site, scoreboard recording,
+        error tagging. Raises _ShardAttemptError on failure."""
+        host = self.hosts[host_idx]
+        stubs = self._stubs[host_idx]
+        t0 = time.perf_counter()
+        try:
+            if faults.active():
+                # Named fault site (faults.py): a rule keyed on this host
+                # can delay/fail/wedge exactly one backend of the fan-out.
+                # Bounded by the RPC timeout so an injected WEDGE presents
+                # exactly like a hung backend does on the wire: this
+                # attempt dies DEADLINE_EXCEEDED after timeout_s.
+                try:
+                    await asyncio.wait_for(
+                        faults.fire_async("client.rpc", key=host),
+                        timeout=self.timeout_s,
+                    )
+                except asyncio.TimeoutError:
+                    raise faults.InjectedFaultError(
+                        "client.rpc", "DEADLINE_EXCEEDED",
+                        f"injected wedge at {host} outlived the RPC deadline",
+                    ) from None
             # rr advances once per logical request (not per shard), so shard
             # i of request r lands on channel (r + i) % k: consecutive
             # requests stripe every host's channels even when the shard
             # count divides k.
+            resp = await invoke(stubs[(rr + i) % len(stubs)])
+        except asyncio.CancelledError:
+            if self.scoreboard is not None:
+                # The attempt resolved neither way: free any half-open
+                # probe slot this host_idx holds, or a recovered backend
+                # whose probe got cancelled (caller timeout, shutdown)
+                # would be skipped by steering forever.
+                self.scoreboard.release_probe(host_idx)
+            raise
+        except (grpc.aio.AioRpcError, faults.InjectedFaultError) as e:
+            code = e.code()
+            code_name = getattr(code, "name", str(code))
+            if self.scoreboard is not None:
+                if code_name in _FAILOVER_CODES:
+                    self.scoreboard.record_failure(host_idx)
+                else:
+                    # A deterministic request error PROVES the backend is
+                    # alive and answering — that is a health success.
+                    self.scoreboard.record_success(
+                        host_idx, time.perf_counter() - t0
+                    )
+            raise _ShardAttemptError(host_idx, code, e.details()) from e
+        if self.scoreboard is not None:
+            self.scoreboard.record_success(host_idx, time.perf_counter() - t0)
+        return resp
+
+    def _hedge_target(self, used: list[int]) -> int | None:
+        """Extra host for a hedged attempt: the scoreboard's best healthy
+        candidate, or (scoreboard-less) the next host in rotation."""
+        if self.scoreboard is not None:
+            return self.scoreboard.hedge_target(exclude=tuple(used))
+        n = len(self.hosts)
+        for k in range(1, n):
+            h = (used[0] + k) % n
+            if h not in used:
+                return h
+        return None
+
+    @staticmethod
+    async def _first_success(pending: set):
+        """First task to complete SUCCESSFULLY wins; _ShardAttemptErrors
+        are tolerated while any task is still running (a primary failure
+        lets the in-flight hedge finish — it is the de-facto failover).
+        Returns the winning TASK; raises the first failure when every task
+        failed. Cleanup (cancel + exception reaping) is the caller's."""
+        first_exc: _ShardAttemptError | None = None
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in done:
+                if t.cancelled():
+                    continue
+                exc = t.exception()
+                if exc is None:
+                    return t
+                if isinstance(exc, _ShardAttemptError):
+                    if first_exc is None:
+                        first_exc = exc
+                else:
+                    raise exc
+        raise first_exc  # every attempt failed
+
+    async def _attempt(self, i: int, rr: int, host_idx: int, invoke, used: list[int]):
+        """One failover attempt, optionally hedged: the primary RPC runs on
+        `host_idx`; after hedge_delay_s without an answer a second attempt
+        fires on another healthy host — first ANSWER wins, the loser is
+        cancelled. Hosts burned here are appended to `used` so the outer
+        loop never re-tries them."""
+        if not self.hedge_delay_s or len(self.hosts) < 2:
+            # No task wrapper: the coroutine is awaited inline, so an outer
+            # cancellation (gather's sibling-cancel on another shard's
+            # failure, a caller timeout) cancels the RPC itself instead of
+            # orphaning a detached task.
+            return await self._one_rpc(i, rr, host_idx, invoke)
+        primary = asyncio.ensure_future(self._one_rpc(i, rr, host_idx, invoke))
+        tasks: dict = {primary: host_idx}
+        try:
+            done, _ = await asyncio.wait({primary}, timeout=self.hedge_delay_s)
+            hedge = None
+            if not done:
+                hedge_idx = self._hedge_target(used)
+                if hedge_idx is not None:
+                    used.append(hedge_idx)
+                    self.counters.hedges_fired += 1
+                    hedge = asyncio.ensure_future(
+                        self._one_rpc(i, rr, hedge_idx, invoke)
+                    )
+                    tasks[hedge] = hedge_idx
+            winner = await self._first_success(set(tasks))
+            if winner is hedge:
+                self.counters.hedges_won += 1
+            return winner.result()
+        finally:
+            # Runs on EVERY exit — win, both-failed, outer cancellation:
+            # cancel stragglers (freeing any half-open probe slot they
+            # hold) and retrieve every finished task's exception so none
+            # surfaces as 'Task exception was never retrieved'.
+            for t, h in tasks.items():
+                if not t.done():
+                    t.cancel()
+                    if self.scoreboard is not None:
+                        self.scoreboard.release_probe(h)
+            for t in tasks:
+                if t.done() and not t.cancelled():
+                    t.exception()
+                else:
+                    try:
+                        await t
+                    except BaseException:  # noqa: BLE001 — reaping only
+                        pass
+
+    async def _health_check_ok(self, host_idx: int) -> bool:
+        """grpc.health.v1 Check on the host's first channel (overall server
+        health, service \"\") — the cheap half-open probe that never costs a
+        real request its latency."""
+        from ..proto import health as health_proto
+
+        stub = self._health_stubs[host_idx]
+        if stub is None:
+            stub = self._health_stubs[host_idx] = health_proto.HealthStub(
+                self._channels[host_idx][0]
+            )
+        try:
+            resp = await stub.Check(
+                health_proto.HealthCheckRequest(""),
+                timeout=min(self.timeout_s, 2.0),
+            )
+        except grpc.aio.AioRpcError as e:
+            if getattr(e.code(), "name", "") == "UNIMPLEMENTED":
+                # Backend build without the health service: the answer
+                # PROVES it is alive — inconclusive, so fall through to
+                # the real-request probe instead of re-ejecting forever.
+                return True
+            return False
+        except Exception:  # noqa: BLE001 — any other probe failure = down
+            return False
+        return resp.status == health_proto.SERVING
+
+    async def _shard_call(self, i: int, rr: int, invoke) -> np.ndarray:
+        """One shard's RPC with failover: `invoke(stub)` issues the call on
+        the chosen stub (message path uses stub.Predict, prepared-bytes path
+        stub.PredictRaw); host steering (scoreboard when present, blind
+        rotation otherwise), hedging, jittered backoff, reroutable-status
+        retry, and error wrapping are shared here so the message and
+        prepared-bytes paths cannot diverge."""
+        n = len(self.hosts)
+        used: list[int] = []
+        last: _ShardAttemptError | None = None
+        for attempt in range(self.failover_attempts + 1):
+            if self.scoreboard is not None:
+                host_idx = self.scoreboard.pick(i % n, exclude=tuple(used))
+            else:
+                host_idx = next(
+                    (
+                        h
+                        for h in ((i + attempt + k) % n for k in range(n))
+                        if h not in used
+                    ),
+                    None,
+                )
+            if host_idx is None:
+                # Every host already burned (hedges count too): wrap around
+                # and reuse the rotation — the pre-scoreboard failover
+                # retried the same host (transient errors DO clear on
+                # retry-with-backoff), and the attempt budget still bounds
+                # total work. Both fallbacks always yield a host.
+                host_idx = (
+                    self.scoreboard.pick((i + attempt) % n)
+                    if self.scoreboard is not None
+                    else (i + attempt) % n
+                )
+            used.append(host_idx)
             try:
-                resp = await invoke(stubs[(rr + i) % len(stubs)])
-            except grpc.aio.AioRpcError as e:
-                code_name = getattr(e.code(), "name", str(e.code()))
+                # From here to the RPC the attempt may be CANCELLED (caller
+                # timeout, a sibling shard's failure cancelling the gather)
+                # while this host_idx holds a half-open probe slot pick()
+                # just granted — the except below releases it, or the
+                # backend would be steered around forever (_one_rpc covers
+                # only its own await).
+                if attempt and self.backoff_initial_s:
+                    # Exponential with 0.5x-1.5x jitter: retries decorrelate
+                    # across clients instead of synchronizing into a storm.
+                    base = min(
+                        self.backoff_initial_s * (2 ** (attempt - 1)),
+                        self.backoff_max_s,
+                    )
+                    self.counters.backoff_sleeps += 1
+                    await asyncio.sleep(base * (0.5 + self._jitter.random()))
                 if (
-                    attempt < self.failover_attempts
-                    and code_name in _FAILOVER_CODES
+                    self.health_probe
+                    and self.scoreboard is not None
+                    and self.scoreboard.state(host_idx) == HALF_OPEN
                 ):
+                    if not await self._health_check_ok(host_idx):
+                        # Probe says still down: re-eject (doubled interval)
+                        # without burning a real RPC + timeout on it.
+                        self.scoreboard.record_failure(host_idx)
+                        if last is None:
+                            last = _ShardAttemptError(
+                                host_idx,
+                                grpc.StatusCode.UNAVAILABLE,
+                                "health probe reported not serving",
+                            )
+                        continue
+                resp = await self._attempt(i, rr, host_idx, invoke, used)
+            except asyncio.CancelledError:
+                if self.scoreboard is not None:
+                    self.scoreboard.release_probe(host_idx)
+                raise
+            except _ShardAttemptError as e:
+                last = e
+                if attempt < self.failover_attempts and e.code_name in _FAILOVER_CODES:
+                    self.counters.failovers += 1
                     continue  # reroute this shard to the next host
                 raise PredictClientError(
-                    self.hosts[host_idx], e.code(), e.details()
+                    self.hosts[e.host_idx], e.code, e.details
                 ) from e
             return codec.to_ndarray(resp.outputs[self.output_key])
-        raise AssertionError("unreachable: loop always returns or raises")
+        assert last is not None, "exhaustion implies at least one failure"
+        raise PredictClientError(
+            self.hosts[last.host_idx], last.code, last.details
+        ) from last
+
+    def resilience_counters(self) -> dict:
+        """Client-side resilience events + per-backend scoreboard state —
+        the block bench.py and tools/soak.py report."""
+        out = dataclasses.asdict(self.counters)
+        if self.scoreboard is not None:
+            out["scoreboard"] = self.scoreboard.snapshot()
+        return out
 
     async def _predict_shard(self, i: int, shard: dict[str, np.ndarray], rr: int) -> np.ndarray:
         req = build_predict_request(
@@ -244,10 +585,19 @@ class ShardedPredictClient:
             i, rr, lambda stub: stub.Predict(req, timeout=self.timeout_s)
         )
 
-    async def _fan_out(self, shard_coros: list, sort_scores: bool) -> np.ndarray:
+    async def _fan_out(
+        self,
+        shard_coros: list,
+        sort_scores: bool,
+        bounds: list[tuple[int, int]] | None = None,
+    ) -> "np.ndarray | PredictResult":
         """Await the per-shard coroutines (concurrently or in host order),
         host-order merge, optional ascending sort (Collections.sort parity,
-        DCNClient.java:195)."""
+        DCNClient.java:195). In partial-results mode (`bounds` carries the
+        per-shard candidate ranges) shards whose failover chain exhausted
+        degrade the merge instead of failing it."""
+        if bounds is not None:
+            return await self._fan_out_partial(shard_coros, sort_scores, bounds)
         if len(shard_coros) == 1:
             # Degenerate fan-out: await the one RPC directly — gather()'s
             # task + future machinery costs several event-loop callbacks per
@@ -271,17 +621,58 @@ class ShardedPredictClient:
             merged = np.sort(merged)
         return merged
 
+    async def _fan_out_partial(
+        self, shard_coros: list, sort_scores: bool, bounds: list[tuple[int, int]]
+    ) -> PredictResult:
+        """Degraded-merge fan-out: failed shards become missing_ranges.
+        Shards are awaited concurrently regardless of full_async — the
+        sequential mode's early-abort semantics make no sense when failures
+        are survivable. Every shard failing still raises (an empty result
+        would read as 'zero candidates scored well')."""
+        results = await asyncio.gather(*shard_coros, return_exceptions=True)
+        for r in results:
+            # Anything but a per-shard RPC failure is a client bug (or a
+            # cancellation) and must not be laundered into a degraded merge.
+            if isinstance(r, BaseException) and not isinstance(r, PredictClientError):
+                raise r
+        failed = [k for k, r in enumerate(results) if isinstance(r, BaseException)]
+        if len(failed) == len(results):
+            raise results[0]  # total outage: degraded mode has nothing to merge
+        if not failed:
+            merged = merge_host_order(list(results))
+            if sort_scores:
+                merged = np.sort(merged)
+            return PredictResult(scores=merged)
+        self.counters.partial_responses += 1
+        merged = merge_host_order(
+            [r for r in results if not isinstance(r, BaseException)]
+        )
+        if sort_scores:
+            merged = np.sort(merged)
+        return PredictResult(
+            scores=merged,
+            missing_ranges=tuple(bounds[k] for k in failed),
+            degraded=True,
+        )
+
     async def predict(
         self, arrays: dict[str, np.ndarray], sort_scores: bool = False
-    ) -> np.ndarray:
+    ) -> "np.ndarray | PredictResult":
         """One logical request: shard -> concurrent RPCs -> host-order merge
-        (-> ascending sort when ranking semantics are wanted)."""
+        (-> ascending sort when ranking semantics are wanted). Returns a
+        PredictResult (possibly degraded) when partial_results is on, the
+        plain merged score vector otherwise."""
         shards = shard_candidates(arrays, len(self.hosts))
         self._rr += 1
         rr = self._rr
+        n = next(iter(arrays.values())).shape[0]
+        bounds = (
+            partition_bounds(n, len(shards)) if self.partial_results else None
+        )
         return await self._fan_out(
             [self._predict_shard(i, s, rr) for i, s in enumerate(shards)],
             sort_scores,
+            bounds=bounds,
         )
 
     def prepare(self, arrays: dict[str, np.ndarray]) -> PreparedRequest:
@@ -309,17 +700,24 @@ class ShardedPredictClient:
 
     async def predict_prepared(
         self, prep: PreparedRequest, sort_scores: bool = False
-    ) -> np.ndarray:
+    ) -> "np.ndarray | PredictResult":
         """predict() over pre-serialized shard bytes: identical wire traffic
-        and merge/sort semantics, none of the per-call build+serialize."""
+        and merge/sort semantics (including partial-results degradation),
+        none of the per-call build+serialize."""
         self._rr += 1
         rr = self._rr
+        bounds = (
+            partition_bounds(prep.candidates, len(prep.shard_blobs))
+            if self.partial_results
+            else None
+        )
         return await self._fan_out(
             [
                 self._predict_shard_raw(i, b, rr)
                 for i, b in enumerate(prep.shard_blobs)
             ],
             sort_scores,
+            bounds=bounds,
         )
 
 
@@ -327,6 +725,19 @@ def client_from_config(cfg) -> ShardedPredictClient:
     """ShardedPredictClient from a utils.config.ClientConfig — every
     reference knob (DCNClient.java:25-40) lands on the matching client
     parameter, including the sync/async mode flag."""
+    from .health import ScoreboardConfig
+
+    scoreboard = (
+        BackendScoreboard(
+            list(cfg.hosts),
+            ScoreboardConfig(
+                failure_threshold=cfg.ejection_failures,
+                ejection_s=cfg.ejection_interval_s,
+            ),
+        )
+        if cfg.health_scoreboard
+        else None
+    )
     return ShardedPredictClient(
         list(cfg.hosts),
         model_name=cfg.model_name,
@@ -338,6 +749,14 @@ def client_from_config(cfg) -> ShardedPredictClient:
         failover_attempts=cfg.failover_attempts,
         version_label=cfg.version_label or None,
         channel_credentials=_credentials_from_config(cfg),
+        scoreboard=scoreboard,
+        hedge_delay_s=cfg.hedge_delay_ms / 1e3,
+        backoff_initial_s=cfg.backoff_initial_ms / 1e3,
+        backoff_max_s=cfg.backoff_max_ms / 1e3,
+        partial_results=cfg.partial_results,
+        health_probe=cfg.health_probe,
+        keepalive_time_ms=cfg.keepalive_time_ms,
+        keepalive_timeout_ms=cfg.keepalive_timeout_ms,
     )
 
 

@@ -1,0 +1,217 @@
+"""Per-backend health scoreboard — health-aware shard placement + failover.
+
+The reference printed a failed shard and dropped it (DCNClient.java:158-159);
+PR 1's failover rotated blindly to the next host — a wedged backend still
+costs a full timeout per shard attempt, every request, until someone
+restarts it. Production fan-out serving ("Scaling TensorFlow to 300 million
+predictions per second") routes AROUND sick backends instead:
+
+- **EWMA latency** per backend (observability + the hedge-target ranking);
+- **consecutive-failure ejection**: after `failure_threshold` consecutive
+  reroutable failures the backend is ejected for `ejection_s` (doubling per
+  repeat up to `max_ejection_s`);
+- **half-open probing**: once the ejection interval passes, exactly ONE
+  in-flight request (or an explicit grpc.health.v1 Check, see
+  client.ShardedPredictClient.health_probe) is allowed through; success
+  recovers the backend, failure re-ejects it with a doubled interval.
+
+The scoreboard only STEERS (pick()); the client still owns retry/hedge
+mechanics. Pure in-process bookkeeping: one lock, an injectable clock so
+the ejection/half-open timeline is deterministic under test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+HEALTHY, EJECTED, HALF_OPEN = "healthy", "ejected", "half_open"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoreboardConfig:
+    # Consecutive reroutable failures before ejection. 1 would eject on any
+    # single blip; 3 tolerates isolated packet-loss-shaped noise while still
+    # reacting within one request burst to a genuinely down backend.
+    failure_threshold: int = 3
+    # First ejection interval; doubles on each half-open probe failure.
+    ejection_s: float = 5.0
+    max_ejection_s: float = 60.0
+    # EWMA smoothing for per-backend latency (0 < alpha <= 1).
+    ewma_alpha: float = 0.2
+
+
+@dataclasses.dataclass
+class _HostState:
+    state: str = HEALTHY
+    consecutive_failures: int = 0
+    ejected_until: float = 0.0
+    current_ejection_s: float = 0.0
+    probe_inflight: bool = False
+    ewma_ms: float | None = None
+    successes: int = 0
+    failures: int = 0
+
+
+class BackendScoreboard:
+    """Thread-safe (asyncio callbacks + any direct callers) per-backend
+    scoreboard over a FIXED host list, indexed like the client's."""
+
+    def __init__(
+        self,
+        hosts: list[str],
+        config: ScoreboardConfig | None = None,
+        clock=time.monotonic,
+    ):
+        if not hosts:
+            raise ValueError("need at least one backend host")
+        self.hosts = list(hosts)
+        self.config = config or ScoreboardConfig()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._states = [_HostState() for _ in self.hosts]
+        # Event counters (bench.py / soak report them; names are the
+        # acceptance-criteria vocabulary).
+        self.ejections = 0
+        self.probes = 0
+        self.recoveries = 0
+
+    # ------------------------------------------------------------ recording
+
+    def record_success(self, idx: int, latency_s: float | None = None) -> None:
+        with self._lock:
+            st = self._states[idx]
+            st.successes += 1
+            st.consecutive_failures = 0
+            if latency_s is not None:
+                ms = latency_s * 1e3
+                a = self.config.ewma_alpha
+                st.ewma_ms = ms if st.ewma_ms is None else (1 - a) * st.ewma_ms + a * ms
+            if st.state != HEALTHY:
+                # Half-open probe succeeded (or a raced request landed while
+                # ejected): the backend is back.
+                st.state = HEALTHY
+                st.probe_inflight = False
+                st.current_ejection_s = 0.0
+                self.recoveries += 1
+
+    def record_failure(self, idx: int) -> None:
+        with self._lock:
+            st = self._states[idx]
+            st.failures += 1
+            st.consecutive_failures += 1
+            if st.state == HALF_OPEN:
+                # Probe failed: re-eject with a doubled interval.
+                self._eject_locked(st, double=True)
+            elif (
+                st.state == HEALTHY
+                and st.consecutive_failures >= self.config.failure_threshold
+            ):
+                self._eject_locked(st, double=False)
+            elif st.state == EJECTED:
+                st.probe_inflight = False  # raced request while ejected
+
+    def _eject_locked(self, st: _HostState, double: bool) -> None:
+        interval = (
+            min(st.current_ejection_s * 2, self.config.max_ejection_s)
+            if double and st.current_ejection_s
+            else self.config.ejection_s
+        )
+        st.state = EJECTED
+        st.current_ejection_s = interval
+        st.ejected_until = self._clock() + interval
+        st.probe_inflight = False
+        self.ejections += 1
+
+    # ------------------------------------------------------------- steering
+
+    def _advance_locked(self, st: _HostState) -> None:
+        if st.state == EJECTED and self._clock() >= st.ejected_until:
+            st.state = HALF_OPEN
+            st.probe_inflight = False
+
+    def pick(self, preferred: int, exclude: tuple[int, ...] = ()) -> int | None:
+        """Backend index for a shard homed at `preferred`: the home host
+        when healthy — or HALF_OPEN with a free probe slot (the caller's
+        request IS the probe; without home-priority a half-open host would
+        be starved of probes forever while its healthy peers absorb the
+        rotation, and never recover) — else the first HEALTHY host rotating
+        from `preferred`, else any half-open host with a free slot, else —
+        everything ejected — the rotation's first non-excluded host
+        (sending somewhere beats failing without trying). None only when
+        every host is excluded (failover exhausted the list)."""
+        n = len(self.hosts)
+        order = [(preferred + k) % n for k in range(n) if (preferred + k) % n not in exclude]
+        if not order:
+            return None
+        with self._lock:
+            for i in order:
+                self._advance_locked(self._states[i])
+            home = self._states[order[0]]
+            if (
+                order[0] == preferred % n
+                and home.state == HALF_OPEN
+                and not home.probe_inflight
+            ):
+                home.probe_inflight = True
+                self.probes += 1
+                return order[0]
+            for i in order:
+                if self._states[i].state == HEALTHY:
+                    return i
+            for i in order:
+                st = self._states[i]
+                if st.state == HALF_OPEN and not st.probe_inflight:
+                    st.probe_inflight = True
+                    self.probes += 1
+                    return i
+            return order[0]
+
+    def state(self, idx: int) -> str:
+        with self._lock:
+            self._advance_locked(self._states[idx])
+            return self._states[idx].state
+
+    def release_probe(self, idx: int) -> None:
+        """Free a half-open probe slot whose request was CANCELLED (hedge
+        loser) — neither success nor failure was observed, so the slot must
+        not stay taken forever and starve future probes."""
+        with self._lock:
+            self._states[idx].probe_inflight = False
+
+    def hedge_target(self, exclude: tuple[int, ...]) -> int | None:
+        """Best extra host for a hedged attempt: healthy, lowest EWMA,
+        not already in use. None = nowhere sensible to hedge."""
+        with self._lock:
+            best, best_ms = None, None
+            for i, st in enumerate(self._states):
+                if i in exclude:
+                    continue
+                self._advance_locked(st)
+                if st.state != HEALTHY:
+                    continue
+                ms = st.ewma_ms if st.ewma_ms is not None else float("inf")
+                if best is None or ms < best_ms:
+                    best, best_ms = i, ms
+            return best
+
+    # ---------------------------------------------------------- observation
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ejections": self.ejections,
+                "probes": self.probes,
+                "recoveries": self.recoveries,
+                "backends": {
+                    host: {
+                        "state": st.state,
+                        "ewma_ms": round(st.ewma_ms, 3) if st.ewma_ms is not None else None,
+                        "consecutive_failures": st.consecutive_failures,
+                        "successes": st.successes,
+                        "failures": st.failures,
+                    }
+                    for host, st in zip(self.hosts, self._states)
+                },
+            }

@@ -1,0 +1,161 @@
+"""Hand-written grpc.health.v1 bindings (Check only).
+
+The standard `grpcio-health-checking` package is not in this image, and the
+two messages involved are trivial, so — like service_grpc.py — the wire
+format is written by hand and byte-compatible with the canonical
+health/v1/health.proto:
+
+    message HealthCheckRequest  { string service = 1; }
+    message HealthCheckResponse { ServingStatus status = 1; }
+    enum ServingStatus { UNKNOWN=0; SERVING=1; NOT_SERVING=2; SERVICE_UNKNOWN=3; }
+
+Standard health-checking clients (grpc_health_probe, Kubernetes gRPC
+probes, the upstream HealthStub) interoperate unchanged. Only the unary
+`Check` RPC is wired; `Watch` (server-streaming) is left unimplemented —
+the scoreboard's half-open probes and orchestration probes both poll.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+HEALTH_SERVICE_NAME = "grpc.health.v1.Health"
+
+# ServingStatus values (health.proto enum, canonical numbering).
+UNKNOWN = 0
+SERVING = 1
+NOT_SERVING = 2
+SERVICE_UNKNOWN = 3
+
+STATUS_NAMES = {
+    UNKNOWN: "UNKNOWN",
+    SERVING: "SERVING",
+    NOT_SERVING: "NOT_SERVING",
+    SERVICE_UNKNOWN: "SERVICE_UNKNOWN",
+}
+
+
+def _encode_varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _skip_field(data: bytes, pos: int, wire_type: int) -> int:
+    """Unknown-field tolerance: future additions to the canonical proto
+    must not break this parser."""
+    if wire_type == 0:  # varint
+        _, pos = _read_varint(data, pos)
+        return pos
+    if wire_type == 1:  # 64-bit
+        return pos + 8
+    if wire_type == 2:  # length-delimited
+        length, pos = _read_varint(data, pos)
+        return pos + length
+    if wire_type == 5:  # 32-bit
+        return pos + 4
+    raise ValueError(f"unsupported wire type {wire_type}")
+
+
+class HealthCheckRequest:
+    __slots__ = ("service",)
+
+    def __init__(self, service: str = ""):
+        self.service = service
+
+    def SerializeToString(self) -> bytes:
+        if not self.service:
+            return b""
+        payload = self.service.encode("utf-8")
+        return b"\x0a" + _encode_varint(len(payload)) + payload
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "HealthCheckRequest":
+        msg = cls()
+        pos = 0
+        while pos < len(data):
+            tag, pos = _read_varint(data, pos)
+            if tag == 0x0A:  # field 1, length-delimited
+                length, pos = _read_varint(data, pos)
+                msg.service = data[pos : pos + length].decode("utf-8")
+                pos += length
+            else:
+                pos = _skip_field(data, pos, tag & 0x07)
+        return msg
+
+
+class HealthCheckResponse:
+    __slots__ = ("status",)
+
+    def __init__(self, status: int = UNKNOWN):
+        self.status = status
+
+    def SerializeToString(self) -> bytes:
+        if not self.status:
+            return b""  # proto3: default-valued scalar is omitted
+        return b"\x08" + _encode_varint(self.status)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "HealthCheckResponse":
+        msg = cls()
+        pos = 0
+        while pos < len(data):
+            tag, pos = _read_varint(data, pos)
+            if tag == 0x08:  # field 1, varint
+                msg.status, pos = _read_varint(data, pos)
+            else:
+                pos = _skip_field(data, pos, tag & 0x07)
+        return msg
+
+
+class HealthStub:
+    """Client stub: `stub.Check(HealthCheckRequest(...), timeout=...)`.
+    Works on both sync and grpc.aio channels."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.Check = channel.unary_unary(
+            f"/{HEALTH_SERVICE_NAME}/Check",
+            request_serializer=HealthCheckRequest.SerializeToString,
+            response_deserializer=HealthCheckResponse.FromString,
+        )
+
+
+class HealthServicer:
+    """Service base class; override Check."""
+
+    def Check(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Check not implemented")
+
+
+def add_HealthServicer_to_server(servicer, server) -> None:
+    handlers = {
+        "Check": grpc.unary_unary_rpc_method_handler(
+            servicer.Check,
+            request_deserializer=HealthCheckRequest.FromString,
+            response_serializer=HealthCheckResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(HEALTH_SERVICE_NAME, handlers),)
+    )

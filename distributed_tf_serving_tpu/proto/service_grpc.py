@@ -29,6 +29,18 @@ LARGE_MESSAGE_CHANNEL_OPTIONS = (
     ("grpc.optimization_target", "throughput"),
 )
 
+# Server-side tolerance for client keepalive pings (the client channels run
+# grpc.keepalive_time_ms ~10s to detect silently-dead backends fast): grpc's
+# server default treats data-free pings more often than 5 minutes as abuse
+# and GOAWAYs the connection with ENHANCE_YOUR_CALM/too_many_pings — which
+# would turn the resilience feature into a connection-flapping bug. Both
+# server factories (serving/server.py) append these.
+KEEPALIVE_SERVER_OPTIONS = (
+    ("grpc.http2.min_recv_ping_interval_without_data_ms", 5000),
+    ("grpc.http2.max_ping_strikes", 0),  # never GOAWAY a keepalive-ing client
+    ("grpc.keepalive_permit_without_calls", 1),
+)
+
 # method name -> (request class, response class); order matches the reference
 # service definition.
 _METHODS = {

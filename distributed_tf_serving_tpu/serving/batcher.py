@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults
 from ..models.base import Model
 from ..models.registry import Servable
 from ..ops.transfer import (
@@ -99,6 +100,13 @@ class DeviceWedgedError(RuntimeError):
     New work fails fast (UNAVAILABLE) instead of burning a handler thread
     per request for the full RPC deadline; the breaker closes by itself the
     moment the stuck batch completes."""
+
+
+class RequestDeadlineError(TimeoutError):
+    """Queued work whose CLIENT deadline expired before a dispatch slot
+    opened: shed instead of executed — the caller stopped listening, so the
+    device time would buy nothing and delay everyone behind it. A
+    TimeoutError so the service's translator maps it to DEADLINE_EXCEEDED."""
 
 
 def bucket_for(n: int, buckets: tuple[int, ...]) -> int:
@@ -355,6 +363,9 @@ class _WorkItem:
     future: Future  # resolves to dict[str, np.ndarray]
     enqueue_t: float
     output_keys: tuple[str, ...] | None  # None = all model outputs
+    # Absolute perf_counter deadline propagated from the client RPC (None =
+    # no client deadline): expired items are shed pre-dispatch.
+    deadline_t: float | None = None
     # Warmup work legitimately spends minutes compiling on the batcher
     # thread; it must not read as a wedged device to the circuit breaker.
     warmup: bool = False
@@ -379,6 +390,9 @@ class BatcherStats:
     # Times coalescing waited past max_wait because the dispatch pipeline
     # was saturated (the wait was latency-free; see _coalesce_next).
     fill_waits: int = 0
+    # Queued items shed because their propagated client deadline expired
+    # before a dispatch slot opened (deadline propagation, ISSUE 2).
+    deadline_sheds: int = 0
     # D2H attribution: bytes actually fetched to the host (post-compaction
     # wire dtype, post output filter) vs. what a full-fp32 all-outputs
     # readback of the same batches would have moved.
@@ -602,11 +616,15 @@ class DynamicBatcher:
         servable: Servable,
         arrays: dict[str, np.ndarray],
         output_keys: tuple[str, ...] | None = None,
+        deadline_s: float | None = None,
         _warmup: bool = False,
     ) -> Future:
         """Enqueue one request's arrays; returns a Future of output arrays
         (sliced back to the request's own candidate count). output_keys limits
-        which model outputs are fetched back to the host.
+        which model outputs are fetched back to the host. deadline_s (when
+        given) is the CLIENT's remaining budget: an item still queued when it
+        expires is shed (RequestDeadlineError -> DEADLINE_EXCEEDED) before
+        wasting a dispatch slot.
 
         Admission control (SURVEY.md §5 failure-detection obligations): a
         wedged device fails the request immediately (DeviceWedgedError, and
@@ -643,13 +661,15 @@ class DynamicBatcher:
             self._queued_candidates += n
         fut: Future = Future()
         try:
+            now = time.perf_counter()
             item = _WorkItem(
                 servable=servable,
                 arrays=prepare_inputs(servable.model, arrays, fold_ids=False),
                 n=n,
                 future=fut,
-                enqueue_t=time.perf_counter(),
+                enqueue_t=now,
                 output_keys=output_keys,
+                deadline_t=(now + deadline_s) if deadline_s is not None else None,
                 warmup=_warmup,
             )
         except BaseException:
@@ -1112,6 +1132,48 @@ class DynamicBatcher:
                     out_keys=out_keys, topk=topk, n_valid=n_valid,
                 )
 
+    def _shed_expired_locked(self, it: _WorkItem) -> bool:
+        """True when `it`'s propagated client deadline already expired —
+        the item is failed (DEADLINE_EXCEEDED at the RPC layer) instead of
+        dispatched: its waiter stopped listening, so device time spent on
+        it would only delay the still-live work behind it. Caller holds
+        _cv and has already popped the item."""
+        if it.deadline_t is None or time.perf_counter() < it.deadline_t:
+            return False
+        self.stats.deadline_sheds += 1
+        if not it.future.done():
+            try:
+                it.future.set_exception(
+                    RequestDeadlineError(
+                        "client deadline expired while queued "
+                        f"({time.perf_counter() - it.enqueue_t:.3f}s); "
+                        "shed before dispatch"
+                    )
+                )
+            except InvalidStateError:
+                # The service-side wait times out at the SAME instant this
+                # deadline expires and cancels the future; losing that race
+                # must not kill the batcher thread (same guard as
+                # _complete's set_result).
+                pass
+        return True
+
+    def _drop_stale_locked(self, it: _WorkItem) -> bool:
+        """Staleness classification for a just-popped queue item — the ONE
+        implementation both _take and _coalesce_next use. Cancelled waiter:
+        skip the work, and when the item's propagated deadline has actually
+        EXPIRED count it as a deadline shed (the RPC wait expires at the
+        same instant and withdraws the future first — the common ordering
+        over gRPC; a cancellation BEFORE expiry, e.g. the service's 120s
+        bound firing under a looser client deadline, is not one).
+        Otherwise defer to the expiry shed. True = drop. Caller holds _cv
+        and has adjusted _queued_candidates."""
+        if it.future.cancelled():
+            if it.deadline_t is not None and time.perf_counter() >= it.deadline_t:
+                self.stats.deadline_sheds += 1
+            return True
+        return self._shed_expired_locked(it)
+
     def _take(self) -> _WorkItem | None:
         """Pop the next live queued item, blocking; None on shutdown after
         the queue drains (every accepted item is still served)."""
@@ -1120,8 +1182,8 @@ class DynamicBatcher:
                 while self._items:
                     it = self._items.popleft()
                     self._queued_candidates -= it.n
-                    if it.future.cancelled():
-                        continue  # waiter gave up (RPC deadline); skip the work
+                    if self._drop_stale_locked(it):
+                        continue  # cancelled waiter or expired deadline
                     return it
                 if self._stopping:
                     return None
@@ -1161,9 +1223,13 @@ class DynamicBatcher:
                         free_ride_counted = True
                     self._cv.wait(0.005)
                 nxt = self._items[0]
-                if nxt.future.cancelled():
+                if nxt.future.cancelled() or (
+                    nxt.deadline_t is not None
+                    and time.perf_counter() >= nxt.deadline_t
+                ):
                     self._items.popleft()
                     self._queued_candidates -= nxt.n
+                    self._drop_stale_locked(nxt)
                     continue
                 if (
                     nxt.servable is item.servable
@@ -1321,6 +1387,10 @@ class DynamicBatcher:
                     None if all(it.warmup for it in group) else time.perf_counter()
                 )
             servable = group[0].servable
+            # Named fault site (faults.py): delay/error/wedge the device
+            # stage of this batch — the stuck-device scenario the circuit
+            # breaker and deadline tests drive deterministically.
+            faults.fire("batcher.dispatch")
             with request_trace.span("batch.dispatch"):
                 if fused is not None:
                     outputs = self._execute_fused(
@@ -1419,6 +1489,8 @@ class DynamicBatcher:
         issue_t0: float | None = None, meta: dict | None = None,
     ) -> None:
         try:
+            # Named fault site (faults.py): a readback that stalls or dies.
+            faults.fire("readback")
             # The fetch: with async_readback the copy is already in flight
             # (issued at dispatch), so this measures the residual WAIT, not
             # a full synchronous transfer — the split the phase names carry.

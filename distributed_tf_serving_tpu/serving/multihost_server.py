@@ -247,6 +247,10 @@ def serve(argv=None) -> None:
     # ONE metrics instance across gRPC and REST (the monitoring-endpoint
     # aggregation contract, same as the single-host CLI).
     metrics = ServerMetrics()
+    # create_server registers grpc.health.v1 alongside Prediction/Model
+    # services: the leader answers standard health probes (and the fan-out
+    # client's half-open probing) with per-model status — the initial
+    # version is pre-seeded above, so "" reports SERVING from first bind.
     server, port = create_server(
         impl, f"{args.host}:{args.port}", args.max_workers, metrics,
         credentials=credentials,

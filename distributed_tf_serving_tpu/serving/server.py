@@ -26,11 +26,16 @@ import grpc
 import jax
 
 from ..models import ModelConfig, Servable, ServableRegistry, build_model, ctr_signatures
-from ..proto.service_grpc import LARGE_MESSAGE_CHANNEL_OPTIONS
+from ..proto.service_grpc import (
+    KEEPALIVE_SERVER_OPTIONS,
+    LARGE_MESSAGE_CHANNEL_OPTIONS,
+)
 from ..proto import (
+    add_HealthServicer_to_server,
     add_ModelServiceServicer_to_server,
     add_PredictionServiceServicer_to_server,
 )
+from ..proto import health as health_proto
 from ..utils.config import ServerConfig, load_config
 from ..utils.metrics import ServerMetrics
 from ..utils.tracing import request_trace
@@ -68,20 +73,53 @@ class _SyncServicerBase:
             self.metrics.observe(name, time.perf_counter() - t0, ok)
 
 
+def _deadline_of(context) -> float | None:
+    """The client's remaining budget from the RPC context (None = no
+    deadline), threaded into the impl so the batcher can shed expired work
+    instead of burning its fixed 120s bound on an abandoned request."""
+    remaining = context.time_remaining()
+    # grpc returns None when the client set no deadline; some transports
+    # report float('inf') — both mean "no client bound".
+    if remaining is None or remaining == float("inf"):
+        return None
+    return remaining
+
+
 class GrpcPredictionService(_SyncServicerBase):
-    """grpc servicer adapter: error mapping + per-RPC metrics."""
+    """grpc servicer adapter: error mapping + per-RPC metrics. The three
+    batching RPCs propagate the client deadline into the impl."""
 
     def Predict(self, request, context):
-        return self._call("Predict", self.impl.predict, request, context)
+        deadline_s = _deadline_of(context)
+        return self._call(
+            "Predict",
+            lambda req: self.impl.predict(req, deadline_s=deadline_s),
+            request, context,
+        )
 
     def Classify(self, request, context):
-        return self._call("Classify", self.impl.classify, request, context)
+        deadline_s = _deadline_of(context)
+        return self._call(
+            "Classify",
+            lambda req: self.impl.classify(req, deadline_s=deadline_s),
+            request, context,
+        )
 
     def Regress(self, request, context):
-        return self._call("Regress", self.impl.regress, request, context)
+        deadline_s = _deadline_of(context)
+        return self._call(
+            "Regress",
+            lambda req: self.impl.regress(req, deadline_s=deadline_s),
+            request, context,
+        )
 
     def MultiInference(self, request, context):
-        return self._call("MultiInference", self.impl.multi_inference, request, context)
+        deadline_s = _deadline_of(context)
+        return self._call(
+            "MultiInference",
+            lambda req: self.impl.multi_inference(req, deadline_s=deadline_s),
+            request, context,
+        )
 
     def GetModelMetadata(self, request, context):
         return self._call("GetModelMetadata", self.impl.get_model_metadata, request, context)
@@ -100,6 +138,67 @@ class GrpcModelService(_SyncServicerBase):
         )
 
 
+class GrpcHealthService:
+    """grpc.health.v1 Health over the serving state (proto/health.py glue;
+    standard health-checking clients and the fan-out client's half-open
+    probes both speak it):
+
+    - service "" (the whole server): SERVING once the load+warmup phase
+      completed (impl.warmup_complete — build_stack flips it) AND at least
+      one model has a ready version; NOT_SERVING before — a server still
+      compiling its bucket ladder must not receive traffic.
+    - service "<model>": SERVING when the registry holds a ready version;
+      NOT_SERVING when the server is CONFIGURED for the model (a watcher or
+      lifecycle owns it) but no version landed yet; grpc NOT_FOUND for
+      names this server was never told about (the health spec's
+      unknown-service answer).
+    """
+
+    def __init__(self, impl: PredictionServiceImpl):
+        self.impl = impl
+
+    def _status(self, service: str) -> int | None:
+        served = self.impl.registry.models()
+        if not service:
+            ready = any(served.values())
+            return (
+                health_proto.SERVING
+                if (self.impl.warmup_complete and ready)
+                else health_proto.NOT_SERVING
+            )
+        if served.get(service):
+            return health_proto.SERVING
+        # Same "configured" definition as GetModelStatus's START-vs-
+        # NOT_FOUND split, so the two probe surfaces can never disagree.
+        return (
+            health_proto.NOT_SERVING
+            if self.impl.is_configured(service)
+            else None
+        )
+
+    def Check(self, request, context):
+        st = self._status(request.service)
+        if st is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"unknown service {request.service!r}",
+            )
+        return health_proto.HealthCheckResponse(status=st)
+
+
+class AioGrpcHealthService(GrpcHealthService):
+    """Same status logic on the coroutine server (context.abort awaits)."""
+
+    async def Check(self, request, context):
+        st = self._status(request.service)
+        if st is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"unknown service {request.service!r}",
+            )
+        return health_proto.HealthCheckResponse(status=st)
+
+
 def create_server(
     impl: PredictionServiceImpl,
     address: str = "127.0.0.1:0",
@@ -112,12 +211,15 @@ def create_server(
     --ssl-config-file surface; see load_ssl_credentials)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="rpc"),
-        options=list(LARGE_MESSAGE_CHANNEL_OPTIONS),
+        options=list(LARGE_MESSAGE_CHANNEL_OPTIONS) + list(KEEPALIVE_SERVER_OPTIONS),
     )
     servicer = GrpcPredictionService(impl, metrics)
     add_PredictionServiceServicer_to_server(servicer, server)
     # Same port, second service — exactly tensorflow_model_server's layout.
     add_ModelServiceServicer_to_server(GrpcModelService(impl, servicer.metrics), server)
+    # Third service: grpc.health.v1 (standard probes + client half-open
+    # probing) — NOT_SERVING until warmup completes, per-model afterward.
+    add_HealthServicer_to_server(GrpcHealthService(impl), server)
     if credentials is not None:
         port = server.add_secure_port(address, credentials)
     else:
@@ -197,23 +299,62 @@ class AioGrpcPredictionService(_AioServicerBase):
     ~15% of achievable QPS at 64-way concurrency); the coroutine model keeps
     the hot paths on one thread and awaits the batcher future:
     Predict/Classify/Regress all ride their _async impl variants.
-    MultiInference and GetModelMetadata run their (cheap, synchronous)
-    bodies inline — MultiInference's sub-calls block the loop for their
-    batch, acceptable for its diagnostic traffic share (the reference's
-    entire workload is Predict, DCNClient.java:111-112).
+    GetModelMetadata runs its (cheap, synchronous) body inline;
+    MultiInference — whose sub-calls block on batcher futures for a
+    client-controlled deadline — dispatches to a worker thread so it can
+    never stall the loop that carries every other in-flight RPC.
     """
 
     async def Predict(self, request, context):
-        return await self._call("Predict", self.impl.predict_async, request, context)
+        deadline_s = _deadline_of(context)
+        return await self._call(
+            "Predict",
+            lambda req: self.impl.predict_async(req, deadline_s=deadline_s),
+            request, context,
+        )
 
     async def Classify(self, request, context):
-        return await self._call("Classify", self.impl.classify_async, request, context)
+        deadline_s = _deadline_of(context)
+        return await self._call(
+            "Classify",
+            lambda req: self.impl.classify_async(req, deadline_s=deadline_s),
+            request, context,
+        )
 
     async def Regress(self, request, context):
-        return await self._call("Regress", self.impl.regress_async, request, context)
+        deadline_s = _deadline_of(context)
+        return await self._call(
+            "Regress",
+            lambda req: self.impl.regress_async(req, deadline_s=deadline_s),
+            request, context,
+        )
 
     async def MultiInference(self, request, context):
-        return await self._call("MultiInference", self.impl.multi_inference, request, context)
+        import asyncio
+
+        # Off the event loop: multi_inference's sequential sub-calls BLOCK
+        # on batcher futures (there is no *_async variant), and with
+        # deadline propagation that stall window is client-controlled — one
+        # MultiInference with a long deadline against a saturated batcher
+        # must not freeze every other in-flight RPC.
+        deadline_s = _deadline_of(context)
+        entry_t = time.perf_counter()
+        loop = asyncio.get_running_loop()
+
+        def run(req, _fn=self.impl.multi_inference):
+            # Re-derive the REMAINING budget at executor start: time spent
+            # queued behind other executor work belongs to the client's
+            # budget, not on top of it.
+            left = (
+                None if deadline_s is None
+                else deadline_s - (time.perf_counter() - entry_t)
+            )
+            return _fn(req, deadline_s=left)
+
+        def dispatch(req):
+            return loop.run_in_executor(None, run, req)
+
+        return await self._call("MultiInference", dispatch, request, context)
 
     async def GetModelMetadata(self, request, context):
         return await self._call("GetModelMetadata", self.impl.get_model_metadata, request, context)
@@ -255,7 +396,7 @@ def create_server_async(
     """Build (not start) a grpc.aio server; returns (server, bound_port).
     Must be called from (or started on) the event loop that will own it."""
     server = grpc.aio.server(
-        options=list(LARGE_MESSAGE_CHANNEL_OPTIONS),
+        options=list(LARGE_MESSAGE_CHANNEL_OPTIONS) + list(KEEPALIVE_SERVER_OPTIONS),
     )
     servicer = AioGrpcPredictionService(impl, metrics)
     add_PredictionServiceServicer_to_server(servicer, server)
@@ -263,6 +404,8 @@ def create_server_async(
     add_ModelServiceServicer_to_server(
         AioGrpcModelService(impl, servicer.metrics), server
     )
+    # grpc.health.v1 on the coroutine server too (same status logic).
+    add_HealthServicer_to_server(AioGrpcHealthService(impl), server)
     port = server.add_insecure_port(address)
     if port == 0:
         raise RuntimeError(f"could not bind {address}")
@@ -576,6 +719,10 @@ def build_stack(
         donate_buffers=cfg.donate_buffers,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
+    # Health gating: the grpc.health.v1 servicer reports the overall server
+    # NOT_SERVING until the load+warmup phase below completes (standard
+    # probes and the client's half-open probing key off this).
+    impl.warmup_complete = False
 
     if model_configs is not None:
         watchers = _start_model_config_watchers(
@@ -598,6 +745,7 @@ def build_stack(
             sorted(served)[0] if served else None
         )
         servable = registry.resolve(ready) if ready else None
+        impl.warmup_complete = True
         return registry, batcher, impl, servable, mesh, watchers
     if model_base_path:
         if checkpoint or savedmodel:
@@ -641,6 +789,7 @@ def build_stack(
         else:
             servable = registry.resolve(cfg.model_name)
             log.info("serving %s versions %s from %s", cfg.model_name, versions, model_base_path)
+        impl.warmup_complete = True
         return registry, batcher, impl, servable, mesh, watcher
     if savedmodel:
         from ..interop import import_savedmodel
@@ -683,6 +832,7 @@ def build_stack(
     for label, version in cfg.version_labels:
         registry.set_label(cfg.model_name, label, version)
         log.info("label %r -> %s v%d", label, cfg.model_name, version)
+    impl.warmup_complete = True
     return registry, batcher, impl, servable, mesh, None
 
 

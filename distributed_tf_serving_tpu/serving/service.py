@@ -16,9 +16,11 @@ RESOURCE_EXHAUSTED (wired to codes in server.py via ServiceError.code).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
-from .. import codec
+from .. import codec, faults
 from ..utils.tracing import request_trace
 from ..models.registry import (
     ModelNotFoundError,
@@ -35,6 +37,7 @@ from .batcher import (
     DeviceWedgedError,
     DynamicBatcher,
     QueueOverloadError,
+    RequestDeadlineError,
 )
 from .example_codec import ExampleDecodeError, decode_input
 
@@ -62,6 +65,11 @@ class PredictionServiceImpl:
     def __init__(self, registry: ServableRegistry, batcher: DynamicBatcher):
         self.registry = registry
         self.batcher = batcher
+        # Flipped by build_stack around its load+warmup phase; the
+        # grpc.health.v1 servicer reports the overall server NOT_SERVING
+        # while False. Default True: directly-constructed impls (tests,
+        # in-process embedding) are serving the moment they exist.
+        self.warmup_complete = True
         # Optional sampled PredictionLog writer (serving/request_log.py);
         # assign a RequestLogger to enable — both transports and all four
         # RPC families flow through these entry points.
@@ -80,6 +88,17 @@ class PredictionServiceImpl:
     def _log_request(self, kind: str, request) -> None:
         if self.request_logger is not None:
             self.request_logger.maybe_log(kind, request)
+
+    def is_configured(self, name: str) -> bool:
+        """True when this server is CONFIGURED to serve `name` (a watcher
+        or lifecycle owns it), whether or not a version is ready yet — the
+        one definition shared by GetModelStatus (START vs NOT_FOUND) and
+        the grpc.health.v1 servicer (NOT_SERVING vs NOT_FOUND)."""
+        lifecycle = self.model_lifecycle
+        return name in self.served_sources or (
+            lifecycle is not None
+            and name in getattr(lifecycle, "configured_models", lambda: ())()
+        )
 
     # ------------------------------------------------------------ resolution
 
@@ -236,6 +255,15 @@ class PredictionServiceImpl:
             return ServiceError("RESOURCE_EXHAUSTED", str(exc))
         if isinstance(exc, DeviceWedgedError):
             return ServiceError("UNAVAILABLE", str(exc))
+        if isinstance(exc, RequestDeadlineError):
+            # The batcher shed the queued item itself (propagated client
+            # deadline): the future already failed, nothing to withdraw.
+            return ServiceError("DEADLINE_EXCEEDED", str(exc))
+        if isinstance(exc, faults.InjectedFaultError):
+            # Chaos at a batcher site (batcher.dispatch / readback) keeps
+            # its injected status code instead of collapsing into the
+            # RuntimeError->UNAVAILABLE catch-all below.
+            return ServiceError(exc.code_name, str(exc))
         # Explicit tuple, not bare TimeoutError: asyncio.TimeoutError and
         # concurrent.futures.TimeoutError are aliases of the builtin only on
         # Python >= 3.11; on 3.10 a batcher deadline would surface as
@@ -258,16 +286,46 @@ class PredictionServiceImpl:
             return ServiceError("UNAVAILABLE", str(exc))
         raise exc
 
+    @staticmethod
+    def _clock_deadline(deadline_s: float | None) -> float | None:
+        """Absolute give-up instant for a remaining-budget value, anchored
+        at RPC ENTRY — captured before decode/validation, so pre-submit
+        work spends the client's budget instead of silently extending it."""
+        return None if deadline_s is None else time.perf_counter() + deadline_s
+
+    @staticmethod
+    def _budget_left(deadline_t: float | None) -> float | None:
+        return None if deadline_t is None else deadline_t - time.perf_counter()
+
+    def _effective_timeout(self, deadline_s: float | None) -> float:
+        """Deadline propagation: the wait on the batcher future honors the
+        CLIENT's remaining budget (context.time_remaining(), threaded down
+        by the transport adapters) when it is tighter than the server's own
+        wedge bound — a 2s-deadline Predict against a saturated batcher
+        fails in ~2s, never the fixed 120s batch deadline. An already-
+        expired deadline sheds before submit."""
+        if deadline_s is None:
+            return self._BATCH_DEADLINE_S
+        if deadline_s <= 0:
+            raise ServiceError(
+                "DEADLINE_EXCEEDED", "client deadline already expired on arrival"
+            )
+        return min(deadline_s, self._BATCH_DEADLINE_S)
+
     def _run(
         self,
         servable: Servable,
         arrays: dict[str, np.ndarray],
         output_keys: tuple[str, ...] | None = None,
+        deadline_s: float | None = None,
     ) -> dict[str, np.ndarray]:
+        timeout = self._effective_timeout(deadline_s)
         fut = None
         try:
-            fut = self.batcher.submit(servable, arrays, output_keys=output_keys)
-            return fut.result(timeout=self._BATCH_DEADLINE_S)
+            fut = self.batcher.submit(
+                servable, arrays, output_keys=output_keys, deadline_s=deadline_s
+            )
+            return fut.result(timeout=timeout)
         except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
             raise self._translate_batcher_error(e, fut) from e
 
@@ -276,6 +334,7 @@ class PredictionServiceImpl:
         servable: Servable,
         arrays: dict[str, np.ndarray],
         output_keys: tuple[str, ...] | None = None,
+        deadline_s: float | None = None,
     ) -> dict[str, np.ndarray]:
         """_run for coroutine servers (server.create_server_async): the
         batcher Future is awaited instead of blocked on, so one event-loop
@@ -285,11 +344,14 @@ class PredictionServiceImpl:
         experiment: 72 threads cost ~15% of achievable QPS)."""
         import asyncio
 
+        timeout = self._effective_timeout(deadline_s)
         fut = None
         try:
-            fut = self.batcher.submit(servable, arrays, output_keys=output_keys)
+            fut = self.batcher.submit(
+                servable, arrays, output_keys=output_keys, deadline_s=deadline_s
+            )
             return await asyncio.wait_for(
-                asyncio.wrap_future(fut), timeout=self._BATCH_DEADLINE_S
+                asyncio.wrap_future(fut), timeout=timeout
             )
         except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
             raise self._translate_batcher_error(e, fut) from e
@@ -305,6 +367,12 @@ class PredictionServiceImpl:
                 f"{signature.method_name!r}; use the matching RPC instead of Predict",
             )
         with request_trace.span("predict.decode"):
+            try:
+                # Named fault site (faults.py): decode-stage chaos surfaces
+                # with its injected status code, not as INTERNAL.
+                faults.fire("decode")
+            except faults.InjectedFaultError as e:
+                raise ServiceError(e.code_name, str(e)) from e
             arrays = self._decode_and_validate(servable, signature, request.inputs)
 
         sig_outputs = signature.output_names
@@ -331,10 +399,16 @@ class PredictionServiceImpl:
             fetch_keys = None
         return servable, arrays, out_names, fetch_keys
 
-    def predict(self, request: apis.PredictRequest) -> apis.PredictResponse:
+    def predict(
+        self, request: apis.PredictRequest, deadline_s: float | None = None
+    ) -> apis.PredictResponse:
+        deadline_t = self._clock_deadline(deadline_s)
         servable, arrays, out_names, fetch_keys = self._predict_prepare(request)
         with request_trace.span("predict.execute"):
-            outputs = self._run(servable, arrays, output_keys=fetch_keys)
+            outputs = self._run(
+                servable, arrays, output_keys=fetch_keys,
+                deadline_s=self._budget_left(deadline_t),
+            )
         resp = self._predict_finish(request, servable, out_names, outputs)
         # Log only SUCCEEDED requests: the file's contract is direct
         # usability as a warmup file, and one malformed client request
@@ -342,12 +416,18 @@ class PredictionServiceImpl:
         self._log_request("predict", request)
         return resp
 
-    async def predict_async(self, request: apis.PredictRequest) -> apis.PredictResponse:
+    async def predict_async(
+        self, request: apis.PredictRequest, deadline_s: float | None = None
+    ) -> apis.PredictResponse:
         """Predict for coroutine servers: identical semantics, awaits the
         batch instead of blocking a handler thread on it."""
+        deadline_t = self._clock_deadline(deadline_s)
         servable, arrays, out_names, fetch_keys = self._predict_prepare(request)
         with request_trace.span("predict.execute"):
-            outputs = await self._run_async(servable, arrays, output_keys=fetch_keys)
+            outputs = await self._run_async(
+                servable, arrays, output_keys=fetch_keys,
+                deadline_s=self._budget_left(deadline_t),
+            )
         resp = self._predict_finish(request, servable, out_names, outputs)
         self._log_request("predict", request)
         return resp
@@ -427,17 +507,23 @@ class PredictionServiceImpl:
             raise ServiceError("INVALID_ARGUMENT", str(e)) from e
         return servable, arrays
 
-    def _run_examples(self, request):
+    def _run_examples(self, request, deadline_s: float | None = None):
+        deadline_t = self._clock_deadline(deadline_s)
         servable, arrays = self._examples_prepare(request)
-        outputs = self._run(servable, arrays, output_keys=("prediction_node",))
+        outputs = self._run(
+            servable, arrays, output_keys=("prediction_node",),
+            deadline_s=self._budget_left(deadline_t),
+        )
         return servable, outputs
 
-    async def _run_examples_async(self, request):
+    async def _run_examples_async(self, request, deadline_s: float | None = None):
         """_run_examples for coroutine servers (the REST gateway's
         :classify/:regress routes ride the same event loop as :predict)."""
+        deadline_t = self._clock_deadline(deadline_s)
         servable, arrays = self._examples_prepare(request)
         outputs = await self._run_async(
-            servable, arrays, output_keys=("prediction_node",)
+            servable, arrays, output_keys=("prediction_node",),
+            deadline_s=self._budget_left(deadline_t),
         )
         return servable, outputs
 
@@ -455,22 +541,28 @@ class PredictionServiceImpl:
             cls.classes.add(label="1", score=float(p))
         return resp
 
-    def _classify_impl(self, request: apis.ClassificationRequest) -> apis.ClassificationResponse:
+    def _classify_impl(
+        self, request: apis.ClassificationRequest, deadline_s: float | None = None
+    ) -> apis.ClassificationResponse:
         """classify() minus request logging (multi_inference sub-calls ride
         this so a logged MultiInference record is not double-counted as its
         constituent classifications)."""
-        servable, outputs = self._run_examples(request)
+        servable, outputs = self._run_examples(request, deadline_s=deadline_s)
         return self._classify_finish(request, servable, outputs)
 
-    def classify(self, request: apis.ClassificationRequest) -> apis.ClassificationResponse:
-        resp = self._classify_impl(request)
+    def classify(
+        self, request: apis.ClassificationRequest, deadline_s: float | None = None
+    ) -> apis.ClassificationResponse:
+        resp = self._classify_impl(request, deadline_s=deadline_s)
         self._log_request("classify", request)
         return resp
 
     async def classify_async(
-        self, request: apis.ClassificationRequest
+        self, request: apis.ClassificationRequest, deadline_s: float | None = None
     ) -> apis.ClassificationResponse:
-        servable, outputs = await self._run_examples_async(request)
+        servable, outputs = await self._run_examples_async(
+            request, deadline_s=deadline_s
+        )
         resp = self._classify_finish(request, servable, outputs)
         self._log_request("classify", request)
         return resp
@@ -484,40 +576,63 @@ class PredictionServiceImpl:
             resp.result.regressions.add(value=float(p))
         return resp
 
-    def _regress_impl(self, request: apis.RegressionRequest) -> apis.RegressionResponse:
-        servable, outputs = self._run_examples(request)
+    def _regress_impl(
+        self, request: apis.RegressionRequest, deadline_s: float | None = None
+    ) -> apis.RegressionResponse:
+        servable, outputs = self._run_examples(request, deadline_s=deadline_s)
         return self._regress_finish(request, servable, outputs)
 
-    def regress(self, request: apis.RegressionRequest) -> apis.RegressionResponse:
-        resp = self._regress_impl(request)
+    def regress(
+        self, request: apis.RegressionRequest, deadline_s: float | None = None
+    ) -> apis.RegressionResponse:
+        resp = self._regress_impl(request, deadline_s=deadline_s)
         self._log_request("regress", request)
         return resp
 
     async def regress_async(
-        self, request: apis.RegressionRequest
+        self, request: apis.RegressionRequest, deadline_s: float | None = None
     ) -> apis.RegressionResponse:
-        servable, outputs = await self._run_examples_async(request)
+        servable, outputs = await self._run_examples_async(
+            request, deadline_s=deadline_s
+        )
         resp = self._regress_finish(request, servable, outputs)
         self._log_request("regress", request)
         return resp
 
     # --------------------------------------------------------- MultiInference
 
-    def multi_inference(self, request: apis.MultiInferenceRequest) -> apis.MultiInferenceResponse:
+    def multi_inference(
+        self, request: apis.MultiInferenceRequest, deadline_s: float | None = None
+    ) -> apis.MultiInferenceResponse:
         if not request.tasks:
             raise ServiceError("INVALID_ARGUMENT", "MultiInferenceRequest has no tasks")
+        # Sub-calls run sequentially, so each gets the budget REMAINING at
+        # its own start — handing every task the full entry-time deadline
+        # would let server work extend tasks x deadline past the instant
+        # the client gave up.
+        deadline_t = self._clock_deadline(deadline_s)
+
+        def remaining() -> float | None:
+            left = self._budget_left(deadline_t)
+            if left is not None and left <= 0:
+                raise ServiceError(
+                    "DEADLINE_EXCEEDED",
+                    "client deadline expired between MultiInference tasks",
+                )
+            return left
+
         resp = apis.MultiInferenceResponse()
         for task in request.tasks:
             method = task.method_name
             if method == "tensorflow/serving/classify":
                 sub = apis.ClassificationRequest(model_spec=task.model_spec, input=request.input)
-                out = self._classify_impl(sub)
+                out = self._classify_impl(sub, deadline_s=remaining())
                 r = resp.results.add()
                 r.model_spec.CopyFrom(out.model_spec)
                 r.classification_result.CopyFrom(out.result)
             elif method == "tensorflow/serving/regress":
                 sub = apis.RegressionRequest(model_spec=task.model_spec, input=request.input)
-                out = self._regress_impl(sub)
+                out = self._regress_impl(sub, deadline_s=remaining())
                 r = resp.results.add()
                 r.model_spec.CopyFrom(out.model_spec)
                 r.regression_result.CopyFrom(out.result)
@@ -552,12 +667,7 @@ class PredictionServiceImpl:
             raise ServiceError("INVALID_ARGUMENT", "model_spec.name is required")
         loaded = self.registry.models().get(name)
         if not loaded:
-            lifecycle = self.model_lifecycle
-            configured = name in self.served_sources or (
-                lifecycle is not None
-                and name in getattr(lifecycle, "configured_models", lambda: ())()
-            )
-            if not configured:
+            if not self.is_configured(name):
                 raise ServiceError("NOT_FOUND", f"model {name!r} not found")
             version, _label = self._version_choice(request.model_spec)
             resp = apis.GetModelStatusResponse()

@@ -39,14 +39,29 @@ log = logging.getLogger("dts_tpu.versions")
 
 def scan_versions(base_path) -> dict[int, pathlib.Path]:
     """Numeric subdirectories of the base path (TF-Serving's convention;
-    non-numeric entries are ignored, matching upstream behavior)."""
+    non-numeric entries are ignored, matching upstream behavior).
+
+    Transient filesystem errors are SURVIVABLE by design: deploy tooling
+    swaps version directories while this scan runs, so an ENOENT
+    mid-listing or a stat race on a dir being replaced must degrade to
+    "saw nothing (or less) this tick" and let the next poll retry — never
+    propagate and kill the watcher thread (or the caller's startup scan)."""
     base = pathlib.Path(base_path)
-    if not base.is_dir():
-        return {}
     out: dict[int, pathlib.Path] = {}
-    for child in base.iterdir():
-        if child.is_dir() and child.name.isdigit():
-            out[int(child.name)] = child
+    try:
+        if not base.is_dir():
+            return {}
+        for child in base.iterdir():
+            try:
+                if child.is_dir() and child.name.isdigit():
+                    out[int(child.name)] = child
+            except OSError:
+                continue  # entry vanished mid-scan: as if never listed
+    except OSError as exc:
+        log.warning(
+            "transient filesystem error scanning %s (%s); retrying next tick",
+            base_path, exc,
+        )
     return out
 
 
@@ -65,13 +80,18 @@ def _version_ready(path: pathlib.Path) -> bool:
     ready once variables/variables.index exists — TF writes the index after
     the data shards, so probing for the directory alone can fire while
     shards are still streaming in (ADVICE.md round 1)."""
-    if is_native_checkpoint(path):
-        return (path / "params").exists()
-    if is_saved_model(path):
-        # Strictly require the index: an empty variables/ dir is exactly
-        # what a writer that has created the dir but not yet streamed the
-        # shards looks like, so it must not probe ready.
-        return (path / "variables" / "variables.index").exists()
+    try:
+        if is_native_checkpoint(path):
+            return (path / "params").exists()
+        if is_saved_model(path):
+            # Strictly require the index: an empty variables/ dir is exactly
+            # what a writer that has created the dir but not yet streamed the
+            # shards looks like, so it must not probe ready.
+            return (path / "variables" / "variables.index").exists()
+    except OSError:
+        # Version dir swapped out from under the probe: not ready this
+        # tick; the next poll sees the final state.
+        pass
     return False
 
 

@@ -118,6 +118,33 @@ class ClientConfig:
     # UNAVAILABLE/DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED, up to this many
     # extra attempts (0 = the reference's fail-fast behavior).
     failover_attempts: int = 0
+    # ---- resilience layer (client/health.py + client.py) -----------------
+    # Per-backend scoreboard: EWMA latency + consecutive-failure ejection
+    # with half-open probing; steers shard placement and failover rotation
+    # away from ejected hosts.
+    health_scoreboard: bool = False
+    # Consecutive reroutable failures before a backend is ejected, and the
+    # first ejection interval (doubles per failed half-open probe).
+    ejection_failures: int = 3
+    ejection_interval_s: float = 5.0
+    # Hedged shard RPCs: fire a second attempt on another healthy host
+    # after this delay; first answer wins, the loser is cancelled. 0 = off.
+    hedge_delay_ms: int = 0
+    # Jittered exponential backoff between failover attempts.
+    backoff_initial_ms: int = 50
+    backoff_max_ms: int = 2000
+    # Exhausted shards degrade the merge (PredictResult.missing_ranges +
+    # degraded flag) instead of failing the whole request.
+    partial_results: bool = False
+    # Half-open backends get a grpc.health.v1 Check before real traffic.
+    health_probe: bool = False
+    # HTTP/2 keepalive pings on the backend channels: a silently-dead
+    # backend is detected in ~time+timeout instead of hanging until the
+    # RPC deadline. 0 disables (for stock gRPC backends whose default
+    # ping-abuse policy would GOAWAY a 10s pinger; the in-tree servers
+    # tolerate it via KEEPALIVE_SERVER_OPTIONS).
+    keepalive_time_ms: int = 10000
+    keepalive_timeout_ms: int = 5000
     # Route by version label instead of latest ("" = unset; upstream
     # ModelSpec.version_label routing, e.g. "stable"/"canary").
     version_label: str = ""

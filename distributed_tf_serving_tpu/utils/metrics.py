@@ -165,6 +165,9 @@ class ServerMetrics:
                     batcher_stats.readback_overlap_fraction, 3
                 ),
                 "topk_batches": batcher_stats.topk_batches,
+                # Resilience layer: queued work shed because its propagated
+                # client deadline expired before a dispatch slot opened.
+                "deadline_sheds": getattr(batcher_stats, "deadline_sheds", 0),
             }
         return out
 
@@ -211,6 +214,8 @@ class ServerMetrics:
                  batcher_stats.topk_batches),
                 ("dts_tpu_batcher_readback_overlap_fraction", "gauge",
                  round(batcher_stats.readback_overlap_fraction, 4)),
+                ("dts_tpu_batcher_deadline_sheds_total", "counter",
+                 getattr(batcher_stats, "deadline_sheds", 0)),
             ):
                 lines.append(f"# TYPE {metric} {kind}")
                 lines.append(f"{metric} {value}")

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Tier-1 verification — the EXACT command ROADMAP.md pins, wrapped so CI
+# (.github/workflows/tier1.yml) and a local shell run identically:
+#
+#     tools/ci_tier1.sh
+#
+# Runs the non-slow test suite on the CPU platform, tees the log, prints a
+# DOTS_PASSED count (the driver's pass-counting convention), and exits with
+# pytest's status.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit $rc

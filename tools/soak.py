@@ -30,6 +30,15 @@ SOAK_REQUEST_LOG_SAMPLING (default 0 = logging off; >0 stresses the
 bounded-queue request logger under the mixed load — note it adds a
 SerializeToString per sampled request, so A/Bs against logging-off soaks
 are not apples-to-apples).
+
+Chaos mode (SOAK_CHAOS=1, seeded by SOAK_CHAOS_SEED): deterministic fault
+injection (distributed_tf_serving_tpu/faults.py) rides the same soak —
+low-rate injected RPC errors + delays at the client.rpc / batcher.dispatch
+/ readback sites while the gRPC client runs with the health scoreboard on.
+The JSON line gains `chaos` (per-site fire counts) and `resilience`
+(client counters + scoreboard) blocks; injected UNAVAILABLEs land in the
+error taxonomy, so a chaos soak PASSES when the taxonomy shows nothing
+BUT the injected codes and the stack neither leaks nor wedges.
 """
 
 import asyncio
@@ -83,6 +92,18 @@ def main() -> None:
     grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "8"))
     rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "4"))
     candidates = int(os.environ.get("SOAK_CANDIDATES", "1000"))
+    chaos = os.environ.get("SOAK_CHAOS", "0") == "1"
+    if chaos:
+        from distributed_tf_serving_tpu import faults
+
+        faults.get().seed = int(os.environ.get("SOAK_CHAOS_SEED", "0"))
+        # Low-rate, latency-shaped chaos: enough pressure to exercise the
+        # failover/scoreboard/shed paths continuously, low enough that the
+        # soak still measures the stack (not the injector).
+        faults.get().add("client.rpc", "error", rate=0.02, code="UNAVAILABLE")
+        faults.get().add("client.rpc", "delay", rate=0.05, delay_s=0.02)
+        faults.get().add("batcher.dispatch", "delay", rate=0.05, delay_s=0.01)
+        faults.get().add("readback", "delay", rate=0.05, delay_s=0.005)
 
     # Bench-scale servable on the accelerator; small on the CPU platform so
     # the one core spends its budget on the serving stack, not the forward.
@@ -232,21 +253,31 @@ def main() -> None:
                     note_error("control", f"{type(e).__name__}: {e}")
                 await asyncio.sleep(0.2)
 
+    resilience: dict = {}
+
     async def drive():
         server, gport = create_server_async(impl, "127.0.0.1:0")
         await server.start()
         runner, rport = await start_rest_gateway(impl, port=0)
         try:
             async with ShardedPredictClient(
-                [f"127.0.0.1:{gport}"], "DCN", channels_per_host=3
+                [f"127.0.0.1:{gport}"], "DCN", channels_per_host=3,
+                # Chaos soaks run the resilience layer live: scoreboard on,
+                # one failover attempt so injected UNAVAILABLEs reroute
+                # (same single host — exercises the backoff path).
+                scoreboard=chaos,
+                failover_attempts=1 if chaos else 0,
             ) as client, aiohttp.ClientSession(
                 f"http://127.0.0.1:{rport}"
             ) as session:
-                await asyncio.gather(
-                    *(grpc_worker(client, w) for w in range(grpc_workers)),
-                    *(rest_worker(session, w) for w in range(rest_workers)),
-                    control_worker(gport),
-                )
+                try:
+                    await asyncio.gather(
+                        *(grpc_worker(client, w) for w in range(grpc_workers)),
+                        *(rest_worker(session, w) for w in range(rest_workers)),
+                        control_worker(gport),
+                    )
+                finally:
+                    resilience.update(client.resilience_counters())
         finally:
             await runner.cleanup()
             await server.stop(0)
@@ -299,7 +330,10 @@ def main() -> None:
             "batches": batcher.stats.batches,
             "fused_batches": batcher.stats.fused_batches,
             "requests_per_batch": round(batcher.stats.mean_requests_per_batch, 2),
+            "deadline_sheds": batcher.stats.deadline_sheds,
         },
+        "resilience": resilience or None,
+        "chaos": None,
         "input_cache": (
             {
                 "hits": batcher.input_cache.hits,
@@ -312,6 +346,11 @@ def main() -> None:
             else None
         ),
     }
+    if chaos:
+        from distributed_tf_serving_tpu import faults
+
+        line["chaos"] = faults.get().snapshot()
+        faults.reset()
     batcher.stop()
     print(json.dumps(line))
 
