@@ -275,7 +275,10 @@ def _parent_main() -> None:
                            f"(timeout {CHILD_TIMEOUT_S}s)")
         try:
             r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
+                # Forward the parent's flags (--trace-out) to the child —
+                # the child is where the serving stack actually runs.
+                [sys.executable, os.path.abspath(__file__), "--child"]
+                + sys.argv[1:],
                 stdout=subprocess.PIPE, stderr=None,  # child stderr streams
                 text=True, timeout=CHILD_TIMEOUT_S,
             )
@@ -876,6 +879,21 @@ async def overload_probe(client_cls, port: str, batcher, scale: Scale, payload) 
     return counts
 
 
+def _trace_out_path() -> str | None:
+    """--trace-out PATH (or --trace-out=PATH): enable per-request tracing
+    for the whole bench and write the recorder's Chrome-trace-event JSON
+    (Perfetto-loadable) there at the end. Hand-rolled scan: the bench's
+    parent/child protocol predates argparse here, and unknown flags must
+    keep flowing through untouched."""
+    argv = sys.argv[1:]
+    for i, arg in enumerate(argv):
+        if arg == "--trace-out" and i + 1 < len(argv):
+            return argv[i + 1]
+        if arg.startswith("--trace-out="):
+            return arg.split("=", 1)[1]
+    return None
+
+
 def child_main() -> None:
     import asyncio
     import dataclasses
@@ -908,6 +926,16 @@ def child_main() -> None:
         platform = jax.devices()[0].platform
         scale = Scale(platform)
         log(stage, f"device={device} platform={platform} tpu_scale={scale.tpu}")
+
+        trace_out = _trace_out_path()
+        if trace_out:
+            from distributed_tf_serving_tpu.utils import tracing as span_tracing
+
+            # Tail-heavy sampling: at bench QPS a 2% sample plus the
+            # always-kept slowest-N/error tails bounds recorder growth
+            # while still catching exactly the requests worth explaining.
+            span_tracing.enable(buffer_size=512, sample_rate=0.02, slowest_n=64)
+            log("tracing", f"per-request tracing on -> {trace_out}")
 
         stage = "rtt_floor"
         rtt_floor_ms = measure_rtt_floor()
@@ -1432,6 +1460,18 @@ def child_main() -> None:
             "phases_us": phases,
             "phases_us_unique": phases_unique,
         })
+        if trace_out:
+            from distributed_tf_serving_tpu.utils import tracing as span_tracing
+
+            rec = span_tracing.recorder()
+            events = rec.write_chrome_trace(trace_out)
+            line["trace_out"] = {
+                "path": trace_out,
+                "events": events,
+                "recorded": rec.recorded,
+                "retained": len(rec.spans()),
+            }
+            log("tracing", f"chrome trace written: {events} events -> {trace_out}")
         print(json.dumps(line), flush=True)
     except Exception as exc:  # noqa: BLE001 — the JSON line IS the error report
         import traceback

@@ -28,6 +28,7 @@ import numpy as np
 
 from .. import codec, faults
 from ..proto import serving_apis_pb2 as apis
+from ..utils import tracing
 # LARGE_MESSAGE_CHANNEL_OPTIONS re-exported: transport tuning lives with
 # the grpc wiring, but callers historically reach it through the client.
 from ..proto.service_grpc import (  # noqa: F401
@@ -313,58 +314,75 @@ class ShardedPredictClient:
     async def __aexit__(self, *exc):
         await self.close()
 
-    async def _one_rpc(self, i: int, rr: int, host_idx: int, invoke):
+    async def _one_rpc(
+        self, i: int, rr: int, host_idx: int, invoke,
+        attempt: int = 0, hedge: bool = False,
+    ):
         """One attempt on one backend: fault site, scoreboard recording,
-        error tagging. Raises _ShardAttemptError on failure."""
+        error tagging. Raises _ShardAttemptError on failure. When tracing
+        is on, each attempt is its own span (hedges and failover hops
+        render as siblings) and carries a W3C traceparent in the gRPC
+        metadata so the server's span tree joins this trace."""
         host = self.hosts[host_idx]
         stubs = self._stubs[host_idx]
-        t0 = time.perf_counter()
-        try:
-            if faults.active():
-                # Named fault site (faults.py): a rule keyed on this host
-                # can delay/fail/wedge exactly one backend of the fan-out.
-                # Bounded by the RPC timeout so an injected WEDGE presents
-                # exactly like a hung backend does on the wire: this
-                # attempt dies DEADLINE_EXCEEDED after timeout_s.
-                try:
-                    await asyncio.wait_for(
-                        faults.fire_async("client.rpc", key=host),
-                        timeout=self.timeout_s,
-                    )
-                except asyncio.TimeoutError:
-                    raise faults.InjectedFaultError(
-                        "client.rpc", "DEADLINE_EXCEEDED",
-                        f"injected wedge at {host} outlived the RPC deadline",
-                    ) from None
-            # rr advances once per logical request (not per shard), so shard
-            # i of request r lands on channel (r + i) % k: consecutive
-            # requests stripe every host's channels even when the shard
-            # count divides k.
-            resp = await invoke(stubs[(rr + i) % len(stubs)])
-        except asyncio.CancelledError:
+        attrs = {"host": host, "attempt": attempt}
+        if hedge:
+            attrs["hedge"] = True
+        with tracing.start_span("client.rpc", attrs=attrs) as span:
+            metadata = (
+                (("traceparent",
+                  tracing.make_traceparent(span.trace_id, span.span_id)),)
+                if span is not None else None
+            )
+            t0 = time.perf_counter()
+            try:
+                if faults.active():
+                    # Named fault site (faults.py): a rule keyed on this host
+                    # can delay/fail/wedge exactly one backend of the fan-out.
+                    # Bounded by the RPC timeout so an injected WEDGE presents
+                    # exactly like a hung backend does on the wire: this
+                    # attempt dies DEADLINE_EXCEEDED after timeout_s.
+                    try:
+                        await asyncio.wait_for(
+                            faults.fire_async("client.rpc", key=host),
+                            timeout=self.timeout_s,
+                        )
+                    except asyncio.TimeoutError:
+                        raise faults.InjectedFaultError(
+                            "client.rpc", "DEADLINE_EXCEEDED",
+                            f"injected wedge at {host} outlived the RPC deadline",
+                        ) from None
+                # rr advances once per logical request (not per shard), so shard
+                # i of request r lands on channel (r + i) % k: consecutive
+                # requests stripe every host's channels even when the shard
+                # count divides k.
+                resp = await invoke(stubs[(rr + i) % len(stubs)], metadata)
+            except asyncio.CancelledError:
+                if self.scoreboard is not None:
+                    # The attempt resolved neither way: free any half-open
+                    # probe slot this host_idx holds, or a recovered backend
+                    # whose probe got cancelled (caller timeout, shutdown)
+                    # would be skipped by steering forever.
+                    self.scoreboard.release_probe(host_idx)
+                raise
+            except (grpc.aio.AioRpcError, faults.InjectedFaultError) as e:
+                code = e.code()
+                code_name = getattr(code, "name", str(code))
+                if span is not None:
+                    span.attrs["code"] = code_name
+                if self.scoreboard is not None:
+                    if code_name in _FAILOVER_CODES:
+                        self.scoreboard.record_failure(host_idx)
+                    else:
+                        # A deterministic request error PROVES the backend is
+                        # alive and answering — that is a health success.
+                        self.scoreboard.record_success(
+                            host_idx, time.perf_counter() - t0
+                        )
+                raise _ShardAttemptError(host_idx, code, e.details()) from e
             if self.scoreboard is not None:
-                # The attempt resolved neither way: free any half-open
-                # probe slot this host_idx holds, or a recovered backend
-                # whose probe got cancelled (caller timeout, shutdown)
-                # would be skipped by steering forever.
-                self.scoreboard.release_probe(host_idx)
-            raise
-        except (grpc.aio.AioRpcError, faults.InjectedFaultError) as e:
-            code = e.code()
-            code_name = getattr(code, "name", str(code))
-            if self.scoreboard is not None:
-                if code_name in _FAILOVER_CODES:
-                    self.scoreboard.record_failure(host_idx)
-                else:
-                    # A deterministic request error PROVES the backend is
-                    # alive and answering — that is a health success.
-                    self.scoreboard.record_success(
-                        host_idx, time.perf_counter() - t0
-                    )
-            raise _ShardAttemptError(host_idx, code, e.details()) from e
-        if self.scoreboard is not None:
-            self.scoreboard.record_success(host_idx, time.perf_counter() - t0)
-        return resp
+                self.scoreboard.record_success(host_idx, time.perf_counter() - t0)
+            return resp
 
     def _hedge_target(self, used: list[int]) -> int | None:
         """Extra host for a hedged attempt: the scoreboard's best healthy
@@ -403,7 +421,10 @@ class ShardedPredictClient:
                     raise exc
         raise first_exc  # every attempt failed
 
-    async def _attempt(self, i: int, rr: int, host_idx: int, invoke, used: list[int]):
+    async def _attempt(
+        self, i: int, rr: int, host_idx: int, invoke, used: list[int],
+        attempt: int = 0,
+    ):
         """One failover attempt, optionally hedged: the primary RPC runs on
         `host_idx`; after hedge_delay_s without an answer a second attempt
         fires on another healthy host — first ANSWER wins, the loser is
@@ -414,8 +435,10 @@ class ShardedPredictClient:
             # cancellation (gather's sibling-cancel on another shard's
             # failure, a caller timeout) cancels the RPC itself instead of
             # orphaning a detached task.
-            return await self._one_rpc(i, rr, host_idx, invoke)
-        primary = asyncio.ensure_future(self._one_rpc(i, rr, host_idx, invoke))
+            return await self._one_rpc(i, rr, host_idx, invoke, attempt=attempt)
+        primary = asyncio.ensure_future(
+            self._one_rpc(i, rr, host_idx, invoke, attempt=attempt)
+        )
         tasks: dict = {primary: host_idx}
         try:
             done, _ = await asyncio.wait({primary}, timeout=self.hedge_delay_s)
@@ -426,7 +449,10 @@ class ShardedPredictClient:
                     used.append(hedge_idx)
                     self.counters.hedges_fired += 1
                     hedge = asyncio.ensure_future(
-                        self._one_rpc(i, rr, hedge_idx, invoke)
+                        self._one_rpc(
+                            i, rr, hedge_idx, invoke,
+                            attempt=attempt, hedge=True,
+                        )
                     )
                     tasks[hedge] = hedge_idx
             winner = await self._first_success(set(tasks))
@@ -480,12 +506,18 @@ class ShardedPredictClient:
         return resp.status == health_proto.SERVING
 
     async def _shard_call(self, i: int, rr: int, invoke) -> np.ndarray:
-        """One shard's RPC with failover: `invoke(stub)` issues the call on
-        the chosen stub (message path uses stub.Predict, prepared-bytes path
-        stub.PredictRaw); host steering (scoreboard when present, blind
-        rotation otherwise), hedging, jittered backoff, reroutable-status
-        retry, and error wrapping are shared here so the message and
-        prepared-bytes paths cannot diverge."""
+        """One shard's RPC with failover: `invoke(stub, metadata)` issues
+        the call on the chosen stub (message path uses stub.Predict,
+        prepared-bytes path stub.PredictRaw); host steering (scoreboard
+        when present, blind rotation otherwise), hedging, jittered backoff,
+        reroutable-status retry, and error wrapping are shared here so the
+        message and prepared-bytes paths cannot diverge. With tracing on,
+        the shard gets a span whose children are the individual attempts
+        (failover hops and hedges as siblings)."""
+        with tracing.start_span("client.shard", attrs={"shard": i}):
+            return await self._shard_call_impl(i, rr, invoke)
+
+    async def _shard_call_impl(self, i: int, rr: int, invoke) -> np.ndarray:
         n = len(self.hosts)
         used: list[int] = []
         last: _ShardAttemptError | None = None
@@ -545,7 +577,9 @@ class ShardedPredictClient:
                                 "health probe reported not serving",
                             )
                         continue
-                resp = await self._attempt(i, rr, host_idx, invoke, used)
+                resp = await self._attempt(
+                    i, rr, host_idx, invoke, used, attempt=attempt
+                )
             except asyncio.CancelledError:
                 if self.scoreboard is not None:
                     self.scoreboard.release_probe(host_idx)
@@ -572,6 +606,15 @@ class ShardedPredictClient:
             out["scoreboard"] = self.scoreboard.snapshot()
         return out
 
+    def resilience_prometheus_text(self) -> str:
+        """resilience_counters() as Prometheus text exposition (the client
+        has no scrape port; harnesses write this next to their artifacts
+        so fleet dashboards ingest hedging/failover/ejection state in the
+        same format as the server plane)."""
+        from ..utils.metrics import resilience_prometheus_text
+
+        return resilience_prometheus_text(self.resilience_counters())
+
     async def _predict_shard(self, i: int, shard: dict[str, np.ndarray], rr: int) -> np.ndarray:
         req = build_predict_request(
             shard,
@@ -582,7 +625,10 @@ class ShardedPredictClient:
             use_tensor_content=self.use_tensor_content,
         )
         return await self._shard_call(
-            i, rr, lambda stub: stub.Predict(req, timeout=self.timeout_s)
+            i, rr,
+            lambda stub, metadata=None: stub.Predict(
+                req, timeout=self.timeout_s, metadata=metadata
+            ),
         )
 
     async def _fan_out(
@@ -616,9 +662,17 @@ class ShardedPredictClient:
                 for c in shard_coros[len(results) + 1:]:
                     c.close()
                 raise
-        merged = merge_host_order(list(results))
-        if sort_scores:
-            merged = np.sort(merged)
+        return self._merge(list(results), sort_scores)
+
+    @staticmethod
+    def _merge(results: list, sort_scores: bool, degraded: bool = False):
+        """ONE merge+optional-sort implementation (traced as client.merge)
+        for the full and partial fan-out paths."""
+        attrs = {"degraded": True} if degraded else None
+        with tracing.start_span("client.merge", attrs=attrs):
+            merged = merge_host_order(results)
+            if sort_scores:
+                merged = np.sort(merged)
         return merged
 
     async def _fan_out_partial(
@@ -639,16 +693,21 @@ class ShardedPredictClient:
         if len(failed) == len(results):
             raise results[0]  # total outage: degraded mode has nothing to merge
         if not failed:
-            merged = merge_host_order(list(results))
-            if sort_scores:
-                merged = np.sort(merged)
-            return PredictResult(scores=merged)
+            return PredictResult(scores=self._merge(list(results), sort_scores))
         self.counters.partial_responses += 1
-        merged = merge_host_order(
-            [r for r in results if not isinstance(r, BaseException)]
+        merged = self._merge(
+            [r for r in results if not isinstance(r, BaseException)],
+            sort_scores, degraded=True,
         )
-        if sort_scores:
-            merged = np.sort(merged)
+        root = tracing.current_span()
+        if root is not None:
+            # Degraded merges are tail-kept by the recorder: annotate the
+            # root so /tracez shows WHICH candidate ranges went missing.
+            root.attrs["degraded"] = True
+            root.annotate(
+                "degraded_merge",
+                missing_ranges=[list(bounds[k]) for k in failed],
+            )
         return PredictResult(
             scores=merged,
             missing_ranges=tuple(bounds[k] for k in failed),
@@ -661,7 +720,9 @@ class ShardedPredictClient:
         """One logical request: shard -> concurrent RPCs -> host-order merge
         (-> ascending sort when ranking semantics are wanted). Returns a
         PredictResult (possibly degraded) when partial_results is on, the
-        plain merged score vector otherwise."""
+        plain merged score vector otherwise. With tracing on, this is the
+        ROOT span of the distributed trace — every shard RPC (and the
+        server work it lands on) joins it via the injected traceparent."""
         shards = shard_candidates(arrays, len(self.hosts))
         self._rr += 1
         rr = self._rr
@@ -669,11 +730,16 @@ class ShardedPredictClient:
         bounds = (
             partition_bounds(n, len(shards)) if self.partial_results else None
         )
-        return await self._fan_out(
-            [self._predict_shard(i, s, rr) for i, s in enumerate(shards)],
-            sort_scores,
-            bounds=bounds,
-        )
+        with tracing.start_root(
+            "client.predict",
+            attrs={"model": self.model_name, "candidates": n,
+                   "shards": len(shards)},
+        ):
+            return await self._fan_out(
+                [self._predict_shard(i, s, rr) for i, s in enumerate(shards)],
+                sort_scores,
+                bounds=bounds,
+            )
 
     def prepare(self, arrays: dict[str, np.ndarray]) -> PreparedRequest:
         """Shard + build + serialize once; returns the reusable wire bytes
@@ -695,7 +761,10 @@ class ShardedPredictClient:
 
     async def _predict_shard_raw(self, i: int, blob: bytes, rr: int) -> np.ndarray:
         return await self._shard_call(
-            i, rr, lambda stub: stub.PredictRaw(blob, timeout=self.timeout_s)
+            i, rr,
+            lambda stub, metadata=None: stub.PredictRaw(
+                blob, timeout=self.timeout_s, metadata=metadata
+            ),
         )
 
     async def predict_prepared(
@@ -711,14 +780,19 @@ class ShardedPredictClient:
             if self.partial_results
             else None
         )
-        return await self._fan_out(
-            [
-                self._predict_shard_raw(i, b, rr)
-                for i, b in enumerate(prep.shard_blobs)
-            ],
-            sort_scores,
-            bounds=bounds,
-        )
+        with tracing.start_root(
+            "client.predict",
+            attrs={"model": self.model_name, "candidates": prep.candidates,
+                   "shards": len(prep.shard_blobs), "prepared": True},
+        ):
+            return await self._fan_out(
+                [
+                    self._predict_shard_raw(i, b, rr)
+                    for i, b in enumerate(prep.shard_blobs)
+                ],
+                sort_scores,
+                bounds=bounds,
+            )
 
 
 def client_from_config(cfg) -> ShardedPredictClient:

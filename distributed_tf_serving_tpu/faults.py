@@ -50,6 +50,8 @@ import random
 import threading
 import time
 
+from .utils import tracing
+
 SITES = ("decode", "batcher.dispatch", "readback", "client.rpc")
 KINDS = ("delay", "error", "wedge")
 
@@ -185,12 +187,26 @@ class FaultInjector:
                 return rule
         return None
 
+    @staticmethod
+    def _annotate(site: str, rule: FaultRule, key: str | None) -> None:
+        """Mark the active request span (or the batcher's phase sink) with
+        the injected fault, so a chaos run's trace shows exactly where the
+        delay/error/wedge landed (no-op when tracing is off)."""
+        tracing.annotate(
+            f"fault.{site}",
+            kind=rule.kind,
+            code=rule.code if rule.kind == "error" else None,
+            delay_s=rule.delay_s or None,
+            key=key,
+        )
+
     def fire(self, site: str, key: str | None = None) -> None:
         """Synchronous site (server threads). Sleeps, raises, or wedges
         according to the first matching rule; returns untouched otherwise."""
         rule = self._match(site, key)
         if rule is None:
             return
+        self._annotate(site, rule, key)
         if rule.kind == "delay":
             time.sleep(rule.delay_s)
         elif rule.kind == "wedge":
@@ -205,6 +221,7 @@ class FaultInjector:
         rule = self._match(site, key)
         if rule is None:
             return
+        self._annotate(site, rule, key)
         if rule.kind == "delay":
             await asyncio.sleep(rule.delay_s)
         elif rule.kind == "wedge":

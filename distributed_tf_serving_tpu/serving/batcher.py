@@ -76,6 +76,7 @@ from ..ops.transfer import (
     unpack_device_combined,
 )
 from ..utils.compat import enable_x64
+from ..utils import tracing
 from ..utils.tracing import request_trace
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
@@ -369,6 +370,21 @@ class _WorkItem:
     # Warmup work legitimately spends minutes compiling on the batcher
     # thread; it must not read as a wedged device to the circuit breaker.
     warmup: bool = False
+    # Per-request tracing handle (utils/tracing.Span of the submitting
+    # RPC): the batcher attaches queue-wait + per-phase child spans and
+    # fault annotations to it from its own threads. None = untraced.
+    span: "tracing.Span | None" = None
+
+
+def _replay_group_phases(group: list["_WorkItem"], phases: list) -> None:
+    """Attach a batch's collected phase intervals + annotations to every
+    traced member request's span (each co-batched request carries the full
+    batch timeline — the batch work WAS its work)."""
+    if not phases:
+        return
+    for it in group:
+        if it.span is not None:
+            tracing.replay_phases(it.span, phases)
 
 
 @dataclasses.dataclass
@@ -617,6 +633,7 @@ class DynamicBatcher:
         arrays: dict[str, np.ndarray],
         output_keys: tuple[str, ...] | None = None,
         deadline_s: float | None = None,
+        span: "tracing.Span | None" = None,
         _warmup: bool = False,
     ) -> Future:
         """Enqueue one request's arrays; returns a Future of output arrays
@@ -624,7 +641,9 @@ class DynamicBatcher:
         which model outputs are fetched back to the host. deadline_s (when
         given) is the CLIENT's remaining budget: an item still queued when it
         expires is shed (RequestDeadlineError -> DEADLINE_EXCEEDED) before
-        wasting a dispatch slot.
+        wasting a dispatch slot. `span` (when per-request tracing is on) is
+        the RPC's span handle: the batcher attaches queue-wait and device-
+        stage phase child spans to it from its own threads.
 
         Admission control (SURVEY.md §5 failure-detection obligations): a
         wedged device fails the request immediately (DeviceWedgedError, and
@@ -671,6 +690,7 @@ class DynamicBatcher:
                 output_keys=output_keys,
                 deadline_t=(now + deadline_s) if deadline_s is not None else None,
                 warmup=_warmup,
+                span=span if tracing.enabled() else None,
             )
         except BaseException:
             with self._cv:
@@ -1263,6 +1283,15 @@ class DynamicBatcher:
         — handed to the dispatch thread in pipelined mode so this thread
         returns to collecting+padding batch k+1 while batch k's
         pack/upload/jit-call proceeds (and batch k-1 executes on device)."""
+        # Per-request tracing: one phase sink per batch — request_trace's
+        # existing call sites (batch.pad here; cache/pack/jitcall/readback
+        # on the stage threads) land in it once and are replayed onto
+        # EVERY member request's span, so co-batched requests each carry
+        # the full batch timeline. None = nobody in this group is traced.
+        phases: list | None = (
+            [] if tracing.enabled() and any(it.span is not None for it in group)
+            else None
+        )
         try:
             bucket = bucket_for(total, self.buckets)
             first = group[0]
@@ -1296,7 +1325,8 @@ class DynamicBatcher:
             if fused is None:
                 keys = list(first.arrays.keys())
                 batched = {}
-                with request_trace.span("batch.pad"):
+                with (tracing.collect_phases(phases) if phases is not None
+                      else _NULL_CTX), request_trace.span("batch.pad"):
                     for k in keys:
                         parts = [it.arrays[k] for it in group]
                         if len(parts) == 1 and parts[0].shape[0] == bucket:
@@ -1325,7 +1355,7 @@ class DynamicBatcher:
         if self._dispatcher is None:
             self._run_stage(
                 None, group, total, bucket, wanted, wanted_key,
-                topk, n_valid, fused, batched,
+                topk, n_valid, fused, batched, phases,
             )
             return
         with self._cv:
@@ -1336,7 +1366,7 @@ class DynamicBatcher:
             self._dispatch_pending += 1
         self._dispatcher.submit(
             self._run_stage, sid, group, total, bucket, wanted, wanted_key,
-            topk, n_valid, fused, batched,
+            topk, n_valid, fused, batched, phases,
         )
         # Backpressure: at most one group may queue behind the running
         # stage — enough to keep the pipeline full (assembly of k+1
@@ -1362,12 +1392,26 @@ class DynamicBatcher:
         n_valid: int | None,
         fused: dict | None,
         batched: dict | None,
+        phases: list | None = None,
     ) -> None:
         """Device stage for one assembled batch: execute, issue the async
         D2H readback, register in flight, hand off to a completer. Runs on
         the dispatch thread (pipelined mode) or inline on the batcher
-        thread (sid None from the fallback path)."""
+        thread (sid None from the fallback path). `phases` is the batch's
+        tracing sink (started in _dispatch with the pad phase); the device-
+        stage phases and fault annotations land in it here and are
+        replayed onto every member request's span."""
         pending_closed = sid is None
+
+        def sink_ctx():
+            # Fresh context per use: collect_phases is a generator context
+            # manager (single-shot), and this stage enters the sink twice
+            # (device stage, readback issue).
+            return (
+                tracing.collect_phases(phases)
+                if phases is not None else _NULL_CTX
+            )
+
         try:
             if sid is not None:
                 with self._cv:
@@ -1387,21 +1431,30 @@ class DynamicBatcher:
                     None if all(it.warmup for it in group) else time.perf_counter()
                 )
             servable = group[0].servable
-            # Named fault site (faults.py): delay/error/wedge the device
-            # stage of this batch — the stuck-device scenario the circuit
-            # breaker and deadline tests drive deterministically.
-            faults.fire("batcher.dispatch")
-            with request_trace.span("batch.dispatch"):
-                if fused is not None:
-                    outputs = self._execute_fused(
-                        fused, bucket, wanted_key, topk, n_valid
-                    )
-                    self.stats.fused_batches += 1
-                else:
-                    outputs = self._execute(  # async dispatch
-                        servable, batched,
-                        out_keys=wanted_key, topk=topk, n_valid=n_valid,
-                    )
+            if phases is not None:
+                # Queue wait is per-item (each enqueued at its own time);
+                # attached directly, not through the shared batch sink.
+                now = time.perf_counter()
+                for it in group:
+                    if it.span is not None:
+                        it.span.add_interval("batch.queue_wait", it.enqueue_t, now)
+            with sink_ctx():
+                # Named fault site (faults.py): delay/error/wedge the device
+                # stage of this batch — the stuck-device scenario the circuit
+                # breaker and deadline tests drive deterministically. Inside
+                # the sink so an injected fault annotates the member spans.
+                faults.fire("batcher.dispatch")
+                with request_trace.span("batch.dispatch"):
+                    if fused is not None:
+                        outputs = self._execute_fused(
+                            fused, bucket, wanted_key, topk, n_valid
+                        )
+                        self.stats.fused_batches += 1
+                    else:
+                        outputs = self._execute(  # async dispatch
+                            servable, batched,
+                            out_keys=wanted_key, topk=topk, n_valid=n_valid,
+                        )
             if topk:
                 self.stats.topk_batches += 1
                 # Top-k outputs ARE the fetch (the score vector is
@@ -1435,9 +1488,10 @@ class DynamicBatcher:
                 for v in fetch.values():
                     if hasattr(v, "copy_to_host_async"):
                         v.copy_to_host_async()
-                request_trace.add(
-                    "readback.issue", time.perf_counter() - issue_t0
-                )
+                with sink_ctx():
+                    request_trace.add(
+                        "readback.issue", time.perf_counter() - issue_t0
+                    )
 
             self.stats.batches += 1
             self.stats.requests += len(group)
@@ -1470,10 +1524,18 @@ class DynamicBatcher:
                     self._dispatch_pending -= 1
                     pending_closed = True
                 self._cv.notify_all()
+            if phases is not None:
+                _replay_group_phases(group, phases)
+                phases = None  # a later submit() failure must not re-replay
             self._completers.submit(
                 self._complete, batch_id, group, fetch, issue_t0, meta
             )
         except Exception as exc:  # propagate to every waiter, keep serving
+            if phases is not None:
+                # The spans must show the phases (and any injected-fault
+                # annotation) that led to the failure BEFORE the waiters
+                # unblock and finish their root spans.
+                _replay_group_phases(group, phases)
             for it in group:
                 if not it.future.done():
                     it.future.set_exception(exc)
@@ -1488,20 +1550,30 @@ class DynamicBatcher:
         self, batch_id: int, group: list[_WorkItem], outputs,
         issue_t0: float | None = None, meta: dict | None = None,
     ) -> None:
+        phases: list | None = (
+            [] if tracing.enabled() and any(it.span is not None for it in group)
+            else None
+        )
+        trace_ctx = (
+            tracing.collect_phases(phases) if phases is not None else _NULL_CTX
+        )
         try:
-            # Named fault site (faults.py): a readback that stalls or dies.
-            faults.fire("readback")
-            # The fetch: with async_readback the copy is already in flight
-            # (issued at dispatch), so this measures the residual WAIT, not
-            # a full synchronous transfer — the split the phase names carry.
-            wait_t0 = time.perf_counter()
-            host = {k: np.asarray(v) for k, v in outputs.items()}
-            done_t = time.perf_counter()
-            waited = done_t - wait_t0
-            request_trace.add(
-                "readback.wait" if self.async_readback else "batch.readback",
-                waited,
-            )
+            with trace_ctx:
+                # Named fault site (faults.py): a readback that stalls or
+                # dies — inside the sink so chaos annotates member spans.
+                faults.fire("readback")
+                # The fetch: with async_readback the copy is already in
+                # flight (issued at dispatch), so this measures the residual
+                # WAIT, not a full synchronous transfer — the split the
+                # phase names carry.
+                wait_t0 = time.perf_counter()
+                host = {k: np.asarray(v) for k, v in outputs.items()}
+                done_t = time.perf_counter()
+                waited = done_t - wait_t0
+                request_trace.add(
+                    "readback.wait" if self.async_readback else "batch.readback",
+                    waited,
+                )
             downloaded = sum(v.nbytes for v in host.values())
             window = max(done_t - issue_t0 if issue_t0 is not None else waited, waited)
             with self._cv:  # counters race across completer threads otherwise
@@ -1527,6 +1599,12 @@ class DynamicBatcher:
                 # declaring DT_HALF/DT_BFLOAT16) must pass through
                 # untouched, exactly as before this pipeline existed.
                 host = restore_outputs_host(host)
+            if phases is not None:
+                # Attach the readback phases before the waiters unblock —
+                # a root span must already hold its full tree when the RPC
+                # handler finishes (and records) it.
+                _replay_group_phases(group, phases)
+                phases = None  # a set_result failure must not re-replay
             off = 0
             for it in group:
                 sliced = {k: v[off : off + it.n] for k, v in host.items()}
@@ -1540,6 +1618,8 @@ class DynamicBatcher:
                     # not poison co-batched requests via the except below.
                     pass
         except Exception as exc:
+            if phases is not None:
+                _replay_group_phases(group, phases)
             for it in group:
                 if not it.future.done():
                     it.future.set_exception(exc)

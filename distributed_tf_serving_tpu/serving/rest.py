@@ -24,6 +24,12 @@ model server's version_labels map):
 - `GET  /v1/models/{model}/metadata` -> signature metadata (JSON).
 - `GET  /monitoring/prometheus/metrics` -> Prometheus text exposition
   (the model server's monitoring endpoint; TF-Serving metric names).
+- `GET  /monitoring` -> the metrics snapshot as JSON (rolling-window QPS
+  + windowed percentiles next to lifetime values, per-model blocks,
+  batcher gauges, phase means).
+- `GET  /tracez[?format=chrome][&limit=N]` -> the trace plane
+  (utils/tracing.py): recent + slowest retained span trees as JSON, or a
+  Perfetto-loadable Chrome-trace-event export.
 
 Requests are converted to the SAME PredictRequest protos the gRPC path
 parses and handed to PredictionServiceImpl.predict_async — one
@@ -35,6 +41,7 @@ codes onto HTTP statuses (TF-Serving's own REST error shape:
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 
@@ -43,6 +50,8 @@ from aiohttp import web
 
 from .. import codec
 from ..proto import serving_apis_pb2 as apis
+from ..utils import tracing
+from ..utils.tracing import request_trace
 from .service import PredictionServiceImpl, ServiceError
 
 log = logging.getLogger("dts_tpu.rest")
@@ -111,6 +120,13 @@ class RestGateway:
             ),
             web.get("/v1/models/{model}/labels/{label}/metadata", self.metadata),
             web.get("/monitoring/prometheus/metrics", self.prometheus),
+            # Live-telemetry plane (ISSUE 3): the JSON twin of the
+            # Prometheus surface (rolling-window QPS/percentiles next to
+            # lifetime values, per-model blocks, batcher gauges, phase
+            # means) and the trace viewer (recent + slowest span trees;
+            # ?format=chrome exports Perfetto-loadable trace-event JSON).
+            web.get("/monitoring", self.monitoring),
+            web.get("/tracez", self.tracez),
         ])
 
     # ------------------------------------------------------------- helpers
@@ -202,8 +218,26 @@ class RestGateway:
 
     async def _observed(self, name: str, handler, request) -> web.Response:
         t0 = time.perf_counter()
-        resp = await handler(request)
-        self.metrics.observe(name, time.perf_counter() - t0, resp.status < 400)
+        model = request.match_info.get("model")
+        if tracing.enabled():
+            # Server root span for the REST surface: adopts the caller's
+            # trace via the standard W3C `traceparent` HTTP header.
+            with tracing.start_root(
+                f"server.{name}",
+                traceparent=request.headers.get("traceparent"),
+                attrs={"entrypoint": name, **({"model": model} if model else {})},
+            ) as span:
+                resp = await handler(request)
+                # span can be None: disable() racing this request makes
+                # start_root yield the no-op context mid-flight.
+                if span is not None and resp.status >= 400:
+                    span.status = "ERROR"
+                    span.attrs["http_status"] = resp.status
+        else:
+            resp = await handler(request)
+        self.metrics.observe(
+            name, time.perf_counter() - t0, resp.status < 400, model=model
+        )
         return resp
 
     async def predict(self, request: web.Request) -> web.Response:
@@ -418,6 +452,35 @@ class RestGateway:
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
             },
         )
+
+    async def monitoring(self, request: web.Request) -> web.Response:
+        """GET /monitoring: the metrics snapshot as JSON — rolling-window
+        qps + windowed percentiles next to the lifetime values, per-model
+        blocks, batcher gauges, and the aggregate phase means."""
+        stats = getattr(self.impl.batcher, "stats", None)
+        snap = self.metrics.snapshot(stats)
+        snap["phases"] = request_trace.snapshot()
+        snap["tracing"] = {
+            "enabled": tracing.enabled(),
+            "recorded": tracing.recorder().recorded,
+        }
+        return web.json_response(snap)
+
+    async def tracez(self, request: web.Request) -> web.Response:
+        """GET /tracez: recent + slowest retained span trees as JSON;
+        ?format=chrome returns Chrome-trace-event JSON (Perfetto /
+        chrome://tracing loadable); ?limit=N bounds the trace list."""
+        rec = tracing.recorder()
+        dumps = lambda obj: json.dumps(obj, default=str)  # noqa: E731
+        if request.query.get("format") == "chrome":
+            return web.json_response(rec.chrome_trace(), dumps=dumps)
+        try:
+            limit = int(request.query.get("limit", "50"))
+        except ValueError:
+            return _json_error("INVALID_ARGUMENT", "limit must be an integer")
+        body = rec.tracez(limit=limit)
+        body["enabled"] = tracing.enabled()
+        return web.json_response(body, dumps=dumps)
 
     async def status(self, request: web.Request) -> web.Response:
         # ONE status implementation: delegate to the ModelService RPC body

@@ -38,6 +38,7 @@ from ..proto import (
 from ..proto import health as health_proto
 from ..utils.config import ServerConfig, load_config
 from ..utils.metrics import ServerMetrics
+from ..utils import tracing
 from ..utils.tracing import request_trace
 from .batcher import DynamicBatcher
 from .service import PredictionServiceImpl, ServiceError
@@ -49,9 +50,29 @@ def _status(code_name: str) -> grpc.StatusCode:
     return getattr(grpc.StatusCode, code_name, grpc.StatusCode.UNKNOWN)
 
 
+def _model_of(request) -> str | None:
+    """The resolved model label for metrics/tracing (None when the request
+    shape carries no top-level model_spec, e.g. MultiInference)."""
+    return getattr(getattr(request, "model_spec", None), "name", "") or None
+
+
+def _traceparent_of(context) -> str | None:
+    """The W3C traceparent from the RPC's invocation metadata (both sync
+    and aio contexts expose it as (key, value) pairs); None when absent.
+    Only called when tracing is enabled."""
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == "traceparent":
+                return value
+    except Exception:  # noqa: BLE001 — tracing must never fail an RPC
+        return None
+    return None
+
+
 class _SyncServicerBase:
     """Shared adapter plumbing for sync servicers: ServiceError -> grpc
-    status mapping + per-RPC metrics."""
+    status mapping + per-RPC metrics (+ the per-request server root span
+    when tracing is on)."""
 
     def __init__(self, impl: PredictionServiceImpl, metrics: ServerMetrics | None = None):
         self.impl = impl
@@ -60,8 +81,24 @@ class _SyncServicerBase:
     def _call(self, name: str, fn, request, context):
         t0 = time.perf_counter()
         ok = False
+        model = _model_of(request)
+        if tracing.enabled():
+            # Server-side LOCAL ROOT: adopts the client's trace id (and
+            # parents onto the exact shard-attempt span that carried the
+            # RPC) when a traceparent arrived; a fresh trace otherwise.
+            span_ctx = tracing.start_root(
+                f"server.{name}",
+                traceparent=_traceparent_of(context),
+                attrs={"entrypoint": name, **({"model": model} if model else {})},
+            )
+        else:
+            span_ctx = None
         try:
-            resp = fn(request)
+            if span_ctx is not None:
+                with span_ctx:
+                    resp = fn(request)
+            else:
+                resp = fn(request)
             ok = True
             return resp
         except ServiceError as e:
@@ -70,7 +107,7 @@ class _SyncServicerBase:
             log.exception("internal error serving %s", name)
             context.abort(grpc.StatusCode.INTERNAL, f"internal error: {e}")
         finally:
-            self.metrics.observe(name, time.perf_counter() - t0, ok)
+            self.metrics.observe(name, time.perf_counter() - t0, ok, model=model)
 
 
 def _deadline_of(context) -> float | None:
@@ -273,10 +310,28 @@ class _AioServicerBase:
     async def _call(self, name: str, fn, request, context):
         t0 = time.perf_counter()
         ok = False
+        model = _model_of(request)
+        if tracing.enabled():
+            span_ctx = tracing.start_root(
+                f"server.{name}",
+                traceparent=_traceparent_of(context),
+                attrs={"entrypoint": name, **({"model": model} if model else {})},
+            )
+        else:
+            span_ctx = None
         try:
-            resp = fn(request)
-            if hasattr(resp, "__await__"):
-                resp = await resp
+            if span_ctx is not None:
+                # Sync `with` is correct across awaits here: contextvars
+                # are coroutine-scoped, so the span stays current through
+                # the await and resets on exit.
+                with span_ctx:
+                    resp = fn(request)
+                    if hasattr(resp, "__await__"):
+                        resp = await resp
+            else:
+                resp = fn(request)
+                if hasattr(resp, "__await__"):
+                    resp = await resp
             ok = True
             return resp
         except ServiceError as e:
@@ -287,7 +342,7 @@ class _AioServicerBase:
             log.exception("internal error serving %s", name)
             await context.abort(grpc.StatusCode.INTERNAL, f"internal error: {e}")
         finally:
-            self.metrics.observe(name, time.perf_counter() - t0, ok)
+            self.metrics.observe(name, time.perf_counter() - t0, ok, model=model)
 
 
 class AioGrpcPredictionService(_AioServicerBase):
@@ -877,6 +932,12 @@ def serve(argv=None) -> None:
     parser.add_argument("--metrics-every-s", type=float, default=0.0,
                         help="periodically log a metrics snapshot")
     parser.add_argument(
+        "--tracing", action="store_true", default=None,
+        help="per-request span tracing (W3C traceparent propagation; GET "
+        "/tracez on the REST surface, ?format=chrome for a Perfetto-"
+        "loadable export). Equivalent to [observability] tracing=true",
+    )
+    parser.add_argument(
         "--batching-parameters-file", dest="batching_parameters_file",
         help="tensorflow_model_server-format batching config (text-format "
         "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
@@ -922,8 +983,13 @@ def serve(argv=None) -> None:
     )
     args = parser.parse_args(argv)
 
+    from ..utils.config import ObservabilityConfig
+
     cfgs = load_config(args.config) if args.config else {"server": ServerConfig()}
     cfg = cfgs["server"]
+    obs = cfgs.get("observability") or ObservabilityConfig()
+    if args.tracing:
+        obs = dataclasses.replace(obs, tracing=True)
     model_config = cfgs.get("model")
     if model_config is not None:
         # Explicit CLI architecture flags win over the TOML [model] section
@@ -987,7 +1053,13 @@ def serve(argv=None) -> None:
         impl.request_logger = request_logger
         log.info("request logging to %s (sampling %.4f)",
                  cfg.request_log_file, cfg.request_log_sampling)
-    metrics = ServerMetrics()
+    if obs.apply() is not None:
+        log.info(
+            "per-request tracing on (buffer=%d sample_rate=%.3f slowest_n=%d)"
+            " — GET /tracez on the REST surface",
+            obs.trace_buffer, obs.trace_sample_rate, obs.trace_slowest_n,
+        )
+    metrics = ServerMetrics(window_s=obs.window_seconds)
     server, port = create_server(
         impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics,
         credentials=credentials,

@@ -21,6 +21,7 @@ import time
 import numpy as np
 
 from .. import codec, faults
+from ..utils import tracing
 from ..utils.tracing import request_trace
 from ..models.registry import (
     ModelNotFoundError,
@@ -322,8 +323,12 @@ class PredictionServiceImpl:
         timeout = self._effective_timeout(deadline_s)
         fut = None
         try:
+            # The current span (the transport adapter's server root, when
+            # tracing is on) rides into the batcher so its threads can
+            # attach queue/device/readback child spans per request.
             fut = self.batcher.submit(
-                servable, arrays, output_keys=output_keys, deadline_s=deadline_s
+                servable, arrays, output_keys=output_keys,
+                deadline_s=deadline_s, span=tracing.current_span(),
             )
             return fut.result(timeout=timeout)
         except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
@@ -348,7 +353,8 @@ class PredictionServiceImpl:
         fut = None
         try:
             fut = self.batcher.submit(
-                servable, arrays, output_keys=output_keys, deadline_s=deadline_s
+                servable, arrays, output_keys=output_keys,
+                deadline_s=deadline_s, span=tracing.current_span(),
             )
             return await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=timeout

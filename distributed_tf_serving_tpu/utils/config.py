@@ -156,13 +156,53 @@ class ClientConfig:
     tls_client_cert_file: str = ""
 
 
+@dataclasses.dataclass(frozen=True)
+class ObservabilityConfig:
+    """Telemetry-plane knobs (utils/tracing.py + utils/metrics.py): the
+    per-request trace recorder behind GET /tracez and the rolling-window
+    horizon of /monitoring and the Prometheus endpoint."""
+
+    # Per-request span tracing (W3C traceparent propagation, /tracez,
+    # Chrome-trace export). Off by default: the hot path then pays one
+    # global bool read per hook.
+    tracing: bool = False
+    # Retained local-root spans per ring (recent / error) — memory bound.
+    trace_buffer: int = 256
+    # Tail-sampling rate for unremarkable traces (errors, degraded
+    # results, and fault-annotated traces are ALWAYS kept). 0.0 keeps
+    # nothing but the tails; 1.0 keeps everything the buffer can hold.
+    trace_sample_rate: float = 1.0
+    # The slowest-N traces are always retained regardless of sampling.
+    trace_slowest_n: int = 32
+    # Rolling-window horizon for sliding QPS + windowed p50/p99.
+    window_seconds: float = 60.0
+
+    def apply(self):
+        """Flip the global tracing plane to this config; returns the
+        active TraceRecorder (or None when tracing stays off)."""
+        from . import tracing as tracing_mod
+
+        if not self.tracing:
+            tracing_mod.disable()
+            return None
+        return tracing_mod.enable(
+            buffer_size=self.trace_buffer,
+            sample_rate=self.trace_sample_rate,
+            slowest_n=self.trace_slowest_n,
+        )
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
     return ModelConfig
 
 
-_SECTIONS = {"server": ServerConfig, "client": ClientConfig}
+_SECTIONS = {
+    "server": ServerConfig,
+    "client": ClientConfig,
+    "observability": ObservabilityConfig,
+}
 
 
 def _coerce(cls, data: dict[str, Any]):

@@ -6,6 +6,13 @@ BASELINE.md's target metric set (p50/p99, QPS/chip) needs percentile-capable
 aggregation, so the core here is a fixed-bucket log-scale histogram: O(1)
 record, lock-free-ish (GIL-atomic list ops), percentiles from bucket
 interpolation, mergeable across RPCs.
+
+Two time horizons per metric (ISSUE 3): LIFETIME aggregates (unchanged —
+the totals dashboards trend on) and ROLLING WINDOWS — sliding-window QPS
+and windowed p50/p99 over the last `window_s` seconds, so `/monitoring`
+answers "what is the server doing NOW" instead of a lifetime average that
+decays toward 0 on an idle server. Both surfaces carry per-model labels
+when the transport adapters pass the resolved model name.
 """
 
 from __future__ import annotations
@@ -30,6 +37,27 @@ def _bucket_index(us: float) -> int:
 _EDGES_US = [_BASE_US * _GROWTH**i for i in range(_NUM_BUCKETS)]
 
 
+def _percentile_ms(
+    counts: list[int], total: int, min_us: float, max_us: float, q: float
+) -> float:
+    """q in [0, 100] over a consistent (counts, total) snapshot; linear
+    interpolation inside the winning bucket. Shared by the lifetime
+    histogram and the rolling-window slices (merged counts)."""
+    if total == 0:
+        return 0.0
+    target = q / 100.0 * total
+    acc = 0
+    for i, c in enumerate(counts):
+        if acc + c >= target and c > 0:
+            lo = _EDGES_US[i - 1] if i > 0 else 0.0
+            hi = _EDGES_US[i]
+            frac = (target - acc) / c
+            val = lo + (hi - lo) * frac
+            return min(max(val, min_us), max_us) / 1e3
+        acc += c
+    return max_us / 1e3
+
+
 class LatencyHistogram:
     """Log-bucketed latency histogram with percentile readout."""
 
@@ -52,35 +80,37 @@ class LatencyHistogram:
 
     @property
     def count(self) -> int:
-        return self._total
+        with self._lock:  # pairs count with the same instant's sums
+            return self._total
 
     def mean_ms(self) -> float:
-        return self._sum_us / self._total / 1e3 if self._total else 0.0
+        # total and sum read under ONE lock: a snapshot racing a record()
+        # must never pair a new count with an old sum (ISSUE 3 satellite).
+        with self._lock:
+            return self._sum_us / self._total / 1e3 if self._total else 0.0
+
+    def _state(self) -> tuple[list[int], int, float, float, float]:
+        """One consistent copy of the mutable state."""
+        with self._lock:
+            return (
+                list(self._counts), self._total, self._sum_us,
+                self._min_us, self._max_us,
+            )
 
     def percentile_ms(self, q: float) -> float:
-        """q in [0, 100]; linear interpolation inside the winning bucket."""
-        with self._lock:
-            if self._total == 0:
-                return 0.0
-            target = q / 100.0 * self._total
-            acc = 0
-            for i, c in enumerate(self._counts):
-                if acc + c >= target and c > 0:
-                    lo = _EDGES_US[i - 1] if i > 0 else 0.0
-                    hi = _EDGES_US[i]
-                    frac = (target - acc) / c
-                    val = lo + (hi - lo) * frac
-                    return min(max(val, self._min_us), self._max_us) / 1e3
-                acc += c
-            return self._max_us / 1e3
+        counts, total, _sum_us, min_us, max_us = self._state()
+        return _percentile_ms(counts, total, min_us, max_us, q)
 
     def snapshot(self) -> dict:
+        # One locked copy feeds count/mean AND every percentile, so the
+        # block is internally consistent even mid-record.
+        counts, total, sum_us, min_us, max_us = self._state()
         return {
-            "count": self.count,
-            "mean_ms": round(self.mean_ms(), 3),
-            "p50_ms": round(self.percentile_ms(50), 3),
-            "p90_ms": round(self.percentile_ms(90), 3),
-            "p99_ms": round(self.percentile_ms(99), 3),
+            "count": total,
+            "mean_ms": round(sum_us / total / 1e3 if total else 0.0, 3),
+            "p50_ms": round(_percentile_ms(counts, total, min_us, max_us, 50), 3),
+            "p90_ms": round(_percentile_ms(counts, total, min_us, max_us, 90), 3),
+            "p99_ms": round(_percentile_ms(counts, total, min_us, max_us, 99), 3),
         }
 
     def prometheus_buckets(self) -> tuple[list[tuple[float, int]], float, int]:
@@ -99,51 +129,247 @@ class LatencyHistogram:
         return out, sum_us, total
 
 
+class WindowedLatency:
+    """Sliding-window latency + rate over the last `window_s` seconds.
+
+    A ring of `slices` sub-histograms, each covering window_s/slices of
+    wall time; record() lands in the current slice (lazily reset when its
+    slot is reused), and readout merges only the slices still inside the
+    window. O(1) record, bounded memory, no background thread — the
+    standard cheap approximation to a true sliding window (granularity =
+    one slice; with the 60s/6-slice default, 10s).
+    """
+
+    def __init__(
+        self,
+        window_s: float = 60.0,
+        slices: int = 6,
+        clock=time.monotonic,
+    ):
+        self.window_s = float(window_s)
+        self.slices = max(2, int(slices))
+        self.slice_s = self.window_s / self.slices
+        self._clock = clock
+        self._created = clock()
+        self._lock = threading.Lock()
+        self._counts = [[0] * _NUM_BUCKETS for _ in range(self.slices)]
+        self._totals = [0] * self.slices
+        self._sums_us = [0.0] * self.slices
+        self._mins_us = [math.inf] * self.slices
+        self._maxs_us = [0.0] * self.slices
+        self._epochs = [-1] * self.slices  # which slice-epoch each slot holds
+
+    def _slot(self, now: float) -> int:
+        """Current slot index, reset if its epoch is stale. Caller holds
+        the lock."""
+        epoch = int(now / self.slice_s)
+        idx = epoch % self.slices
+        if self._epochs[idx] != epoch:
+            self._epochs[idx] = epoch
+            self._counts[idx] = [0] * _NUM_BUCKETS
+            self._totals[idx] = 0
+            self._sums_us[idx] = 0.0
+            self._mins_us[idx] = math.inf
+            self._maxs_us[idx] = 0.0
+        return idx
+
+    def record(self, seconds: float) -> None:
+        us = seconds * 1e6
+        with self._lock:
+            idx = self._slot(self._clock())
+            self._counts[idx][_bucket_index(us)] += 1
+            self._totals[idx] += 1
+            self._sums_us[idx] += us
+            self._mins_us[idx] = min(self._mins_us[idx], us)
+            self._maxs_us[idx] = max(self._maxs_us[idx], us)
+
+    def _merged(self) -> tuple[list[int], int, float, float, float]:
+        """Merge the in-window slices into one consistent histogram."""
+        with self._lock:
+            now = self._clock()
+            current_epoch = int(now / self.slice_s)
+            counts = [0] * _NUM_BUCKETS
+            total, sum_us = 0, 0.0
+            min_us, max_us = math.inf, 0.0
+            for idx in range(self.slices):
+                # In-window = one of the last `slices` epochs (the current
+                # partial slice counts; the slot about to be recycled does
+                # not).
+                if current_epoch - self._epochs[idx] >= self.slices:
+                    continue
+                if self._epochs[idx] < 0:
+                    continue
+                sl = self._counts[idx]
+                for i, c in enumerate(sl):
+                    if c:
+                        counts[i] += c
+                total += self._totals[idx]
+                sum_us += self._sums_us[idx]
+                min_us = min(min_us, self._mins_us[idx])
+                max_us = max(max_us, self._maxs_us[idx])
+            return counts, total, sum_us, min_us, max_us
+
+    def count(self) -> int:
+        return self._merged()[1]
+
+    def effective_window_s(self) -> float:
+        """Rate divisor: the nominal window, shrunk while the recorder is
+        YOUNGER than it (a server 8 s old serving 100 req/s must report
+        ~100 qps, not 800/60) and floored at 1 s so a burst in the first
+        milliseconds doesn't quote an absurd spike."""
+        return min(self.window_s, max(self._clock() - self._created, 1.0))
+
+    def qps(self) -> float:
+        return self._merged()[1] / self.effective_window_s()
+
+    def snapshot(self) -> dict:
+        counts, total, sum_us, min_us, max_us = self._merged()
+        return {
+            "window_s": self.window_s,
+            "count": total,
+            "qps": round(total / self.effective_window_s(), 2),
+            "mean_ms": round(sum_us / total / 1e3 if total else 0.0, 3),
+            "p50_ms": round(_percentile_ms(counts, total, min_us, max_us, 50), 3),
+            "p99_ms": round(_percentile_ms(counts, total, min_us, max_us, 99), 3),
+        }
+
+
 @dataclasses.dataclass
 class RpcMetrics:
     latency: LatencyHistogram = dataclasses.field(default_factory=LatencyHistogram)
+    window: WindowedLatency = dataclasses.field(default_factory=WindowedLatency)
     ok: int = 0
     errors: int = 0
 
 
-class ServerMetrics:
-    """Per-RPC latency/outcome metrics + a QPS window, exported as one dict
-    (the /metrics analog; the reference had only a final stdout mean)."""
+def escape_label_value(value) -> str:
+    """Prometheus text-format 0.0.4 label-value escaping: backslash, double
+    quote, and line feed must be escaped or the exposition line is
+    malformed (ISSUE 3 satellite — a model named `he"llo` or a path-ish
+    entrypoint must not corrupt the scrape)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
-    def __init__(self):
+
+class ServerMetrics:
+    """Per-RPC latency/outcome metrics + rolling windows, exported as one
+    dict (the /monitoring analog; the reference had only a final stdout
+    mean). `observe(..., model=...)` additionally aggregates under the
+    resolved model name, so both surfaces carry per-model labels."""
+
+    # Per-model series are keyed on CLIENT-SUPPLIED model names (a
+    # NOT_FOUND still observes under the name it asked for), so the key
+    # space must be bounded or a fuzzer's ever-new names would grow
+    # memory and scrape cardinality without limit. Real deployments serve
+    # a handful of models; past the cap, overflow traffic aggregates
+    # under one sentinel label instead of allocating new series.
+    MAX_MODEL_LABELS = 64
+    OVERFLOW_MODEL = "_other"
+
+    def __init__(self, window_s: float = 60.0, clock=time.monotonic):
+        self.window_s = float(window_s)
+        self._clock = clock
         self._rpcs: dict[str, RpcMetrics] = {}
+        self._models: dict[tuple[str, str], RpcMetrics] = {}
+        self._model_names: set[str] = set()
         self._lock = threading.Lock()
-        self._start = time.monotonic()
+        self._start = clock()
+
+    def _new_rpc_metrics(self) -> RpcMetrics:
+        return RpcMetrics(
+            window=WindowedLatency(window_s=self.window_s, clock=self._clock)
+        )
 
     def rpc(self, name: str) -> RpcMetrics:
         with self._lock:
             if name not in self._rpcs:
-                self._rpcs[name] = RpcMetrics()
+                self._rpcs[name] = self._new_rpc_metrics()
             return self._rpcs[name]
 
-    def observe(self, name: str, seconds: float, ok: bool) -> None:
-        m = self.rpc(name)
-        m.latency.record(seconds)
-        with self._lock:  # counters race across handler threads otherwise
-            if ok:
-                m.ok += 1
-            else:
-                m.errors += 1
+    def _model_rpc(self, name: str, model: str) -> RpcMetrics:
+        with self._lock:
+            if (
+                model not in self._model_names
+                and len(self._model_names) >= self.MAX_MODEL_LABELS
+            ):
+                model = self.OVERFLOW_MODEL
+            self._model_names.add(model)
+            key = (name, model)
+            if key not in self._models:
+                self._models[key] = self._new_rpc_metrics()
+            return self._models[key]
+
+    def observe(
+        self, name: str, seconds: float, ok: bool, model: str | None = None
+    ) -> None:
+        targets = [self.rpc(name)]
+        if model:
+            targets.append(self._model_rpc(name, model))
+        for m in targets:
+            m.latency.record(seconds)
+            m.window.record(seconds)
+            with self._lock:  # counters race across handler threads otherwise
+                if ok:
+                    m.ok += 1
+                else:
+                    m.errors += 1
+
+    @staticmethod
+    def _rpc_block(m: RpcMetrics) -> tuple[dict, int]:
+        """ONE construction of the per-entrypoint stats block — lifetime
+        histogram + counters + the rolling-window horizon — shared by the
+        aggregate and per-model surfaces so they can never drift. Returns
+        (block, windowed count) so callers never re-merge the window
+        slices for a count this snapshot already produced."""
+        win = m.window.snapshot()
+        block = {
+            **m.latency.snapshot(),
+            "ok": m.ok,
+            "errors": m.errors,
+            # Rolling horizon next to the lifetime values: what this
+            # entrypoint is doing NOW (windowed qps + percentiles).
+            "window": {
+                "qps": win["qps"],
+                "p50_ms": win["p50_ms"],
+                "p99_ms": win["p99_ms"],
+            },
+        }
+        return block, win["count"]
 
     def snapshot(self, batcher_stats=None) -> dict:
-        uptime = time.monotonic() - self._start
-        out: dict = {"uptime_s": round(uptime, 1), "rpcs": {}}
+        uptime = self._clock() - self._start
+        out: dict = {
+            "uptime_s": round(uptime, 1),
+            "window_s": self.window_s,
+            "rpcs": {},
+        }
         total = 0
+        window_count = 0
         with self._lock:  # rpc() may insert concurrently
             items = sorted(self._rpcs.items())
+            model_items = sorted(self._models.items())
         for name, m in items:
-            out["rpcs"][name] = {
-                **m.latency.snapshot(),
-                "ok": m.ok,
-                "errors": m.errors,
-            }
+            out["rpcs"][name], win_count = self._rpc_block(m)
             total += m.ok + m.errors
-        out["qps"] = round(total / uptime, 2) if uptime > 0 else 0.0
+            window_count += win_count
+        if model_items:
+            models: dict = {}
+            for (name, model), m in model_items:
+                models.setdefault(model, {})[name] = self._rpc_block(m)[0]
+            out["models"] = models
+        # `qps` is the ROLLING rate (what the server is doing now); the
+        # lifetime average — which decays toward 0 on an idle server and
+        # under-reports after any idle stretch — stays visible as
+        # qps_lifetime (ISSUE 3 satellite). The divisor shrinks while the
+        # server is younger than the window (see effective_window_s).
+        out["qps"] = round(
+            window_count / min(self.window_s, max(uptime, 1.0)), 2
+        )
+        out["qps_lifetime"] = round(total / uptime, 2) if uptime > 0 else 0.0
         if batcher_stats is not None:
             out["batcher"] = {
                 "batches": batcher_stats.batches,
@@ -166,7 +392,7 @@ class ServerMetrics:
                 ),
                 "topk_batches": batcher_stats.topk_batches,
                 # Resilience layer: queued work shed because its propagated
-                # client deadline expired before a dispatch slot opened.
+                # client deadline expired.
                 "deadline_sheds": getattr(batcher_stats, "deadline_sheds", 0),
             }
         return out
@@ -177,25 +403,70 @@ class ServerMetrics:
         server's monitoring surface (`:tensorflow:serving:request_count` /
         `:tensorflow:serving:request_latency`, microsecond buckets) so
         existing TF-Serving dashboards and alert rules scrape unchanged;
-        batcher gauges are framework-native and ride the dts_tpu_ prefix."""
+        rolling-window gauges, per-model series, and batcher gauges are
+        framework-native and ride the dts_tpu_ prefix."""
         rc, rl = ":tensorflow:serving:request_count", ":tensorflow:serving:request_latency"
+        esc = escape_label_value
         lines = [f"# TYPE {rc} counter"]
         with self._lock:
             items = sorted(self._rpcs.items())
+            model_items = sorted(self._models.items())
         for name, m in items:
-            lines.append(f'{rc}{{entrypoint="{name}",status="OK"}} {m.ok}')
+            lines.append(f'{rc}{{entrypoint="{esc(name)}",status="OK"}} {m.ok}')
             if m.errors:
-                lines.append(f'{rc}{{entrypoint="{name}",status="ERROR"}} {m.errors}')
+                lines.append(
+                    f'{rc}{{entrypoint="{esc(name)}",status="ERROR"}} {m.errors}'
+                )
         lines.append(f"# TYPE {rl} histogram")
         for name, m in items:
             buckets, sum_us, total = m.latency.prometheus_buckets()
             for le_us, cum in buckets:
                 lines.append(
-                    f'{rl}_bucket{{entrypoint="{name}",le="{le_us:.6g}"}} {cum}'
+                    f'{rl}_bucket{{entrypoint="{esc(name)}",le="{le_us:.6g}"}} {cum}'
                 )
-            lines.append(f'{rl}_bucket{{entrypoint="{name}",le="+Inf"}} {total}')
-            lines.append(f'{rl}_sum{{entrypoint="{name}"}} {sum_us:.6g}')
-            lines.append(f'{rl}_count{{entrypoint="{name}"}} {total}')
+            lines.append(f'{rl}_bucket{{entrypoint="{esc(name)}",le="+Inf"}} {total}')
+            lines.append(f'{rl}_sum{{entrypoint="{esc(name)}"}} {sum_us:.6g}')
+            lines.append(f'{rl}_count{{entrypoint="{esc(name)}"}} {total}')
+        # Rolling-window horizon: sliding QPS + windowed percentiles per
+        # entrypoint, plus the overall rolling rate `snapshot()["qps"]`
+        # reports (ISSUE 3).
+        win_qps = "dts_tpu_request_window_qps"
+        win_lat = "dts_tpu_request_window_latency_ms"
+        lines.append(f"# TYPE {win_qps} gauge")
+        overall = 0.0
+        win_snaps = [(name, m.window.snapshot()) for name, m in items]
+        for name, win in win_snaps:
+            overall += win["qps"]
+            lines.append(f'{win_qps}{{entrypoint="{esc(name)}"}} {win["qps"]}')
+        lines.append("# TYPE dts_tpu_qps_window gauge")
+        lines.append(f"dts_tpu_qps_window {round(overall, 2)}")
+        lines.append(f"# TYPE {win_lat} gauge")
+        for name, win in win_snaps:
+            for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
+                lines.append(
+                    f'{win_lat}{{entrypoint="{esc(name)}",quantile="{q}"}} '
+                    f'{win[key]}'
+                )
+        if model_items:
+            mrc = "dts_tpu_model_request_count"
+            mqps = "dts_tpu_model_window_qps"
+            mlat = "dts_tpu_model_window_latency_ms"
+            lines.append(f"# TYPE {mrc} counter")
+            for (name, model), m in model_items:
+                base = f'entrypoint="{esc(name)}",model_name="{esc(model)}"'
+                lines.append(f'{mrc}{{{base},status="OK"}} {m.ok}')
+                if m.errors:
+                    lines.append(f'{mrc}{{{base},status="ERROR"}} {m.errors}')
+            lines.append(f"# TYPE {mqps} gauge")
+            lines.append(f"# TYPE {mlat} gauge")
+            for (name, model), m in model_items:
+                base = f'entrypoint="{esc(name)}",model_name="{esc(model)}"'
+                win = m.window.snapshot()
+                lines.append(f'{mqps}{{{base}}} {win["qps"]}')
+                for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
+                    lines.append(
+                        f'{mlat}{{{base},quantile="{q}"}} {win[key]}'
+                    )
         if batcher_stats is not None:
             for metric, kind, value in (
                 ("dts_tpu_batcher_batches_total", "counter", batcher_stats.batches),
@@ -220,3 +491,56 @@ class ServerMetrics:
                 lines.append(f"# TYPE {metric} {kind}")
                 lines.append(f"{metric} {value}")
         return "\n".join(lines) + "\n"
+
+
+def resilience_prometheus_text(resilience: dict) -> str:
+    """Prometheus text exposition of the CLIENT resilience state — the
+    dict client.ShardedPredictClient.resilience_counters() returns
+    (ResilienceCounters fields + an optional BackendScoreboard snapshot).
+    The client has no scrape port of its own; bench.py/soak write this
+    next to their artifacts so fleet dashboards ingest client-side hedging
+    /failover/ejection state in the same format as the server plane."""
+    esc = escape_label_value
+    lines = []
+    for key in (
+        "hedges_fired", "hedges_won", "failovers",
+        "backoff_sleeps", "partial_responses",
+    ):
+        if key in resilience:
+            metric = f"dts_tpu_client_{key}_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {int(resilience[key])}")
+    sb = resilience.get("scoreboard")
+    if sb:
+        for key in ("ejections", "probes", "recoveries"):
+            if key in sb:
+                metric = f"dts_tpu_client_{key}_total"
+                lines.append(f"# TYPE {metric} counter")
+                lines.append(f"{metric} {int(sb[key])}")
+        backends = sb.get("backends", {})
+        if backends:
+            lines.append("# TYPE dts_tpu_client_backend_up gauge")
+            lines.append("# TYPE dts_tpu_client_backend_ewma_ms gauge")
+            lines.append("# TYPE dts_tpu_client_backend_successes_total counter")
+            lines.append("# TYPE dts_tpu_client_backend_failures_total counter")
+            for host, st in backends.items():
+                label = f'host="{esc(host)}"'
+                up = 1 if st.get("state") == "healthy" else 0
+                lines.append(
+                    f'dts_tpu_client_backend_up{{{label},'
+                    f'state="{esc(st.get("state", ""))}"}} {up}'
+                )
+                if st.get("ewma_ms") is not None:
+                    lines.append(
+                        f"dts_tpu_client_backend_ewma_ms{{{label}}} "
+                        f'{st["ewma_ms"]}'
+                    )
+                lines.append(
+                    f"dts_tpu_client_backend_successes_total{{{label}}} "
+                    f'{st.get("successes", 0)}'
+                )
+                lines.append(
+                    f"dts_tpu_client_backend_failures_total{{{label}}} "
+                    f'{st.get("failures", 0)}'
+                )
+    return "\n".join(lines) + "\n" if lines else ""

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Schema validation for an exported Chrome-trace-event JSON artifact.
+
+Usage: python tools/check_trace.py PATH [--min-events N]
+
+Asserts what Perfetto / chrome://tracing need to load the file — and what
+the CI smoke step (tools/ci_tier1.sh TIER1_TRACE_SMOKE=1, on a
+SOAK_CHAOS=1 traced soak) promises about the tracing plane:
+
+- valid JSON with a non-empty `traceEvents` list;
+- every event has name/ph/pid/tid; complete ("X") events carry integer,
+  non-negative, monotonicity-safe ts/dur (ts >= 0, dur >= 0, and an
+  event never ends before it starts by construction);
+- at least one span event exists (the soak actually traced requests) and
+  span events carry the trace/span-id args the /tracez JSON cross-links.
+
+Exits 0 on success; prints the failure and exits 1 otherwise — the CI
+step uploads the artifact on failure so the broken file is inspectable.
+"""
+
+import json
+import sys
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821 — py3.10 typing comment only
+    print(f"check_trace: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    min_events = 1
+    positional = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--min-events":
+            if i + 1 >= len(argv):
+                fail("--min-events needs a value")
+            min_events = int(argv[i + 1])
+            i += 2  # the value is NOT a positional
+            continue
+        if a.startswith("--min-events="):
+            min_events = int(a.split("=", 1)[1])
+        elif a.startswith("--"):
+            fail(f"unknown flag {a!r}")
+        else:
+            positional.append(a)
+        i += 1
+    if not positional:
+        fail("usage: check_trace.py PATH [--min-events N]")
+    path = positional[0]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        fail(f"{path}: no such file")
+    except json.JSONDecodeError as e:
+        fail(f"{path}: invalid JSON: {e}")
+
+    events = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        fail("traceEvents is not a list")
+    if len(events) < min_events:
+        fail(f"only {len(events)} events (< {min_events})")
+
+    spans = 0
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            fail(f"event {i} is not an object")
+        for key in ("name", "ph", "pid", "tid"):
+            if key not in ev:
+                fail(f"event {i} missing {key!r}: {ev}")
+        if ev["ph"] == "X":
+            spans += 1
+            for key in ("ts", "dur"):
+                val = ev.get(key)
+                if not isinstance(val, int) or val < 0:
+                    fail(
+                        f"event {i} ({ev['name']!r}) {key}={val!r} must be "
+                        "a non-negative integer"
+                    )
+            args_blk = ev.get("args", {})
+            for key in ("trace_id", "span_id"):
+                if not args_blk.get(key):
+                    fail(f"span event {i} ({ev['name']!r}) missing args.{key}")
+    if spans == 0:
+        fail("no complete ('X') span events — nothing was traced")
+    print(
+        f"check_trace: OK: {len(events)} events, {spans} spans "
+        f"({path})"
+    )
+
+
+if __name__ == "__main__":
+    main()

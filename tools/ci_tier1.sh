@@ -7,6 +7,12 @@
 # Runs the non-slow test suite on the CPU platform, tees the log, prints a
 # DOTS_PASSED count (the driver's pass-counting convention), and exits with
 # pytest's status.
+# With TIER1_TRACE_SMOKE=1 (CI sets it), a passing test run is followed by
+# an observability smoke: a short traced chaos soak (SOAK_CHAOS=1 +
+# SOAK_TRACE_OUT) whose /tracez-served Chrome-trace artifact must be
+# non-empty and schema-valid (tools/check_trace.py). The artifact lands at
+# $TIER1_TRACE_ARTIFACT (default /tmp/tier1_soak_trace.json) so CI can
+# upload it for debugging when the step fails.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -17,4 +23,15 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
     2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+
+if [ "$rc" -eq 0 ] && [ "${TIER1_TRACE_SMOKE:-0}" = "1" ]; then
+    ARTIFACT="${TIER1_TRACE_ARTIFACT:-/tmp/tier1_soak_trace.json}"
+    echo "tier1: trace smoke (SOAK_CHAOS=1, artifact $ARTIFACT)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_SMOKE_SECONDS:-8}" SOAK_CHAOS=1 \
+        SOAK_GRPC_WORKERS=2 SOAK_REST_WORKERS=1 SOAK_CANDIDATES=64 \
+        SOAK_TRACE_OUT="$ARTIFACT" SOAK_TRACE_SAMPLE=0.5 \
+        python tools/soak.py || rc=1
+    python tools/check_trace.py "$ARTIFACT" --min-events 10 || rc=1
+fi
 exit $rc

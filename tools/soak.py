@@ -39,6 +39,16 @@ The JSON line gains `chaos` (per-site fire counts) and `resilience`
 (client counters + scoreboard) blocks; injected UNAVAILABLEs land in the
 error taxonomy, so a chaos soak PASSES when the taxonomy shows nothing
 BUT the injected codes and the stack neither leaks nor wedges.
+
+Tracing (SOAK_TRACE_OUT=/path/trace.json): per-request span tracing runs
+for the whole soak (utils/tracing.py; SOAK_TRACE_SAMPLE sets the tail-
+sampling rate, default 0.05 — errors/fault-annotated/slowest-N traces are
+always kept), the live `/tracez?format=chrome` endpoint is probed over
+HTTP before shutdown, and its Chrome-trace-event JSON (Perfetto-loadable)
+is written to the given path. The JSON line gains a `trace` block
+(recorded/retained/event counts + the artifact path) — the CI smoke step
+(tools/ci_tier1.sh TIER1_TRACE_SMOKE=1) asserts the artifact is schema-
+valid and non-empty via tools/check_trace.py.
 """
 
 import asyncio
@@ -93,6 +103,15 @@ def main() -> None:
     rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "4"))
     candidates = int(os.environ.get("SOAK_CANDIDATES", "1000"))
     chaos = os.environ.get("SOAK_CHAOS", "0") == "1"
+    trace_out = os.environ.get("SOAK_TRACE_OUT", "")
+    if trace_out:
+        from distributed_tf_serving_tpu.utils import tracing
+
+        tracing.enable(
+            buffer_size=int(os.environ.get("SOAK_TRACE_BUFFER", "256")),
+            sample_rate=float(os.environ.get("SOAK_TRACE_SAMPLE", "0.05")),
+            slowest_n=int(os.environ.get("SOAK_TRACE_SLOWEST", "32")),
+        )
     if chaos:
         from distributed_tf_serving_tpu import faults
 
@@ -254,6 +273,27 @@ def main() -> None:
                 await asyncio.sleep(0.2)
 
     resilience: dict = {}
+    trace_block: dict = {}
+
+    async def export_trace(session) -> None:
+        """Probe the LIVE /tracez surface (the same bytes an operator's
+        curl would get) and persist the Chrome trace artifact."""
+        async with session.get("/tracez?format=chrome") as r:
+            body = await r.read()
+            if r.status != 200:
+                trace_block["error"] = f"http {r.status}"
+                return
+        with open(trace_out, "wb") as f:
+            f.write(body)
+        doc = json.loads(body)
+        from distributed_tf_serving_tpu.utils import tracing
+
+        trace_block.update({
+            "path": trace_out,
+            "events": len(doc.get("traceEvents", ())),
+            "recorded": tracing.recorder().recorded,
+            "retained": len(tracing.recorder().spans()),
+        })
 
     async def drive():
         server, gport = create_server_async(impl, "127.0.0.1:0")
@@ -278,6 +318,18 @@ def main() -> None:
                     )
                 finally:
                     resilience.update(client.resilience_counters())
+                    prom_out = os.environ.get("SOAK_PROM_OUT", "")
+                    if prom_out:
+                        # Client resilience state in Prometheus text, next
+                        # to the soak artifact (the client has no scrape
+                        # port of its own).
+                        with open(prom_out, "w") as f:
+                            f.write(client.resilience_prometheus_text())
+                    if trace_out:
+                        try:
+                            await export_trace(session)
+                        except Exception as e:  # noqa: BLE001 — report, keep line
+                            trace_block["error"] = f"{type(e).__name__}: {e}"
         finally:
             await runner.cleanup()
             await server.stop(0)
@@ -333,6 +385,7 @@ def main() -> None:
             "deadline_sheds": batcher.stats.deadline_sheds,
         },
         "resilience": resilience or None,
+        "trace": trace_block or None,
         "chaos": None,
         "input_cache": (
             {
