@@ -879,6 +879,20 @@ async def overload_probe(client_cls, port: str, batcher, scale: Scale, payload) 
     return counts
 
 
+def _skew_flag() -> float | None:
+    """--skew[=EXPONENT]: run the cache-plane A/B phase on a seeded
+    zipfian workload (client/bench.py make_zipfian_payloads +
+    zipfian_indices — the same seed replays the identical request stream
+    for the cache-off and cache-on passes). Default exponent 1.1; None
+    when the flag is absent (the phase is skipped entirely)."""
+    for arg in sys.argv[1:]:
+        if arg == "--skew":
+            return 1.1
+        if arg.startswith("--skew="):
+            return float(arg.split("=", 1)[1])
+    return None
+
+
 def _trace_out_path() -> str | None:
     """--trace-out PATH (or --trace-out=PATH): enable per-request tracing
     for the whole bench and write the recorder's Chrome-trace-event JSON
@@ -1067,6 +1081,7 @@ def child_main() -> None:
                     for f in ("batches", "requests", "candidates",
                               "padded_candidates", "fill_waits",
                               "fused_batches", "topk_batches", "deadline_sheds",
+                              "dedup_batches", "dedup_rows_collapsed",
                               "bytes_downloaded", "bytes_download_full_f32",
                               "readback_window_s", "readback_blocked_s"):
                         setattr(d, f, getattr(after, f) - getattr(before, f))
@@ -1311,6 +1326,97 @@ def child_main() -> None:
             finally:
                 ceil_batcher.stop()
 
+        async def serve_cache_ab(skew: float):
+            nonlocal stage
+            stage = "cache_skew"
+            # Cache-plane A/B (ISSUE 4 acceptance): the IDENTICAL seeded
+            # zipfian request stream, cache off then cache on, against the
+            # live stack. Reports hit/miss/coalesced/dedup counters and a
+            # bit-identity check (uncached-miss scores vs cached-hit
+            # scores). Off unless --skew is passed — the headline windows
+            # stay reference-methodology.
+            from distributed_tf_serving_tpu.cache import ScoreCache
+            from distributed_tf_serving_tpu.client import (
+                make_zipfian_payloads,
+                zipfian_indices,
+            )
+
+            server, port = create_server_async(impl, "127.0.0.1:0")
+            await server.start()
+            try:
+                batcher.max_batch_candidates = min(8192, batcher.buckets[-1])
+                pool_n = 64 if scale.tpu else 8
+                rpw = 40 if scale.tpu else 4
+                conc = scale.unique_concurrency
+                pool = make_zipfian_payloads(
+                    pool_n, CANDIDATES, NUM_FIELDS, skew=skew, seed=11
+                )
+                sched = zipfian_indices(conc * rpw, pool_n, skew=skew, seed=12)
+
+                async def skew_loop():
+                    async with ShardedPredictClient(
+                        [f"127.0.0.1:{port}"], "DCN",
+                        channels_per_host=scale.channels_per_host,
+                    ) as client:
+                        return await run_closed_loop(
+                            client, pool[0], concurrency=conc,
+                            requests_per_worker=rpw, sort_scores=True,
+                            warmup_requests=2, payload_pool=pool,
+                            schedule=sched,
+                        )
+
+                log(stage, f"skew={skew} pool={pool_n} x {conc}x{rpw}: cache OFF pass")
+                d_batches = batcher.stats.dedup_batches
+                d_rows = batcher.stats.dedup_rows_collapsed
+                rep_off = await skew_loop()
+                cache = ScoreCache(ttl_s=600.0)
+                batcher.score_cache, batcher.dedup = cache, True
+                try:
+                    log(stage, "cache ON pass (identical stream)")
+                    rep_on = await skew_loop()
+                    # Bit-identity probe against a DISARMED reference: the
+                    # same payload scored with the whole plane off, then
+                    # armed as a filling miss (the dedup path) and a cached
+                    # hit — all three vectors must be byte-equal, or the
+                    # plane is changing answers. (Comparing the hit only to
+                    # its own filling miss would be tautological.)
+                    probe = pool[int(sched[0])]
+                    async with ShardedPredictClient(
+                        [f"127.0.0.1:{port}"], "DCN", channels_per_host=1,
+                    ) as client:
+                        batcher.score_cache, batcher.dedup = None, False
+                        ref = await client.predict(probe, sort_scores=True)
+                        batcher.score_cache, batcher.dedup = cache, True
+                        cache.flush()
+                        miss = await client.predict(probe, sort_scores=True)
+                        hit = await client.predict(probe, sort_scores=True)
+                    snap = cache.snapshot()
+                finally:
+                    batcher.score_cache, batcher.dedup = None, False
+                res["cache"] = {
+                    "skew": skew,
+                    "pool": pool_n,
+                    "requests_each_pass": conc * rpw,
+                    "qps_cache_off": round(rep_off.summary()["qps"], 1),
+                    "qps_cache_on": round(rep_on.summary()["qps"], 1),
+                    "p50_ms_cache_off": round(rep_off.summary()["p50_ms"], 3),
+                    "p50_ms_cache_on": round(rep_on.summary()["p50_ms"], 3),
+                    "hits": snap["hits"],
+                    "misses": snap["misses"],
+                    "coalesced": snap["coalesced"],
+                    "hit_rate": snap["hit_rate"],
+                    "dedup_batches": batcher.stats.dedup_batches - d_batches,
+                    "dedup_rows_collapsed": (
+                        batcher.stats.dedup_rows_collapsed - d_rows
+                    ),
+                    "scores_bit_identical": bool(
+                        np.array_equal(ref, miss) and np.array_equal(ref, hit)
+                    ),
+                }
+                log(stage, json.dumps(res["cache"]))
+            finally:
+                await server.stop(0)
+
         asyncio.run(serve_windows())
         report = res["report"]
         s = report.summary()
@@ -1361,6 +1467,10 @@ def child_main() -> None:
         s_u = report_u.summary()
         phases_unique = res["phases_unique"]
         overload_block = res["overload"]
+
+        skew = _skew_flag()
+        if skew is not None:
+            asyncio.run(serve_cache_ab(skew))
         batcher.stop()
 
         asyncio.run(measure_host_ceiling())
@@ -1457,6 +1567,10 @@ def child_main() -> None:
             "pallas": pallas_block,
             "device_decomposition": device_block,
             "overload": overload_block,
+            # Cache-plane A/B (--skew): seeded zipfian stream replayed
+            # cache-off/cache-on, hit/coalesced/dedup counters + score
+            # bit-identity. None when --skew was not passed.
+            "cache": res.get("cache"),
             "phases_us": phases,
             "phases_us_unique": phases_unique,
         })

@@ -1,0 +1,18 @@
+"""Cache plane: exact-match score cache, single-flight coalescing, and
+intra-batch duplicate collapse. Everything here is jax-free (numpy +
+stdlib), so the client package reuses the same core for its optional
+local cache."""
+
+from .digest import canonical_rows, features_digest, rows_as_bytes
+from .dedup import collapse_rows
+from .score_cache import CacheHandle, CoalescedLeaderCancelled, ScoreCache
+
+__all__ = [
+    "CacheHandle",
+    "CoalescedLeaderCancelled",
+    "ScoreCache",
+    "canonical_rows",
+    "collapse_rows",
+    "features_digest",
+    "rows_as_bytes",
+]

@@ -3,9 +3,11 @@
 from .bench import (
     BenchReport,
     make_payload,
+    make_zipfian_payloads,
     run_closed_loop,
     run_closed_loop_mp,
     transfer_counters,
+    zipfian_indices,
 )
 from .client import (
     PredictClientError,
@@ -48,6 +50,8 @@ __all__ = [
     "merge_host_order",
     "BenchReport",
     "make_payload",
+    "make_zipfian_payloads",
     "run_closed_loop",
     "transfer_counters",
+    "zipfian_indices",
 ]

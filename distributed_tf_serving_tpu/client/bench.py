@@ -93,6 +93,53 @@ def make_payload(candidates: int = 1500, num_fields: int = 43, seed: int = 7):
     }
 
 
+def zipfian_indices(
+    n: int, pool_size: int, skew: float = 1.1, seed: int = 0
+) -> np.ndarray:
+    """Deterministic seeded zipfian index stream: n draws over
+    [0, pool_size) with P(i) ∝ 1/(i+1)^skew. The SAME (n, pool_size, skew,
+    seed) replays the identical sequence, so cache-on/cache-off A/B runs
+    serve the identical request stream — the anti-flattering requirement
+    for any cache measurement."""
+    if pool_size <= 0:
+        raise ValueError(f"pool_size must be positive, got {pool_size}")
+    rng = np.random.RandomState(seed)
+    p = np.arange(1, pool_size + 1, dtype=np.float64) ** -float(skew)
+    p /= p.sum()
+    return rng.choice(pool_size, size=n, p=p)
+
+
+def make_zipfian_payloads(
+    pool: int,
+    candidates: int,
+    num_fields: int = 43,
+    skew: float = 1.1,
+    seed: int = 0,
+    catalog: int = 4096,
+) -> list[dict[str, np.ndarray]]:
+    """`pool` payloads whose candidate ROWS are drawn zipfian (seeded, so
+    deterministic) from a catalog of `catalog` distinct candidate rows —
+    the CTR traffic shape the cache plane exists for: hot rows recur
+    WITHIN a payload (intra-batch duplicate collapse) and ACROSS payloads,
+    while whole-payload repeats (zipfian_indices over this pool) exercise
+    the exact-match score cache and single-flight coalescing."""
+    rng = np.random.RandomState(seed)
+    cat_ids = rng.randint(
+        0, 1 << 40, size=(catalog, num_fields)
+    ).astype(np.int64)
+    cat_wts = rng.rand(catalog, num_fields).astype(np.float32)
+    p = np.arange(1, catalog + 1, dtype=np.float64) ** -float(skew)
+    p /= p.sum()
+    out = []
+    for _ in range(pool):
+        rows = rng.choice(catalog, size=candidates, p=p)
+        out.append({
+            "feat_ids": np.ascontiguousarray(cat_ids[rows]),
+            "feat_wts": np.ascontiguousarray(cat_wts[rows]),
+        })
+    return out
+
+
 async def run_closed_loop(
     client: ShardedPredictClient,
     payload: dict[str, np.ndarray],
@@ -102,6 +149,7 @@ async def run_closed_loop(
     warmup_requests: int = 3,
     payload_pool: list[dict[str, np.ndarray]] | None = None,
     prepared: bool = False,
+    schedule: "np.ndarray | None" = None,
 ) -> BenchReport:
     """payload_pool, when given, varies the request bytes: worker w's i-th
     request sends pool[(w + i*STRIDE) % len(pool)] with STRIDE=73 (odd, so
@@ -113,6 +161,12 @@ async def run_closed_loop(
     `concurrency` would degenerate to period len(pool)/gcd and re-send a
     couple of payloads per worker.
 
+    schedule, when given with payload_pool, REPLACES the stride walk with
+    an explicit pool-index stream: worker w's i-th request sends
+    pool[schedule[(w*requests_per_worker + i) % len(schedule)]] — the
+    zipfian replay mode (zipfian_indices), where cache-on and cache-off
+    runs must serve the byte-identical request sequence.
+
     prepared=True hoists the request build+serialize out of the loop
     (client.prepare + predict_prepared): the reference methodology already
     fixes the payload once (DCNClient.java:208-210), so the serialized
@@ -122,6 +176,8 @@ async def run_closed_loop(
     if prepared and payload_pool:
         raise ValueError("prepared mode is for the single-payload methodology; "
                          "payload_pool must charge the full build path")
+    if schedule is not None and not payload_pool:
+        raise ValueError("schedule indexes payload_pool; provide both")
     prep = client.prepare(payload) if prepared else None
     for _ in range(warmup_requests):
         if prep is not None:
@@ -147,11 +203,14 @@ async def run_closed_loop(
                 latencies.append((time.perf_counter() - t0) * 1e3)
                 assert scores.shape[0] == prep.candidates
                 continue
-            p = (
-                payload_pool[(w + i * stride) % len(payload_pool)]
-                if payload_pool
-                else payload
-            )
+            if schedule is not None:
+                p = payload_pool[
+                    schedule[(w * requests_per_worker + i) % len(schedule)]
+                ]
+            elif payload_pool:
+                p = payload_pool[(w + i * stride) % len(payload_pool)]
+            else:
+                p = payload
             t0 = time.perf_counter()
             scores = await client.predict(p, sort_scores=sort_scores)
             latencies.append((time.perf_counter() - t0) * 1e3)

@@ -214,6 +214,7 @@ class ShardedPredictClient:
         health_probe: bool = False,
         keepalive_time_ms: int = 10_000,
         keepalive_timeout_ms: int = 5_000,
+        score_cache=None,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -269,6 +270,20 @@ class ShardedPredictClient:
         # Half-open ejected backends get a grpc.health.v1 Check before any
         # real traffic when enabled (needs a scoreboard to matter).
         self.health_probe = health_probe
+        # Optional client-local score cache (cache/score_cache.py — the
+        # SAME core the server's batcher uses, jax-free): an exact repeat
+        # of a recent predict() is answered without any RPC at all. OFF by
+        # default; pass a ScoreCache instance, or True for defaults.
+        # Degraded (partial) merges are NEVER cached — a reduced candidate
+        # set must not masquerade as the full ranking on later hits — and
+        # version-label routing rides the key, so a label retarget is only
+        # served stale within the cache's TTL (size it accordingly, or
+        # flush on retarget).
+        if score_cache is True:
+            from ..cache import ScoreCache
+
+            score_cache = ScoreCache()
+        self.score_cache = score_cache or None
         self.counters = ResilienceCounters()
         self._health_stubs: list[object | None] = [None] * len(self.hosts)
         # Long-lived plaintext channels per host, created once and shared
@@ -714,6 +729,29 @@ class ShardedPredictClient:
             degraded=True,
         )
 
+    def _cache_key(self, arrays: dict[str, np.ndarray], sort_scores: bool) -> tuple:
+        """Client cache key: model + label route + (signature, output key,
+        sort flag) + the same canonical feature digest the server cache
+        uses. The client never knows the resolved version number, so the
+        label (or "latest") is the version axis — the TTL bounds staleness
+        across retargets. The sort flag is part of the output contract
+        (the cached vector is stored exactly as it was returned)."""
+        return self.score_cache.make_key(
+            self.model_name,
+            self.version_label or "latest",
+            (self.signature_name, self.output_key, bool(sort_scores)),
+            arrays,
+        )
+
+    def _cache_serve(self, scores: np.ndarray):
+        """Shape a cached merged-score vector like a fresh predict()'s
+        return: copied (callers own their result arrays), wrapped in a
+        PredictResult when partial mode is on."""
+        out = scores.copy()
+        if self.partial_results:
+            return PredictResult(scores=out)
+        return out
+
     async def predict(
         self, arrays: dict[str, np.ndarray], sort_scores: bool = False
     ) -> "np.ndarray | PredictResult":
@@ -722,7 +760,28 @@ class ShardedPredictClient:
         PredictResult (possibly degraded) when partial_results is on, the
         plain merged score vector otherwise. With tracing on, this is the
         ROOT span of the distributed trace — every shard RPC (and the
-        server work it lands on) joins it via the injected traceparent."""
+        server work it lands on) joins it via the injected traceparent.
+        With a client score cache armed, an exact repeat of a recent
+        request returns its merged scores with no RPC at all."""
+        cache_key = None
+        if self.score_cache is not None:
+            cache_key = self._cache_key(arrays, sort_scores)
+            hit = self.score_cache.lookup(cache_key)
+            if hit is not None:
+                return self._cache_serve(hit["scores"])
+        result = await self._predict_uncached(arrays, sort_scores)
+        if cache_key is not None:
+            merged = result.scores if isinstance(result, PredictResult) else result
+            degraded = isinstance(result, PredictResult) and result.degraded
+            if not degraded:
+                # NEVER fill from a degraded merge: a reduced candidate set
+                # must not be served as the full ranking to later repeats.
+                self.score_cache.fill(cache_key, {"scores": merged})
+        return result
+
+    async def _predict_uncached(
+        self, arrays: dict[str, np.ndarray], sort_scores: bool
+    ) -> "np.ndarray | PredictResult":
         shards = shard_candidates(arrays, len(self.hosts))
         self._rr += 1
         rr = self._rr

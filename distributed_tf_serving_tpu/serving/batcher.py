@@ -59,6 +59,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import faults
+from ..cache import collapse_rows
 from ..models.base import Model
 from ..models.registry import Servable
 from ..ops.transfer import (
@@ -406,6 +407,11 @@ class BatcherStats:
     # Times coalescing waited past max_wait because the dispatch pipeline
     # was saturated (the wait was latency-free; see _coalesce_next).
     fill_waits: int = 0
+    # Intra-batch duplicate collapse (cache/dedup.py): batches whose
+    # combined rows held exact duplicates, and how many rows were never
+    # padded/uploaded/executed because of it (effective-batch shrink).
+    dedup_batches: int = 0
+    dedup_rows_collapsed: int = 0
     # Queued items shed because their propagated client deadline expired
     # before a dispatch slot opened (deadline propagation, ISSUE 2).
     deadline_sheds: int = 0
@@ -469,8 +475,19 @@ class DynamicBatcher:
         async_readback: bool = True,
         pipelined_dispatch: bool = True,
         donate_buffers: bool = True,
+        score_cache=None,
+        dedup: bool = False,
     ):
         self.compress_transfer = compress_transfer
+        # Cache plane (cache/): an exact-match ScoreCache short-circuits
+        # whole-request repeats at submit (hit = no queue, no device, no
+        # dispatch slot; identical concurrent misses single-flight onto one
+        # computation), and dedup collapses duplicate rows inside a
+        # combined batch before padding/upload. Both off by default; when
+        # score_cache is None / dedup False the hot path pays one attribute
+        # read per submit/dispatch — the tracing/faults precedent.
+        self.score_cache = score_cache
+        self.dedup = bool(dedup)
         # Output-transfer pipeline knobs (utils/config.py ServerConfig
         # carries the same names). wire dtype is validated HERE so a typo'd
         # config fails at construction, not at first dispatch.
@@ -657,6 +674,48 @@ class DynamicBatcher:
         if any(v != n for v in ns.values()):
             raise ValueError(f"inconsistent candidate counts across inputs: {ns}")
         bucket_for(n, self.buckets)  # validate size up front, raises if too big
+        # Score-cache lookup BEFORE admission: a hit (or a coalesced join
+        # onto an identical in-flight miss) bypasses the queue entirely —
+        # including the wedge/overload checks, deliberately: cached scores
+        # are servable even while the device is wedged or the queue full.
+        cache = self.score_cache
+        handle = None
+        if cache is not None and not _warmup:
+            with request_trace.span("cache.lookup"):
+                handle = cache.begin(
+                    servable.name, servable.version, output_keys, arrays
+                )
+            if handle.hit is not None:
+                if span is not None:
+                    span.attrs["cache_hit"] = True
+                fut: Future = Future()
+                fut.set_result(handle.hit)
+                return fut
+            if handle.waiter is not None:
+                if span is not None:
+                    span.attrs["cache_coalesced"] = True
+                return handle.waiter
+        try:
+            return self._submit_miss(
+                servable, arrays, n, output_keys, deadline_s, span, _warmup,
+                handle, cache,
+            )
+        except BaseException as exc:
+            if handle is not None and handle.leader:
+                # The leader never enqueued (admission refused, prepare
+                # failed): close the flight so coalesced waiters fail with
+                # the same error instead of hanging.
+                cache.abort(handle, exc)
+            raise
+
+    def _submit_miss(
+        self, servable, arrays, n, output_keys, deadline_s, span, _warmup,
+        handle, cache=None,
+    ) -> Future:
+        """The no-cache-hit tail of submit(): admission, prepare, enqueue
+        (exactly the pre-cache-plane submit body). The cache handle, when
+        this request leads a single-flight, is armed on the future so the
+        completion fans out to waiters and fills the cache."""
         # Admission BEFORE the defensive copy: a shed request must not pay
         # the copy/fold cost — overload is exactly when the host can least
         # afford it. Capacity is reserved under the lock so concurrent
@@ -700,7 +759,70 @@ class DynamicBatcher:
             self._items.append(item)
             self.stats.max_queue_depth = max(self.stats.max_queue_depth, len(self._items))
             self._cv.notify()
+        if handle is not None and handle.leader:
+            # Fill + waiter fan-out ride the future's completion (success,
+            # failure, or cancellation), on whichever thread resolves it.
+            # `cache` is the instance that MINTED the handle in submit()
+            # (passed down, never re-read from self here): detaching or
+            # swapping score_cache with leaders in flight (bench A/B
+            # teardown) must still close those leaders' flights, or their
+            # coalesced waiters hang. The leader's servable/arrays ride
+            # along so a deadline-killed leader's waiters can be
+            # re-dispatched instead of inheriting its deadline fate.
+            fut.add_done_callback(
+                lambda f, h=handle, c=cache, sv=servable, a=arrays,
+                ok=output_keys: self._cache_complete(c, h, f, sv, a, ok)
+            )
         return fut
+
+    def _cache_complete(
+        self, cache, handle, fut: Future, servable, arrays, output_keys
+    ) -> None:
+        """Close a single-flight leader's computation into the cache:
+        successful results fill (and wake coalesced waiters), failures fan
+        out. A leader killed by ITS OWN deadline (service-timeout cancel,
+        queued-deadline shed) does not doom its waiters — their budgets are
+        their own, so the computation is re-dispatched once on their
+        behalf (deadline-free; a fresh identical request would coalesce
+        onto it). Runs as a Future done-callback on a completer/service
+        thread."""
+        deadline_shaped = fut.cancelled() or isinstance(
+            fut.exception(), RequestDeadlineError
+        )
+        if deadline_shaped:
+            waiters = [
+                w for w in cache.take_waiters(handle) if not w.cancelled()
+            ]
+            if not waiters:
+                return
+            try:
+                retry = self.submit(servable, arrays, output_keys=output_keys)
+            except BaseException as exc:  # stopped/wedged/overloaded batcher
+                for w in waiters:
+                    try:
+                        w.set_exception(exc)
+                    except InvalidStateError:
+                        pass
+                return
+
+            def chain(rf: Future) -> None:
+                for w in waiters:
+                    if w.cancelled():
+                        continue
+                    try:
+                        if rf.cancelled():
+                            w.cancel()
+                        elif rf.exception() is not None:
+                            w.set_exception(rf.exception())
+                        else:
+                            w.set_result(rf.result())
+                    except InvalidStateError:
+                        pass
+
+            retry.add_done_callback(chain)
+            return
+        with request_trace.span("cache.fill"):
+            cache.complete(handle, fut)
 
     @staticmethod
     def warmup_arrays(servable: Servable, n: int) -> dict[str, np.ndarray]:
@@ -1320,9 +1442,66 @@ class DynamicBatcher:
                 and not first.servable.model.needs_x64
             ):
                 topk, n_valid = self.output_top_k, first.n
-            fused = self._fused_ctx(group, bucket)
+            # Intra-batch duplicate collapse (cache/dedup.py): exact-bytes
+            # duplicate rows across the combined batch execute ONCE; the
+            # completer scatters the unique rows' scores back into every
+            # requester's original order. Skipped for top-k batches (the
+            # returned indices address original rows) and warmup groups
+            # (all-zero warmup rows would collapse to one and compile the
+            # wrong bucket).
+            scatter = None
+            dedup_cats = None
+            if (
+                self.dedup
+                and not topk
+                and total > 1
+                and not any(it.warmup for it in group)
+            ):
+                with (tracing.collect_phases(phases) if phases is not None
+                      else _NULL_CTX), request_trace.span("batch.dedup"):
+                    uniq, scatter, dedup_cats = collapse_rows(
+                        {k: [it.arrays[k] for it in group] for k in first.arrays}
+                    )
+                if scatter is not None:
+                    n_unique = next(iter(uniq.values())).shape[0]
+                    bucket = bucket_for(n_unique, self.buckets)
+                    self.stats.dedup_batches += 1
+                    self.stats.dedup_rows_collapsed += total - n_unique
+            # A collapsed batch skips the fused assembler: its native pack
+            # reads the ORIGINAL per-request parts, which would re-inflate
+            # the rows dedup just removed.
+            fused = None if scatter is not None else self._fused_ctx(group, bucket)
+            if fused is not None and dedup_cats is not None:
+                # All-unique screen with the fused path winning: hand the
+                # packer the screen's concatenated arrays as single parts
+                # (its output is row-sequential, so one pre-concatenated
+                # part packs bit-identically to the original part list) —
+                # the screen's concat is reused here too, never discarded.
+                fused["ids_parts"] = [dedup_cats["feat_ids"]]
+                fused["wts_parts"] = [dedup_cats["feat_wts"]]
             batched = None
-            if fused is None:
+            if fused is None and (scatter is not None or dedup_cats is not None):
+                # Pad from the dedup screen's arrays: the unique rows when
+                # duplicates collapsed, else the concatenated batch
+                # collapse_rows built anyway (all-unique outcome) — never
+                # a SECOND concat of the same parts.
+                src = uniq if scatter is not None else dedup_cats
+                batched = {}
+                with (tracing.collect_phases(phases) if phases is not None
+                      else _NULL_CTX), request_trace.span("batch.pad"):
+                    for k, arr in src.items():
+                        if arr.shape[0] == bucket:
+                            # Owned either way: a multi-part concat, a
+                            # first-occurrence gather, or a single item's
+                            # prepare_inputs-owned array (same passthrough
+                            # contract as the generic pad path below).
+                            batched[k] = arr
+                            continue
+                        out = np.empty((bucket,) + arr.shape[1:], arr.dtype)
+                        out[: arr.shape[0]] = arr
+                        out[arr.shape[0]:] = 0  # padding rows
+                        batched[k] = out
+            elif fused is None:
                 keys = list(first.arrays.keys())
                 batched = {}
                 with (tracing.collect_phases(phases) if phases is not None
@@ -1355,7 +1534,7 @@ class DynamicBatcher:
         if self._dispatcher is None:
             self._run_stage(
                 None, group, total, bucket, wanted, wanted_key,
-                topk, n_valid, fused, batched, phases,
+                topk, n_valid, fused, batched, phases, scatter,
             )
             return
         with self._cv:
@@ -1366,7 +1545,7 @@ class DynamicBatcher:
             self._dispatch_pending += 1
         self._dispatcher.submit(
             self._run_stage, sid, group, total, bucket, wanted, wanted_key,
-            topk, n_valid, fused, batched, phases,
+            topk, n_valid, fused, batched, phases, scatter,
         )
         # Backpressure: at most one group may queue behind the running
         # stage — enough to keep the pipeline full (assembly of k+1
@@ -1393,6 +1572,7 @@ class DynamicBatcher:
         fused: dict | None,
         batched: dict | None,
         phases: list | None = None,
+        scatter: "np.ndarray | None" = None,
     ) -> None:
         """Device stage for one assembled batch: execute, issue the async
         D2H readback, register in flight, hand off to a completer. Runs on
@@ -1528,7 +1708,7 @@ class DynamicBatcher:
                 _replay_group_phases(group, phases)
                 phases = None  # a later submit() failure must not re-replay
             self._completers.submit(
-                self._complete, batch_id, group, fetch, issue_t0, meta
+                self._complete, batch_id, group, fetch, issue_t0, meta, scatter
             )
         except Exception as exc:  # propagate to every waiter, keep serving
             if phases is not None:
@@ -1549,6 +1729,7 @@ class DynamicBatcher:
     def _complete(
         self, batch_id: int, group: list[_WorkItem], outputs,
         issue_t0: float | None = None, meta: dict | None = None,
+        scatter: "np.ndarray | None" = None,
     ) -> None:
         phases: list | None = (
             [] if tracing.enabled() and any(it.span is not None for it in group)
@@ -1599,6 +1780,12 @@ class DynamicBatcher:
                 # declaring DT_HALF/DT_BFLOAT16) must pass through
                 # untouched, exactly as before this pipeline existed.
                 host = restore_outputs_host(host)
+            if scatter is not None:
+                # Dedup scatter: the executable saw only the batch's unique
+                # rows; fan their scores back out to every original row
+                # position, so the per-request slices below are exactly
+                # what an uncollapsed execution would have produced.
+                host = {k: v[scatter] for k, v in host.items()}
             if phases is not None:
                 # Attach the readback phases before the waiters unblock —
                 # a root span must already hold its full tree when the RPC

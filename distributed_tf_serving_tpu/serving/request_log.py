@@ -80,33 +80,75 @@ class RequestLogger:
         except Exception:  # noqa: BLE001 — logging must never cost a request
             log.exception("request sampling failed")
 
+    def stats(self) -> dict:
+        """Written/dropped/queued accounting for /monitoring: a log queue
+        shedding under load must be observable without grepping stderr."""
+        return {
+            "path": str(self.path),
+            "sampling_rate": self.sampling_rate,
+            "written": self.written,
+            "dropped": self.dropped,
+            "queued": self._queue.qsize(),
+        }
+
     # --------------------------------------------------------------- writer
 
-    def _loop(self) -> None:
+    def _write_record(self, kind: str, payload: bytes) -> None:
+        """Frame + write one sampled record (writer thread, or close()'s
+        residual drain — never the request path)."""
         from ..proto import serving_apis_pb2 as apis
 
+        try:
+            plog = apis.PredictionLog()
+            getattr(plog, _KIND_FIELDS[kind]).request.MergeFromString(payload)
+            # One write + flush per record: a crash/SIGKILL can
+            # truncate at most the FINAL record, never interleave.
+            self._file.write(frame_tfrecord(plog.SerializeToString()))
+            self._file.flush()
+            self.written += 1
+        except Exception:  # noqa: BLE001 — keep draining
+            log.exception("request-log write failed")
+
+    def _loop(self) -> None:
         while True:
             item = self._queue.get()
             if item is None:
                 return
-            kind, payload = item
-            try:
-                plog = apis.PredictionLog()
-                getattr(plog, _KIND_FIELDS[kind]).request.MergeFromString(payload)
-                # One write + flush per record: a crash/SIGKILL can
-                # truncate at most the FINAL record, never interleave.
-                self._file.write(frame_tfrecord(plog.SerializeToString()))
-                self._file.flush()
-                self.written += 1
-            except Exception:  # noqa: BLE001 — keep draining
-                log.exception("request-log write failed")
+            self._write_record(*item)
 
     def close(self) -> None:
-        """Drain and close; idempotent."""
+        """Flush every pending record, then close; idempotent.
+
+        The sentinel rides the FIFO queue behind any pending entries, so
+        the writer drains them before exiting; records that slipped in
+        behind the sentinel (or are left behind an already-exited writer)
+        are written synchronously here rather than discarded — sampled
+        records already accepted are evidence, and close() is the last
+        chance to keep them. A WEDGED writer that outlives the join
+        timeout keeps ownership of the file: closing it under a live
+        writer would interleave/corrupt the record stream, so close()
+        leaves the (daemon) thread to finish and reports what is still
+        queued — a later close() retries."""
         if self._thread.is_alive():
             self._queue.put(None)
             self._thread.join(timeout=10)
+        if self._thread.is_alive():
+            log.warning(
+                "request log %s: writer still busy after close timeout; "
+                "leaving the file to it (%d records queued)",
+                self.path, self._queue.qsize(),
+            )
+            return
         if not self._file.closed:
+            # Residual drain: anything still queued (entries enqueued after
+            # the sentinel was inserted) flushes before the file closes.
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    self._write_record(*item)
             self._file.close()
         if self.dropped:
             log.warning(

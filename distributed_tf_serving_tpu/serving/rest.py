@@ -127,6 +127,10 @@ class RestGateway:
             # ?format=chrome exports Perfetto-loadable trace-event JSON).
             web.get("/monitoring", self.monitoring),
             web.get("/tracez", self.tracez),
+            # Cache plane (ISSUE 4): per-model hit/miss/coalesced/eviction
+            # counters + occupancy/config, and the operator flush control.
+            web.get("/cachez", self.cachez),
+            web.post("/cachez/flush", self.cachez_flush),
         ])
 
     # ------------------------------------------------------------- helpers
@@ -447,7 +451,9 @@ class RestGateway:
     async def prometheus(self, request: web.Request) -> web.Response:
         stats = getattr(self.impl.batcher, "stats", None)
         return web.Response(
-            body=self.metrics.prometheus_text(stats).encode("utf-8"),
+            body=self.metrics.prometheus_text(
+                stats, cache=self.impl.cache_stats()
+            ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
             },
@@ -464,6 +470,14 @@ class RestGateway:
             "enabled": tracing.enabled(),
             "recorded": tracing.recorder().recorded,
         }
+        cache = self.impl.cache_stats()
+        if cache is not None:
+            snap["cache"] = cache
+        logger = getattr(self.impl, "request_logger", None)
+        if logger is not None:
+            # Written/dropped accounting for the sampled PredictionLog
+            # writer — a silently-shedding log queue must be visible here.
+            snap["request_log"] = logger.stats()
         return web.json_response(snap)
 
     async def tracez(self, request: web.Request) -> web.Response:
@@ -481,6 +495,25 @@ class RestGateway:
         body = rec.tracez(limit=limit)
         body["enabled"] = tracing.enabled()
         return web.json_response(body, dumps=dumps)
+
+    async def cachez(self, request: web.Request) -> web.Response:
+        """GET /cachez: the score-cache introspection surface — aggregate +
+        per-model hit/miss/coalesced/eviction/expiration counters, hit
+        rate, entry/byte occupancy, and the active config. `{"enabled":
+        false}` when no cache is armed (the route always answers, so
+        probes need no config knowledge)."""
+        stats = self.impl.cache_stats()
+        return web.json_response(stats if stats is not None else {"enabled": False})
+
+    async def cachez_flush(self, request: web.Request) -> web.Response:
+        """POST /cachez/flush[?model=NAME]: drop every cached score (or one
+        model's). The flush is generation-bumped, so results filled by
+        computations already in flight are dropped too."""
+        try:
+            dropped = self.impl.cache_flush(request.query.get("model") or None)
+        except ServiceError as e:
+            return _json_error(e.code, str(e))
+        return web.json_response({"flushed": True, "entries_dropped": dropped})
 
     async def status(self, request: web.Request) -> web.Response:
         # ONE status implementation: delegate to the ModelService RPC body

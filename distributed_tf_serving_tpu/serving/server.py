@@ -596,6 +596,7 @@ class ModelLifecycle:
         from .version_watcher import VersionWatcher, VersionWatcherConfig
 
         cfg, batcher = self._cfg, self._batcher
+        score_cache = getattr(batcher, "score_cache", None)
         kind = mc.model_platform or cfg.model_kind
         if kind == "tensorflow":  # upstream's only platform string
             kind = cfg.model_kind
@@ -619,6 +620,11 @@ class ModelLifecycle:
             model_config=self._model_config,
             mesh=self._mesh,
             tensor_parallel=cfg.tensor_parallel,
+            # Version swaps drop the swapped model's cached scores the
+            # moment the registry flips (cache-plane generation hook).
+            on_servable_change=(
+                score_cache.invalidate_model if score_cache is not None else None
+            ),
         ).start()
 
     @staticmethod
@@ -721,6 +727,7 @@ def build_stack(
     savedmodel: str | None = None,
     model_config: ModelConfig | None = None,
     model_base_path: str | None = None,
+    cache_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -728,7 +735,10 @@ def build_stack(
     model_base_path switches to TF-Serving's versioned-directory lifecycle
     (serving/version_watcher.py) instead of a fixed artifact;
     cfg.model_config_file switches to MULTI-model serving (one watcher per
-    model_config_list entry)."""
+    model_config_list entry). cache_config (the TOML [cache] section, a
+    utils.config.CacheConfig) arms the cache plane: an exact-match score
+    cache + single-flight coalescing at submit, intra-batch dedup in the
+    batcher, generation invalidation wired to every version watcher."""
     # Validate the multi-model config (and its exclusivity) BEFORE any
     # threads exist — a typo'd file must leave nothing to tear down.
     model_configs = None
@@ -759,6 +769,14 @@ def build_stack(
             tensor_parallel=cfg.tensor_parallel,
             output_wire_dtype=cfg.output_wire_dtype,
         )
+    score_cache = cache_config.build() if cache_config is not None else None
+    if score_cache is not None:
+        log.info(
+            "score cache on: max_entries=%d max_bytes=%d ttl_s=%.1f "
+            "coalesce=%s dedup=%s — GET /cachez on the REST surface",
+            cache_config.max_entries, cache_config.max_bytes,
+            cache_config.ttl_s, cache_config.coalesce, cache_config.dedup,
+        )
     batcher = DynamicBatcher(
         buckets=cfg.buckets,
         max_wait_us=cfg.max_wait_us,
@@ -772,6 +790,13 @@ def build_stack(
         async_readback=cfg.async_readback,
         pipelined_dispatch=cfg.pipelined_dispatch,
         donate_buffers=cfg.donate_buffers,
+        score_cache=score_cache,
+        # `enabled` is the MASTER switch for the whole cache plane: a
+        # config with enabled=false and dedup=true must arm nothing.
+        dedup=(
+            cache_config.enabled and cache_config.dedup
+            if cache_config is not None else False
+        ),
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
     # Health gating: the grpc.health.v1 servicer reports the overall server
@@ -833,6 +858,9 @@ def build_stack(
             or ModelConfig(name=cfg.model_name, num_fields=cfg.num_fields),
             mesh=mesh,
             tensor_parallel=cfg.tensor_parallel,
+            on_servable_change=(
+                score_cache.invalidate_model if score_cache is not None else None
+            ),
         ).start()
         # Label-only reloads may re-state this source verbatim (deploy
         # tools replay full configs); anything ELSE is a rejected move.
@@ -938,6 +966,13 @@ def serve(argv=None) -> None:
         "loadable export). Equivalent to [observability] tracing=true",
     )
     parser.add_argument(
+        "--cache", action="store_true", default=None,
+        help="exact-match score cache + single-flight coalescing at the "
+        "batcher (cache/score_cache.py; GET /cachez on the REST surface). "
+        "Equivalent to [cache] enabled=true; the [cache] section carries "
+        "the capacity/ttl/coalesce/dedup knobs",
+    )
+    parser.add_argument(
         "--batching-parameters-file", dest="batching_parameters_file",
         help="tensorflow_model_server-format batching config (text-format "
         "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
@@ -983,13 +1018,16 @@ def serve(argv=None) -> None:
     )
     args = parser.parse_args(argv)
 
-    from ..utils.config import ObservabilityConfig
+    from ..utils.config import CacheConfig, ObservabilityConfig
 
     cfgs = load_config(args.config) if args.config else {"server": ServerConfig()}
     cfg = cfgs["server"]
     obs = cfgs.get("observability") or ObservabilityConfig()
     if args.tracing:
         obs = dataclasses.replace(obs, tracing=True)
+    cache_config = cfgs.get("cache") or CacheConfig()
+    if args.cache:
+        cache_config = dataclasses.replace(cache_config, enabled=True)
     model_config = cfgs.get("model")
     if model_config is not None:
         # Explicit CLI architecture flags win over the TOML [model] section
@@ -1042,6 +1080,7 @@ def serve(argv=None) -> None:
         savedmodel=args.savedmodel,
         model_config=model_config,
         model_base_path=args.model_base_path,
+        cache_config=cache_config,
     )
     request_logger = None
     if cfg.request_log_file:

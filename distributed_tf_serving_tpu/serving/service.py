@@ -90,6 +90,28 @@ class PredictionServiceImpl:
         if self.request_logger is not None:
             self.request_logger.maybe_log(kind, request)
 
+    # ----------------------------------------------------------- cache plane
+
+    def cache_stats(self) -> dict | None:
+        """Cache-plane snapshot (per-model hit/miss/coalesced/eviction
+        counters, occupancy, config) — the body of GET /cachez and the
+        `cache` block in /monitoring. None when no score cache is armed,
+        so both surfaces can distinguish "disabled" from "cold"."""
+        cache = getattr(self.batcher, "score_cache", None)
+        return cache.snapshot() if cache is not None else None
+
+    def cache_flush(self, model: str | None = None) -> int:
+        """Operator flush control: drop every cached score (or one
+        model's), generation-bumped so in-flight fills of the flushed
+        entries die too. Returns the number of entries dropped."""
+        cache = getattr(self.batcher, "score_cache", None)
+        if cache is None:
+            raise ServiceError(
+                "FAILED_PRECONDITION",
+                "no score cache is configured ([cache] enabled=false)",
+            )
+        return cache.flush(model)
+
     def is_configured(self, name: str) -> bool:
         """True when this server is CONFIGURED to serve `name` (a watcher
         or lifecycle owns it), whether or not a version is ready yet — the

@@ -156,6 +156,13 @@ class VersionWatcher:
         model_config=None,  # ModelConfig for SavedModel version dirs
         mesh=None,  # restore-time placement for native checkpoints
         tensor_parallel: bool = False,
+        # on_servable_change(model_name) fires after every registry
+        # mutation this watcher performs (version loaded or retired) —
+        # the cache plane's generation-invalidation hook: a version swap
+        # must drop the old generation's cached scores the moment the
+        # registry flips, not at TTL expiry. Must not raise; exceptions
+        # are logged and never fail the load/retire that triggered them.
+        on_servable_change: Callable[[str], None] | None = None,
     ):
         self.base_path = pathlib.Path(base_path)
         self.registry = registry
@@ -166,6 +173,7 @@ class VersionWatcher:
         self.model_config = model_config
         self.mesh = mesh
         self.tensor_parallel = tensor_parallel
+        self.on_servable_change = on_servable_change
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="version-watcher", daemon=True
@@ -246,6 +254,7 @@ class VersionWatcher:
                 self._attempts.pop(version, None)
                 self._attempt_mtime.pop(version, None)
                 log.info("loaded %s v%d from %s", name, version, path)
+                self._notify_change(name)
             except Exception:
                 self._attempts[version] = self._attempts.get(version, 0) + 1
                 self._attempt_mtime[version] = pre_mtime
@@ -271,6 +280,7 @@ class VersionWatcher:
             self.registry.unload(name, version)
             log.info("retired %s v%d (retention window %d)",
                      name, version, self.config.keep_versions)
+            self._notify_change(name)
 
         # Startup-label seeding: assign each desired label the moment its
         # version is loaded, ONCE (retrying only while pending) — from then
@@ -291,6 +301,16 @@ class VersionWatcher:
                     )
 
     # ------------------------------------------------------------ internals
+
+    def _notify_change(self, name: str) -> None:
+        """Fire the servable-change hook; a hook failure must never fail
+        the load/retire that triggered it."""
+        if self.on_servable_change is None:
+            return
+        try:
+            self.on_servable_change(name)
+        except Exception:  # noqa: BLE001 — hook bugs stay out of the lifecycle
+            log.exception("on_servable_change hook failed for %s", name)
 
     def _default_loader(self, version: int, path: pathlib.Path) -> Servable:
         import dataclasses as dc

@@ -192,6 +192,46 @@ class ObservabilityConfig:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Cache-plane knobs (cache/score_cache.py + cache/dedup.py): the
+    exact-match score cache with single-flight coalescing at
+    batcher.submit, and intra-batch duplicate collapse in the batcher.
+    Everything defaults OFF and, when off, costs one attribute read on the
+    hot path (the tracing/faults precedent)."""
+
+    # Master switch: build a ScoreCache and hand it to the batcher.
+    enabled: bool = False
+    # LRU capacity in entries and in cached-score bytes (whichever binds
+    # first; split across the sharded locks).
+    max_entries: int = 8192
+    max_bytes: int = 64 << 20
+    # Shelf life per entry: CTR scores decay with state not in the request
+    # (user history, budget pacing), so exact-match hits are only served
+    # this long after the computation that produced them. Version swaps
+    # invalidate eagerly regardless (version-watcher hook).
+    ttl_s: float = 30.0
+    # Single-flight: concurrent IDENTICAL misses ride one computation
+    # (one leader executes, every waiter gets its scores).
+    coalesce: bool = True
+    # Intra-batch duplicate collapse: exact-duplicate rows within a
+    # combined batch execute once, scores scattered back per requester.
+    dedup: bool = False
+
+    def build(self):
+        """ScoreCache per this config, or None when disabled."""
+        if not self.enabled:
+            return None
+        from ..cache import ScoreCache
+
+        return ScoreCache(
+            max_entries=self.max_entries,
+            max_bytes=self.max_bytes,
+            ttl_s=self.ttl_s,
+            coalesce=self.coalesce,
+        )
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -202,6 +242,7 @@ _SECTIONS = {
     "server": ServerConfig,
     "client": ClientConfig,
     "observability": ObservabilityConfig,
+    "cache": CacheConfig,
 }
 
 

@@ -394,10 +394,16 @@ class ServerMetrics:
                 # Resilience layer: queued work shed because its propagated
                 # client deadline expired.
                 "deadline_sheds": getattr(batcher_stats, "deadline_sheds", 0),
+                # Cache plane: combined batches whose duplicate rows were
+                # collapsed before upload, and the rows never executed.
+                "dedup_batches": getattr(batcher_stats, "dedup_batches", 0),
+                "dedup_rows_collapsed": getattr(
+                    batcher_stats, "dedup_rows_collapsed", 0
+                ),
             }
         return out
 
-    def prometheus_text(self, batcher_stats=None) -> str:
+    def prometheus_text(self, batcher_stats=None, cache=None) -> str:
         """Prometheus exposition (text format 0.0.4) of the same data
         snapshot() serves as JSON. Metric names mirror tensorflow_model_
         server's monitoring surface (`:tensorflow:serving:request_count` /
@@ -487,9 +493,46 @@ class ServerMetrics:
                  round(batcher_stats.readback_overlap_fraction, 4)),
                 ("dts_tpu_batcher_deadline_sheds_total", "counter",
                  getattr(batcher_stats, "deadline_sheds", 0)),
+                ("dts_tpu_batcher_dedup_batches_total", "counter",
+                 getattr(batcher_stats, "dedup_batches", 0)),
+                ("dts_tpu_batcher_dedup_rows_collapsed_total", "counter",
+                 getattr(batcher_stats, "dedup_rows_collapsed", 0)),
             ):
                 lines.append(f"# TYPE {metric} {kind}")
                 lines.append(f"{metric} {value}")
+        if cache is not None:
+            # Cache plane (ISSUE 4): the ScoreCache snapshot dict as
+            # dts_tpu_cache_* series — aggregate counters/gauges plus
+            # per-model hit/miss/coalesced/eviction counters.
+            for metric, kind, value in (
+                ("dts_tpu_cache_hits_total", "counter", cache.get("hits", 0)),
+                ("dts_tpu_cache_misses_total", "counter", cache.get("misses", 0)),
+                ("dts_tpu_cache_coalesced_total", "counter",
+                 cache.get("coalesced", 0)),
+                ("dts_tpu_cache_evictions_total", "counter",
+                 cache.get("evictions", 0)),
+                ("dts_tpu_cache_expirations_total", "counter",
+                 cache.get("expirations", 0)),
+                ("dts_tpu_cache_invalidations_total", "counter",
+                 cache.get("invalidations", 0)),
+                ("dts_tpu_cache_hit_rate", "gauge", cache.get("hit_rate", 0.0)),
+                ("dts_tpu_cache_entries", "gauge", cache.get("entries", 0)),
+                ("dts_tpu_cache_value_bytes", "gauge",
+                 cache.get("value_bytes", 0)),
+            ):
+                lines.append(f"# TYPE {metric} {kind}")
+                lines.append(f"{metric} {value}")
+            models = cache.get("models") or {}
+            if models:
+                mc = "dts_tpu_cache_model_events_total"
+                lines.append(f"# TYPE {mc} counter")
+                for model, counters in sorted(models.items()):
+                    base = f'model_name="{esc(model)}"'
+                    for event in ("hits", "misses", "coalesced", "evictions"):
+                        lines.append(
+                            f'{mc}{{{base},event="{event}"}} '
+                            f'{counters.get(event, 0)}'
+                        )
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,797 @@
+"""Cache plane (distributed_tf_serving_tpu/cache/, ISSUE 4): canonical
+digest invariance, LRU+TTL+byte-bound eviction, generation invalidation on
+version swap (direct and through the version-watcher hook), single-flight
+coalescing under real concurrency, degraded-results-never-cached, intra-
+batch dedup scatter correctness vs uncached scores, disabled-mode
+inertness, zipfian workload determinism, and the /cachez surface."""
+
+import asyncio
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_tf_serving_tpu import codec
+from distributed_tf_serving_tpu.cache import (
+    CoalescedLeaderCancelled,
+    ScoreCache,
+    collapse_rows,
+    features_digest,
+)
+from distributed_tf_serving_tpu.client.bench import (
+    make_zipfian_payloads,
+    zipfian_indices,
+)
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.serving import DynamicBatcher, PredictionServiceImpl
+from distributed_tf_serving_tpu.serving.batcher import fold_ids_host
+
+F = 6
+VOCAB = 1 << 10
+CFG = ModelConfig(
+    name="DCN", num_fields=F, vocab_size=VOCAB, embed_dim=4,
+    mlp_dims=(8,), num_cross_layers=1, compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def servable():
+    model = build_model("dcn", CFG)
+    return Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(F),
+    )
+
+
+def make_arrays(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, 1 << 40, size=(n, F)).astype(np.int64),
+        "feat_wts": rng.rand(n, F).astype(np.float32),
+    }
+
+
+def reference_scores(servable, arrays):
+    batch = {
+        "feat_ids": fold_ids_host(arrays["feat_ids"], VOCAB),
+        "feat_wts": arrays["feat_wts"],
+    }
+    return np.asarray(
+        servable.model.apply(servable.params, batch)["prediction_node"]
+    )
+
+
+# ---------------------------------------------------------------- digests
+
+
+def test_digest_invariant_across_proto_encodings():
+    """Satellite: two protobuf encodings of the same features (raw
+    tensor_content bytes vs repeated *_val fields — both wire shapes the
+    reference client emits) must digest identically after decode."""
+    arrays = make_arrays(5, seed=3)
+    digests = []
+    for use_content in (True, False):
+        decoded = {}
+        for name, arr in arrays.items():
+            proto = codec.from_ndarray(arr, use_tensor_content=use_content)
+            assert bool(proto.tensor_content) == use_content
+            decoded[name] = codec.to_ndarray(proto)
+        digests.append(features_digest(decoded))
+    assert digests[0] == digests[1]
+
+
+def test_digest_distinguishes_structure_and_content():
+    a = make_arrays(4, seed=0)
+    assert features_digest(a) == features_digest({k: v.copy() for k, v in a.items()})
+    b = {k: v.copy() for k, v in a.items()}
+    b["feat_wts"][2, 1] += 1e-3
+    assert features_digest(a) != features_digest(b)
+    # Same raw bytes under a different structure must not collide: the
+    # compact wire (int32 folded ids) digests apart from the wide wire.
+    compact = {
+        "feat_ids": fold_ids_host(a["feat_ids"], VOCAB),
+        "feat_wts": a["feat_wts"],
+    }
+    assert features_digest(a) != features_digest(compact)
+    # Input NAMES are part of the canonical form.
+    assert features_digest({"x": a["feat_ids"]}) != features_digest(
+        {"y": a["feat_ids"]}
+    )
+
+
+# ------------------------------------------------------- store semantics
+
+
+def _key(cache, i, model="DCN", version=1):
+    return cache.make_key(model, version, None, {"feat_ids": np.full((2, 2), i, np.int64)})
+
+
+def _val(n=4):
+    return {"prediction_node": np.arange(n, dtype=np.float32)}
+
+
+def test_lru_entry_eviction():
+    cache = ScoreCache(max_entries=4, shards=1)
+    keys = [_key(cache, i) for i in range(6)]
+    for k in keys:
+        assert cache.fill(k, _val())
+    # 0 and 1 evicted (LRU), 2..5 resident.
+    assert cache.lookup(keys[0]) is None
+    assert cache.lookup(keys[1]) is None
+    for k in keys[2:]:
+        assert cache.lookup(k) is not None
+    snap = cache.snapshot()
+    assert snap["evictions"] == 2
+    assert snap["entries"] == 4
+
+
+def test_lru_recency_protects_hot_entries():
+    cache = ScoreCache(max_entries=2, shards=1)
+    k0, k1, k2 = (_key(cache, i) for i in range(3))
+    cache.fill(k0, _val())
+    cache.fill(k1, _val())
+    assert cache.lookup(k0) is not None  # touch: k0 becomes MRU
+    cache.fill(k2, _val())  # evicts k1, not k0
+    assert cache.lookup(k0) is not None
+    assert cache.lookup(k1) is None
+
+
+def test_byte_bound_eviction():
+    # Each value is 400 bytes; a 1000-byte budget holds 2.
+    cache = ScoreCache(max_entries=1000, max_bytes=1000, shards=1)
+    keys = [_key(cache, i) for i in range(3)]
+    for k in keys:
+        assert cache.fill(k, {"s": np.zeros(100, np.float32)})
+    assert cache.entry_count() == 2
+    assert cache.lookup(keys[0]) is None
+    assert cache.value_bytes() <= 1000
+    # A single value larger than the whole budget is refused outright.
+    assert not cache.fill(_key(cache, 9), {"s": np.zeros(1024, np.float32)})
+
+
+def test_ttl_expiry_with_fake_clock():
+    now = [0.0]
+    cache = ScoreCache(ttl_s=10.0, clock=lambda: now[0])
+    k = _key(cache, 0)
+    cache.fill(k, _val())
+    now[0] = 9.9
+    assert cache.lookup(k) is not None
+    now[0] = 10.1
+    assert cache.lookup(k) is None  # expired exactly past fill + ttl
+    assert cache.snapshot()["expirations"] == 1
+    assert cache.entry_count() == 0
+
+
+def test_version_swap_invalidation():
+    cache = ScoreCache()
+    k1 = cache.make_key("DCN", 1, None, make_arrays(3))
+    k_other = cache.make_key("OTHER", 1, None, make_arrays(3))
+    cache.fill(k1, _val())
+    cache.fill(k_other, _val())
+    dropped = cache.invalidate_model("DCN")
+    assert dropped == 1
+    assert cache.lookup(k1) is None
+    # Other models' entries survive.
+    assert cache.lookup(k_other) is not None
+    assert cache.snapshot()["models"]["DCN"]["invalidations"] == 1
+
+
+def test_stale_generation_fill_refused():
+    """A fill whose computation started before a version swap must not
+    land: the old version's scores would otherwise enter the NEW
+    generation's store."""
+    cache = ScoreCache()
+    handle = cache.begin("DCN", 1, None, make_arrays(2))
+    assert handle.leader
+    cache.invalidate_model("DCN")
+    assert not cache.fill(handle.key, _val(), gen=handle.gen)
+    assert cache.entry_count() == 0
+
+
+def test_flush_all_and_per_model():
+    cache = ScoreCache()
+    cache.fill(cache.make_key("A", 1, None, make_arrays(2)), _val())
+    cache.fill(cache.make_key("B", 1, None, make_arrays(2, seed=1)), _val())
+    assert cache.flush("A") == 1
+    assert cache.entry_count() == 1
+    assert cache.flush() == 1
+    assert cache.entry_count() == 0
+
+
+# ------------------------------------------------------- single flight
+
+
+def test_single_flight_via_store_api():
+    cache = ScoreCache()
+    leader = cache.begin("DCN", 1, None, make_arrays(2))
+    assert leader.leader and leader.hit is None
+    waiter = cache.begin("DCN", 1, None, make_arrays(2))
+    assert waiter.waiter is not None and not waiter.leader
+    fut: Future = Future()
+    fut.set_result(_val())
+    cache.complete(leader, fut)
+    got = waiter.waiter.result(timeout=1)
+    np.testing.assert_array_equal(got["prediction_node"], _val()["prediction_node"])
+    # The flight's fill is live: a third identical request hits.
+    third = cache.begin("DCN", 1, None, make_arrays(2))
+    assert third.hit is not None
+    assert cache.snapshot()["coalesced"] == 1
+
+
+def test_single_flight_leader_cancelled_fails_waiters_as_timeout():
+    cache = ScoreCache()
+    leader = cache.begin("DCN", 1, None, make_arrays(2))
+    waiter = cache.begin("DCN", 1, None, make_arrays(2))
+    fut: Future = Future()
+    fut.cancel()
+    cache.complete(leader, fut)
+    with pytest.raises(CoalescedLeaderCancelled):
+        waiter.waiter.result(timeout=1)
+    assert cache.entry_count() == 0  # a cancellation never fills
+
+
+def test_single_flight_coalesces_concurrent_misses(servable):
+    """N identical concurrent submits -> ONE device computation; every
+    waiter gets the same scores; coalesced counter records N-1."""
+    runs = []
+    run_done = threading.Event()
+
+    def slow_run(sv, arrays):
+        runs.append(arrays["feat_ids"].shape)
+        run_done.wait(timeout=5)  # hold the leader so followers coalesce
+        n = arrays["feat_ids"].shape[0]
+        ids = arrays["feat_ids"].astype(np.float32)
+        return {"prediction_node": ids.sum(axis=1) / (1 + np.arange(n))}
+
+    cache = ScoreCache()
+    batcher = DynamicBatcher(
+        buckets=(32,), max_wait_us=0, run_fn=slow_run, score_cache=cache,
+    ).start()
+    try:
+        arrays = make_arrays(8, seed=5)
+        futs = [
+            batcher.submit(servable, arrays, output_keys=("prediction_node",))
+            for _ in range(6)
+        ]
+        run_done.set()
+        results = [f.result(timeout=30)["prediction_node"] for f in futs]
+        for r in results[1:]:
+            np.testing.assert_array_equal(results[0], r)
+        assert len(runs) == 1, f"expected one device pass, saw {len(runs)}"
+        snap = cache.snapshot()
+        assert snap["coalesced"] == 5
+        assert snap["misses"] == 1
+        # Post-flight: an identical submit is a plain hit, still one run.
+        hit = batcher.submit(
+            servable, arrays, output_keys=("prediction_node",)
+        ).result(timeout=5)["prediction_node"]
+        np.testing.assert_array_equal(hit, results[0])
+        assert len(runs) == 1
+        assert cache.snapshot()["hits"] == 1
+    finally:
+        run_done.set()
+        batcher.stop()
+
+
+def test_failed_leader_fans_failure_out_and_never_fills(servable):
+    hold = threading.Event()
+
+    def failing_run(sv, arrays):
+        hold.wait(timeout=5)
+        raise RuntimeError("device exploded")
+
+    cache = ScoreCache()
+    batcher = DynamicBatcher(
+        buckets=(32,), max_wait_us=0, run_fn=failing_run, score_cache=cache,
+    ).start()
+    try:
+        arrays = make_arrays(4, seed=9)
+        futs = [batcher.submit(servable, arrays) for _ in range(3)]
+        hold.set()
+        for f in futs:
+            with pytest.raises(RuntimeError, match="device exploded"):
+                f.result(timeout=30)
+        assert cache.entry_count() == 0  # failures are never cached
+        assert cache.snapshot()["coalesced"] == 2
+    finally:
+        hold.set()
+        batcher.stop()
+
+
+def test_cached_scores_bit_identical_and_bypass_queue(servable):
+    """The acceptance property: hit scores are BIT-identical to the
+    uncached computation, and a hit resolves without touching the
+    queue (served even while the batcher is stopped for new work)."""
+    cache = ScoreCache()
+    batcher = DynamicBatcher(
+        buckets=(32, 64), max_wait_us=0, score_cache=cache,
+    ).start()
+    try:
+        arrays = make_arrays(11, seed=2)
+        miss = batcher.submit(servable, arrays).result(timeout=30)
+        hit = batcher.submit(servable, arrays).result(timeout=5)
+        for k in miss:
+            assert np.array_equal(miss[k], hit[k]), k
+        np.testing.assert_allclose(
+            miss["prediction_node"], reference_scores(servable, arrays),
+            rtol=1e-6,
+        )
+        assert cache.snapshot()["hits"] == 1
+    finally:
+        batcher.stop()
+
+
+def test_warmup_submits_skip_the_cache(servable):
+    cache = ScoreCache()
+    batcher = DynamicBatcher(
+        buckets=(32,), max_wait_us=0, score_cache=cache,
+    ).start()
+    try:
+        batcher.warmup_via_queue(servable, buckets=(32,))
+        snap = cache.snapshot()
+        assert snap["hits"] == 0 and snap["misses"] == 0
+        assert cache.entry_count() == 0
+    finally:
+        batcher.stop()
+
+
+def test_waiters_survive_leader_deadline_cancellation(servable):
+    """Review finding: a coalesced waiter's budget is its own — when the
+    LEADER dies of its own deadline (service-timeout cancel), the batcher
+    re-dispatches the computation for the waiters instead of handing them
+    DEADLINE_EXCEEDED on a healthy server."""
+    hold = threading.Event()
+    runs = []
+
+    def slow_run(sv, arrays):
+        runs.append(1)
+        hold.wait(timeout=10)
+        n = arrays["feat_ids"].shape[0]
+        return {"prediction_node": np.full(n, 0.25, np.float32)}
+
+    cache = ScoreCache()
+    batcher = DynamicBatcher(
+        buckets=(32,), max_wait_us=0, run_fn=slow_run, score_cache=cache,
+    ).start()
+    try:
+        arrays = make_arrays(4, seed=37)
+        leader = batcher.submit(servable, arrays)
+        waiter = batcher.submit(servable, arrays)
+        deadline = time.perf_counter() + 5
+        while not runs and time.perf_counter() < deadline:
+            time.sleep(0.005)  # leader executing (held), waiter coalesced
+        assert leader.cancel()  # the service's timeout withdrawal
+        hold.set()
+        got = waiter.result(timeout=30)["prediction_node"]
+        np.testing.assert_array_equal(got, np.full(4, 0.25, np.float32))
+    finally:
+        hold.set()
+        batcher.stop()
+
+
+def test_stale_flight_replacement_never_orphans_waiters():
+    """Review finding: a leader whose generation went stale mid-flight is
+    replaced in the flight map by a new leader — the OLD leader must still
+    resolve ITS OWN waiters (not the new flight's), and vice versa."""
+    cache = ScoreCache()
+    old_leader = cache.begin("DCN", 1, None, make_arrays(2))
+    old_waiter = cache.begin("DCN", 1, None, make_arrays(2))
+    cache.invalidate_model("DCN")
+    new_leader = cache.begin("DCN", 1, None, make_arrays(2))
+    assert new_leader.leader  # stale flight did not absorb it
+    new_waiter = cache.begin("DCN", 1, None, make_arrays(2))
+
+    f_old: Future = Future()
+    f_old.set_result({"s": np.array([1.0], np.float32)})
+    cache.complete(old_leader, f_old)
+    np.testing.assert_array_equal(
+        old_waiter.waiter.result(timeout=1)["s"], [1.0]
+    )
+    assert not new_waiter.waiter.done()  # old leader touched only its own
+    assert cache.entry_count() == 0  # stale-generation fill refused
+
+    f_new: Future = Future()
+    f_new.set_result({"s": np.array([2.0], np.float32)})
+    cache.complete(new_leader, f_new)
+    np.testing.assert_array_equal(
+        new_waiter.waiter.result(timeout=1)["s"], [2.0]
+    )
+    assert cache.entry_count() == 1  # current-generation fill landed
+
+
+def test_flush_kills_in_flight_fill_of_unseen_model():
+    """Review finding: a cold cache whose ONLY activity is an in-flight
+    leader must still bump that model's generation on flush()."""
+    cache = ScoreCache()
+    leader = cache.begin("DCN", 1, None, make_arrays(2))
+    assert cache.flush() == 0  # nothing stored yet
+    assert not cache.fill(leader.key, _val(), gen=leader.gen)
+    assert cache.entry_count() == 0
+
+
+def test_detached_cache_still_closes_leader_flights(servable):
+    """Review finding: swapping score_cache off the batcher while a
+    leader is in flight (the bench A/B teardown) must not strand that
+    flight's coalesced waiters — the completion uses the cache captured
+    at submit."""
+    hold = threading.Event()
+
+    def slow_run(sv, arrays):
+        hold.wait(timeout=5)
+        n = arrays["feat_ids"].shape[0]
+        return {"prediction_node": np.zeros(n, np.float32)}
+
+    cache = ScoreCache()
+    batcher = DynamicBatcher(
+        buckets=(32,), max_wait_us=0, run_fn=slow_run, score_cache=cache,
+    ).start()
+    try:
+        arrays = make_arrays(4, seed=31)
+        leader_fut = batcher.submit(servable, arrays)
+        waiter_fut = batcher.submit(servable, arrays)
+        batcher.score_cache = None  # detach mid-flight
+        hold.set()
+        leader_fut.result(timeout=30)
+        np.testing.assert_array_equal(
+            waiter_fut.result(timeout=5)["prediction_node"], np.zeros(4)
+        )
+    finally:
+        hold.set()
+        batcher.stop()
+
+
+def test_build_stack_cache_master_switch():
+    """Review finding: [cache] enabled=false must gate dedup too."""
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import CacheConfig, ServerConfig
+
+    cfg = ServerConfig(warmup=False, buckets=(32,), num_fields=F)
+    for enabled, want_cache, want_dedup in ((False, False, False),
+                                            (True, True, True)):
+        _r, batcher, _i, _s, _m, _w = build_stack(
+            cfg, model_config=CFG,
+            cache_config=CacheConfig(enabled=enabled, dedup=True),
+        )
+        try:
+            assert (batcher.score_cache is not None) == want_cache
+            assert batcher.dedup == want_dedup
+        finally:
+            batcher.stop()
+
+
+# ------------------------------------------------------------------ dedup
+
+
+def test_dedup_scatter_matches_uncached_scores(servable):
+    """Duplicate rows inside one request execute once; the scattered
+    result must equal the uncached (dedup-off) scores exactly."""
+    base = make_arrays(6, seed=7)
+    sel = np.array([0, 1, 2, 0, 1, 2, 3, 0, 4, 5, 3, 2,
+                    1, 4, 0, 5, 2, 3, 1, 0])  # 20 rows, 6 distinct
+    arrays = {k: np.ascontiguousarray(v[sel]) for k, v in base.items()}
+
+    plain = DynamicBatcher(buckets=(16, 32), max_wait_us=0).start()
+    try:
+        want = plain.submit(servable, arrays).result(timeout=30)["prediction_node"]
+    finally:
+        plain.stop()
+
+    deduped = DynamicBatcher(buckets=(16, 32), max_wait_us=0, dedup=True).start()
+    try:
+        got = deduped.submit(servable, arrays).result(timeout=30)["prediction_node"]
+        np.testing.assert_array_equal(got, want)
+        assert deduped.stats.dedup_batches == 1
+        assert deduped.stats.dedup_rows_collapsed == len(sel) - 6
+        # Effective-batch shrink: 20 rows held only 6 distinct, so the
+        # batch rode the 16 bucket instead of 32 — padded_candidates is
+        # charged at the SMALLER bucket.
+        assert deduped.stats.padded_candidates == 16
+    finally:
+        deduped.stop()
+
+
+def test_dedup_across_coalesced_requests(servable):
+    """Rows duplicated ACROSS requests in one combined batch collapse
+    too, and every requester still gets its own correct slice."""
+    a = make_arrays(10, seed=11)
+    b = {k: np.ascontiguousarray(v[::-1]) for k, v in a.items()}  # same rows, reversed
+    batcher = DynamicBatcher(
+        buckets=(16, 32, 64), max_wait_us=200_000, dedup=True,
+        pipelined_dispatch=False,
+    ).start()
+    try:
+        fa = batcher.submit(servable, a)
+        fb = batcher.submit(servable, b)
+        ra = fa.result(timeout=30)["prediction_node"]
+        rb = fb.result(timeout=30)["prediction_node"]
+        np.testing.assert_allclose(ra, reference_scores(servable, a), rtol=1e-6)
+        np.testing.assert_array_equal(rb, ra[::-1])
+        if batcher.stats.batches == 1:  # both landed in one combined batch
+            assert batcher.stats.dedup_rows_collapsed == 10
+    finally:
+        batcher.stop()
+
+
+def test_collapse_rows_roundtrip_and_none_when_unique():
+    parts = {
+        "x": [np.array([[1, 2], [3, 4]], np.int64),
+              np.array([[1, 2], [5, 6]], np.int64)],
+        "w": [np.array([[0.5], [1.5]], np.float32),
+              np.array([[0.5], [2.5]], np.float32)],
+    }
+    uniq, scatter, cats = collapse_rows(parts)
+    assert uniq["x"].shape[0] == 3
+    cat = np.concatenate(parts["x"])
+    np.testing.assert_array_equal(cats["x"], cat)
+    np.testing.assert_array_equal(uniq["x"][scatter], cat)
+    # All-unique input: no collapse, but the concatenated batch comes back
+    # so the caller pads from it instead of re-concatenating.
+    arr = np.arange(8).reshape(4, 2)
+    uniq2, scatter2, cats2 = collapse_rows({"x": [arr]})
+    assert uniq2 is None and scatter2 is None
+    np.testing.assert_array_equal(cats2["x"], arr)
+
+
+def test_disabled_mode_inert(servable):
+    """No score_cache, no dedup: stats stay zero and scores match the
+    reference — the cache plane must be invisible when off."""
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        assert batcher.score_cache is None and batcher.dedup is False
+        arrays = make_arrays(7, seed=13)
+        sel = np.array([0, 1, 0, 1, 2, 3, 4, 5, 6, 0])
+        dup = {k: np.ascontiguousarray(v[sel]) for k, v in arrays.items()}
+        got = batcher.submit(servable, dup).result(timeout=30)["prediction_node"]
+        np.testing.assert_allclose(
+            got, reference_scores(servable, dup), rtol=1e-6
+        )
+        assert batcher.stats.dedup_batches == 0
+        assert batcher.stats.dedup_rows_collapsed == 0
+    finally:
+        batcher.stop()
+
+
+# -------------------------------------------- version-watcher integration
+
+
+def test_watcher_hook_drops_old_generation(tmp_path, servable):
+    """A version swap through the REAL watcher drops the model's cached
+    scores via on_servable_change (the acceptance criterion's 'version
+    swap drops the old generation's entries')."""
+    from distributed_tf_serving_tpu.serving.version_watcher import (
+        VersionWatcher,
+        VersionWatcherConfig,
+    )
+    from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+    cache = ScoreCache()
+    registry = ServableRegistry()
+    save_servable(tmp_path / "1", servable, kind="dcn")
+    watcher = VersionWatcher(
+        tmp_path, registry,
+        VersionWatcherConfig(poll_interval_s=3600, model_name="DCN"),
+        on_servable_change=cache.invalidate_model,
+    )
+    watcher.poll_once()
+    assert registry.models()["DCN"] == [1]
+    sv1 = registry.resolve("DCN")
+    arrays = make_arrays(3, seed=17)
+    key = cache.make_key(sv1.name, sv1.version, None, arrays)
+    cache.fill(key, _val())
+    assert cache.lookup(key) is not None
+
+    # v2 lands; the poll loads it and the hook must purge v1's entries.
+    import dataclasses
+
+    save_servable(tmp_path / "2", dataclasses.replace(servable, version=2), kind="dcn")
+    watcher.poll_once()
+    assert 2 in registry.models()["DCN"]
+    assert cache.lookup(key) is None
+    assert cache.snapshot()["invalidations"] >= 1
+
+
+# ------------------------------------------------------ client-side cache
+
+
+def test_client_cache_never_stores_degraded_and_serves_repeats():
+    from distributed_tf_serving_tpu.client import PredictResult, ShardedPredictClient
+
+    calls = {"n": 0}
+    arrays = make_arrays(6, seed=19)
+    scores = np.linspace(0.1, 0.6, 6).astype(np.float32)
+
+    async def go():
+        client = ShardedPredictClient(
+            ["127.0.0.1:1"], "DCN", partial_results=True, score_cache=True,
+        )
+        try:
+            async def degraded(a, s):
+                calls["n"] += 1
+                return PredictResult(
+                    scores=scores[:3], missing_ranges=((3, 6),), degraded=True
+                )
+
+            client._predict_uncached = degraded
+            r1 = await client.predict(arrays)
+            r2 = await client.predict(arrays)
+            assert r1.degraded and r2.degraded
+            assert calls["n"] == 2  # degraded merges are never cached
+            assert client.score_cache.entry_count() == 0
+
+            async def healthy(a, s):
+                calls["n"] += 1
+                return PredictResult(scores=scores)
+
+            client._predict_uncached = healthy
+            r3 = await client.predict(arrays)
+            r4 = await client.predict(arrays)
+            assert calls["n"] == 3  # second call served from cache
+            np.testing.assert_array_equal(r3.scores, r4.scores)
+            assert not r4.degraded
+            # Callers own their arrays: the hit is a copy, not the entry.
+            r4.scores[0] = 99.0
+            r5 = await client.predict(arrays)
+            assert r5.scores[0] != 99.0
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_client_cache_keys_on_sort_flag():
+    from distributed_tf_serving_tpu.client import ShardedPredictClient
+
+    async def go():
+        client = ShardedPredictClient(
+            ["127.0.0.1:1"], "DCN", score_cache=True,
+        )
+        try:
+            unsorted = np.array([0.5, 0.1, 0.9], np.float32)
+
+            async def fake(a, sort_scores):
+                return np.sort(unsorted) if sort_scores else unsorted.copy()
+
+            client._predict_uncached = fake
+            arrays = make_arrays(3, seed=23)
+            plain = await client.predict(arrays)
+            ranked = await client.predict(arrays, sort_scores=True)
+            np.testing.assert_array_equal(plain, unsorted)
+            np.testing.assert_array_equal(ranked, np.sort(unsorted))
+            # Both entries live: repeats of each flavor hit their own.
+            assert client.score_cache.entry_count() == 2
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------- surfaces + workload
+
+
+def test_cachez_routes_and_monitoring_block(servable):
+    aiohttp = pytest.importorskip("aiohttp")
+    from distributed_tf_serving_tpu.serving.rest import start_rest_gateway
+
+    registry = ServableRegistry()
+    registry.load(servable)
+    cache = ScoreCache()
+    batcher = DynamicBatcher(
+        buckets=(32,), max_wait_us=0, score_cache=cache, dedup=True,
+    ).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    try:
+        arrays = make_arrays(4, seed=29)
+        batcher.submit(servable, arrays).result(timeout=30)
+        batcher.submit(servable, arrays).result(timeout=5)
+
+        async def go():
+            runner, port = await start_rest_gateway(impl, port=0)
+            try:
+                async with aiohttp.ClientSession(
+                    f"http://127.0.0.1:{port}"
+                ) as session:
+                    async with session.get("/cachez") as r:
+                        cz = await r.json()
+                    async with session.get("/monitoring") as r:
+                        mon = await r.json()
+                    async with session.get(
+                        "/monitoring/prometheus/metrics"
+                    ) as r:
+                        prom = await r.text()
+                    async with session.post("/cachez/flush") as r:
+                        fl = await r.json()
+                    async with session.get("/cachez") as r:
+                        cz2 = await r.json()
+                    return cz, mon, prom, fl, cz2
+            finally:
+                await runner.cleanup()
+
+        cz, mon, prom, fl, cz2 = asyncio.run(go())
+        assert cz["enabled"] and cz["hits"] == 1 and cz["misses"] == 1
+        assert cz["models"]["DCN"]["hits"] == 1
+        assert mon["cache"]["hits"] == 1
+        assert mon["batcher"]["dedup_batches"] == 0
+        assert "dts_tpu_cache_hits_total 1" in prom
+        assert 'dts_tpu_cache_model_events_total{model_name="DCN",event="hits"} 1' in prom
+        assert fl["flushed"] and fl["entries_dropped"] == 1
+        assert cz2["entries"] == 0
+    finally:
+        batcher.stop()
+
+
+def test_cachez_disabled_answers(servable):
+    aiohttp = pytest.importorskip("aiohttp")
+    from distributed_tf_serving_tpu.serving.rest import start_rest_gateway
+
+    registry = ServableRegistry()
+    registry.load(servable)
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    try:
+        async def go():
+            runner, port = await start_rest_gateway(impl, port=0)
+            try:
+                async with aiohttp.ClientSession(
+                    f"http://127.0.0.1:{port}"
+                ) as session:
+                    async with session.get("/cachez") as r:
+                        cz = await r.json()
+                    async with session.post("/cachez/flush") as r:
+                        return cz, r.status
+            finally:
+                await runner.cleanup()
+
+        cz, flush_status = asyncio.run(go())
+        assert cz == {"enabled": False}
+        assert flush_status == 500  # FAILED_PRECONDITION: no cache armed
+    finally:
+        batcher.stop()
+
+
+def test_cache_config_section(tmp_path):
+    from distributed_tf_serving_tpu.utils.config import CacheConfig, load_config
+
+    path = tmp_path / "c.toml"
+    path.write_text(
+        "[cache]\nenabled = true\nmax_entries = 64\nttl_s = 5.0\n"
+        "coalesce = false\ndedup = true\n"
+    )
+    cfg = load_config(path)["cache"]
+    assert cfg == CacheConfig(
+        enabled=True, max_entries=64, ttl_s=5.0, coalesce=False, dedup=True
+    )
+    built = cfg.build()
+    assert isinstance(built, ScoreCache)
+    assert built.max_entries == 64 and built.coalesce is False
+    assert CacheConfig().build() is None  # disabled -> no cache object
+
+
+def test_zipfian_workload_deterministic_and_skewed():
+    a = zipfian_indices(2000, 32, skew=1.2, seed=4)
+    b = zipfian_indices(2000, 32, skew=1.2, seed=4)
+    np.testing.assert_array_equal(a, b)  # identical replay, the A/B contract
+    assert not np.array_equal(a, zipfian_indices(2000, 32, skew=1.2, seed=5))
+    counts = np.bincount(a, minlength=32)
+    assert counts[0] > counts[-1]  # head hotter than tail
+    assert counts[0] > 2000 // 32  # genuinely skewed, not uniform
+
+    p1 = make_zipfian_payloads(4, 64, F, skew=1.3, seed=7, catalog=32)
+    p2 = make_zipfian_payloads(4, 64, F, skew=1.3, seed=7, catalog=32)
+    for x, y in zip(p1, p2):
+        np.testing.assert_array_equal(x["feat_ids"], y["feat_ids"])
+        np.testing.assert_array_equal(x["feat_wts"], y["feat_wts"])
+    # Hot rows recur WITHIN a payload: fewer distinct rows than candidates
+    # (the intra-batch dedup surface).
+    uniq = np.unique(p1[0]["feat_ids"], axis=0).shape[0]
+    assert uniq < 64

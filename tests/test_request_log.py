@@ -159,3 +159,71 @@ def test_close_is_idempotent(tmp_path):
     logger = RequestLogger(tmp_path / "c.log", sampling_rate=1.0)
     logger.close()
     logger.close()
+
+
+def test_stats_exposes_written_and_dropped(impl, tmp_path):
+    """ISSUE 4 satellite: the writer's accounting is a queryable block
+    (rest.py's /monitoring includes it when a logger is attached)."""
+    service, _sv = impl
+    p = tmp_path / "stats.log"
+    logger = RequestLogger(p, sampling_rate=1.0)
+    service.request_logger = logger
+    for i in range(4):
+        service.predict(build_predict_request(_arrays(seed=i), "DCN"))
+    logger.close()
+    stats = logger.stats()
+    assert stats["written"] == 4
+    assert stats["dropped"] == 0
+    assert stats["queued"] == 0
+    assert stats["sampling_rate"] == 1.0
+    assert str(p) in stats["path"]
+
+
+def test_monitoring_carries_request_log_block(impl, tmp_path):
+    aiohttp = pytest.importorskip("aiohttp")
+    import asyncio
+
+    from distributed_tf_serving_tpu.serving.rest import start_rest_gateway
+
+    service, _sv = impl
+    logger = RequestLogger(tmp_path / "mon.log", sampling_rate=1.0)
+    service.request_logger = logger
+    try:
+        service.predict(build_predict_request(_arrays(), "DCN"))
+
+        async def go():
+            runner, port = await start_rest_gateway(service, port=0)
+            try:
+                async with aiohttp.ClientSession(
+                    f"http://127.0.0.1:{port}"
+                ) as session:
+                    async with session.get("/monitoring") as r:
+                        return await r.json()
+            finally:
+                await runner.cleanup()
+
+        snap = asyncio.run(go())
+        assert "request_log" in snap
+        assert snap["request_log"]["dropped"] == 0
+        assert snap["request_log"]["written"] >= 0  # writer may still drain
+    finally:
+        logger.close()
+
+
+def test_close_flushes_pending_queue(tmp_path):
+    """ISSUE 4 satellite: records still queued at close() are WRITTEN,
+    not discarded — even when the writer thread is already gone (the
+    close-side residual drain)."""
+    logger = RequestLogger(tmp_path / "flush.log", sampling_rate=1.0)
+    # Stop the writer thread first so enqueued records cannot be drained
+    # by it — close() must flush them itself.
+    logger._queue.put(None)
+    logger._thread.join(timeout=10)
+    req = build_predict_request(_arrays(), "DCN")
+    for _ in range(3):
+        logger.maybe_log("predict", req)
+    assert logger._queue.qsize() == 3
+    logger.close()
+    assert logger.written == 3
+    records = list(read_tfrecords(tmp_path / "flush.log"))
+    assert len(records) == 3

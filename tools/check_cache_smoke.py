@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Gate for the tier-1 cache smoke (tools/ci_tier1.sh TIER1_CACHE_SMOKE=1).
+
+Reads the SOAK_CACHE=1 soak's JSON line and asserts the cache plane's
+acceptance conditions: a NONZERO hit rate on the skewed workload, and the
+pre-flight bit-identity probe (uncached-miss scores vs cached-hit scores)
+reporting a match. Exits nonzero with a reason otherwise, so CI fails with
+evidence instead of a silent green.
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tier1_cache_soak.json"
+    lines = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    continue
+    if not lines:
+        print(f"cache smoke: no JSON line in {path}", file=sys.stderr)
+        return 1
+    line = lines[-1]
+    cache = line.get("cache") or {}
+    problems = []
+    # WORKLOAD hits (probe counts subtracted): the pre-flight probe
+    # guarantees one hit by construction, so gating on the raw counter
+    # would pass even if worker traffic never hit once.
+    if cache.get("workload_hits", 0) <= 0:
+        problems.append(f"zero workload cache hits (cache block: {cache})")
+    if cache.get("hit_rate", 0.0) <= 0.0:
+        problems.append("hit_rate is zero")
+    if cache.get("scores_match") is not True:
+        problems.append(
+            f"scores_match != True (got {cache.get('scores_match')!r}): "
+            "cached scores are not bit-identical to uncached"
+        )
+    if line.get("grpc_err", 0) and not line.get("grpc_ok", 0):
+        problems.append("every gRPC request errored during the cache soak")
+    if problems:
+        for p in problems:
+            print(f"cache smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print(
+        "cache smoke ok: hit_rate={} workload_hits={} coalesced={} "
+        "dedup_rows={} scores_match={}".format(
+            cache.get("hit_rate"), cache.get("workload_hits"),
+            cache.get("coalesced"), cache.get("dedup_rows_collapsed"),
+            cache.get("scores_match"),
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

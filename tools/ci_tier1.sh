@@ -34,4 +34,18 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_TRACE_SMOKE:-0}" = "1" ]; then
         python tools/soak.py || rc=1
     python tools/check_trace.py "$ARTIFACT" --min-events 10 || rc=1
 fi
+
+# Cache smoke (TIER1_CACHE_SMOKE=1): a short SOAK_CACHE=1 skewed soak must
+# report a NONZERO hit rate and bit-identical scores with the cache on vs
+# off (the soak's pre-flight miss/hit probe) — the cache plane's tier-1
+# acceptance gate.
+if [ "$rc" -eq 0 ] && [ "${TIER1_CACHE_SMOKE:-0}" = "1" ]; then
+    CACHE_LINE="${TIER1_CACHE_LINE:-/tmp/tier1_cache_soak.json}"
+    echo "tier1: cache smoke (SOAK_CACHE=1, line $CACHE_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_SMOKE_SECONDS:-8}" SOAK_CACHE=1 \
+        SOAK_GRPC_WORKERS=4 SOAK_REST_WORKERS=1 SOAK_CANDIDATES=64 \
+        python tools/soak.py | tee "$CACHE_LINE" || rc=1
+    python tools/check_cache_smoke.py "$CACHE_LINE" || rc=1
+fi
 exit $rc

@@ -26,6 +26,11 @@ RSS start/end (leak watch), batcher + input-cache counters, wall/QPS, and
 parsed-back counts.
 Env knobs: SOAK_SECONDS (default 300), SOAK_GRPC_WORKERS (8),
 SOAK_REST_WORKERS (4), SOAK_CANDIDATES (1000),
+SOAK_CACHE=1 (cache plane armed: score cache + single-flight + dedup on
+the batcher, gRPC workers on a seeded zipfian workload —
+SOAK_CACHE_SKEW/SOAK_CACHE_SEED — plus a pre-flight bit-identity probe;
+the JSON line gains a `cache` block with hit/miss/coalesced/dedup
+counters and `scores_match`),
 SOAK_REQUEST_LOG_SAMPLING (default 0 = logging off; >0 stresses the
 bounded-queue request logger under the mixed load — note it adds a
 SerializeToString per sampled request, so A/Bs against logging-off soaks
@@ -84,6 +89,8 @@ def main() -> None:
         ShardedPredictClient,
         compact_payload,
         make_payload,
+        make_zipfian_payloads,
+        zipfian_indices,
     )
     from distributed_tf_serving_tpu.models import (
         ModelConfig,
@@ -103,6 +110,14 @@ def main() -> None:
     rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "4"))
     candidates = int(os.environ.get("SOAK_CANDIDATES", "1000"))
     chaos = os.environ.get("SOAK_CHAOS", "0") == "1"
+    # Cache mode (SOAK_CACHE=1): the batcher runs with the score cache +
+    # single-flight + intra-batch dedup armed, and the gRPC workers switch
+    # to a seeded zipfian workload (hot payloads AND hot rows recur) so
+    # the hit/coalesced/dedup counters actually move. A pre-flight probe
+    # pins correctness: the same payload scored uncached (the filling
+    # miss) and cached (the hit) must be bit-identical.
+    cache_mode = os.environ.get("SOAK_CACHE", "0") == "1"
+    cache_skew = float(os.environ.get("SOAK_CACHE_SKEW", "1.1"))
     trace_out = os.environ.get("SOAK_TRACE_OUT", "")
     if trace_out:
         from distributed_tf_serving_tpu.utils import tracing
@@ -143,9 +158,18 @@ def main() -> None:
         signatures=ctr_signatures(NUM_FIELDS),
     )
     registry.load(servable)
+    score_cache = None
+    if cache_mode:
+        from distributed_tf_serving_tpu.cache import ScoreCache
+
+        # TTL comfortably past the soak horizon: this mode measures the
+        # cache plane's behavior under load, not TTL churn (TTL/eviction
+        # correctness is tests/test_cache.py's job).
+        score_cache = ScoreCache(ttl_s=max(seconds * 2, 600.0))
     buckets = (1024, 2048, 4096, 8192, 16384) if tpu else (1024, 2048)
     batcher = DynamicBatcher(
         buckets=buckets, max_wait_us=2000, completion_workers=12,
+        score_cache=score_cache, dedup=cache_mode,
     ).start()
     batcher.max_batch_candidates = buckets[-1]
     for b in buckets:
@@ -163,6 +187,50 @@ def main() -> None:
         make_payload(candidates=candidates, num_fields=NUM_FIELDS, seed=500 + i)
         for i in range(32)
     ]
+    cache_block: dict = {}
+    zipf_pool, zipf_sched = None, None
+    if cache_mode:
+        # Zipfian workload: hot payloads repeat (score-cache hits +
+        # coalescing) and hot rows recur across distinct payloads
+        # (intra-batch dedup). Seeded, so reruns replay the same stream.
+        zipf_pool = make_zipfian_payloads(
+            32, candidates, NUM_FIELDS, skew=cache_skew,
+            seed=int(os.environ.get("SOAK_CACHE_SEED", "0")),
+            catalog=max(candidates * 4, 256),
+        )
+        zipf_sched = zipfian_indices(
+            4096, len(zipf_pool), skew=cache_skew,
+            seed=int(os.environ.get("SOAK_CACHE_SEED", "0")) + 1,
+        )
+        # Pre-flight bit-identity probe through the real batcher. The
+        # reference is computed with the WHOLE cache plane disarmed
+        # (score cache detached, dedup off) — comparing a cached copy
+        # against its own filling miss would be tautological and blind to
+        # a dedup/scatter bug changing answers. Then the same payload runs
+        # armed: the miss (dedup path, fills) and the hit (cached copy)
+        # must both be bit-identical to the disarmed reference.
+        probe = zipf_pool[0]
+        batcher.score_cache, batcher.dedup = None, False
+        ref = batcher.submit(
+            servable, probe, output_keys=("prediction_node",)
+        ).result(timeout=600)["prediction_node"]
+        batcher.score_cache, batcher.dedup = score_cache, True
+        miss = batcher.submit(
+            servable, probe, output_keys=("prediction_node",)
+        ).result(timeout=600)["prediction_node"]
+        hit = batcher.submit(
+            servable, probe, output_keys=("prediction_node",)
+        ).result(timeout=600)["prediction_node"]
+        cache_block["scores_match"] = bool(
+            np.array_equal(ref, miss) and np.array_equal(ref, hit)
+        )
+        # Counter baseline AFTER the probe: the reported hit/miss/coalesced
+        # workload numbers (and the CI gate) must come from worker traffic,
+        # not from the probe's guaranteed hit.
+        cache_block["probe_snapshot"] = {
+            k: score_cache.snapshot()[k]
+            for k in ("hits", "misses", "coalesced")
+        }
     rest_cols = {
         "feat_ids": wide["feat_ids"][:64].tolist(),
         "feat_wts": wide["feat_wts"][:64].tolist(),
@@ -206,11 +274,22 @@ def main() -> None:
         i = 0
         while time.perf_counter() < deadline:
             i += 1
-            # Interleave regimes every 7 requests, like the r4 soak: the
-            # cache's regime detector must ride the transitions without
-            # false bypass or stale hits.
-            phase = (i // 7 + wid) % 3
-            payload = (wide, compact, unique_pool[(i + wid) % len(unique_pool)])[phase]
+            if cache_mode:
+                # Seeded zipfian stream: worker w walks the schedule from
+                # its own offset, so concurrent workers frequently hold
+                # the SAME hot payload in flight (single-flight coverage)
+                # while the tail keeps misses coming.
+                payload = zipf_pool[
+                    zipf_sched[(wid * 997 + i) % len(zipf_sched)]
+                ]
+            else:
+                # Interleave regimes every 7 requests, like the r4 soak:
+                # the cache's regime detector must ride the transitions
+                # without false bypass or stale hits.
+                phase = (i // 7 + wid) % 3
+                payload = (
+                    wide, compact, unique_pool[(i + wid) % len(unique_pool)]
+                )[phase]
             try:
                 await client.predict(payload, sort_scores=True)
                 counts["grpc_ok"] += 1
@@ -383,7 +462,31 @@ def main() -> None:
             "fused_batches": batcher.stats.fused_batches,
             "requests_per_batch": round(batcher.stats.mean_requests_per_batch, 2),
             "deadline_sheds": batcher.stats.deadline_sheds,
+            "dedup_batches": batcher.stats.dedup_batches,
+            "dedup_rows_collapsed": batcher.stats.dedup_rows_collapsed,
         },
+        "cache": (
+            {
+                **{k: v for k, v in score_cache.snapshot().items()
+                   if k != "models"},
+                "skew": cache_skew,
+                "dedup_batches": batcher.stats.dedup_batches,
+                "dedup_rows_collapsed": batcher.stats.dedup_rows_collapsed,
+                **cache_block,
+                # Workload-only deltas (probe counts subtracted): what the
+                # zipfian WORKER traffic did — the CI gate reads these, so
+                # the probe's guaranteed hit can never green-wash a cache
+                # that stopped hitting under load.
+                **{
+                    f"workload_{k}": (
+                        score_cache.snapshot()[k]
+                        - cache_block.get("probe_snapshot", {}).get(k, 0)
+                    )
+                    for k in ("hits", "misses", "coalesced")
+                },
+            }
+            if cache_mode else None
+        ),
         "resilience": resilience or None,
         "trace": trace_block or None,
         "chaos": None,
