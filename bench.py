@@ -879,6 +879,71 @@ async def overload_probe(client_cls, port: str, batcher, scale: Scale, payload) 
     return counts
 
 
+async def overload_ab_pass(
+    client_cls, port: str, pool, sched, deadline_s: float, workers: int,
+    duration_s: float, channels_per_host: int,
+) -> dict:
+    """One pass of the --overload A/B: `workers` continuous closed-loop
+    workers replaying the same seeded zipfian schedule for `duration_s`,
+    each RPC under a hard `deadline_s` deadline — so `ok` IS the
+    in-deadline success count and goodput_qps = ok / duration. One
+    failover retry with the scoreboard on: refused requests exercise the
+    retry-after pushback path, and the pass records whether refusals
+    landed as pushback (busy) or burned the ejection budget."""
+    import asyncio
+
+    from distributed_tf_serving_tpu.client import PredictClientError
+
+    counts = {"sent": 0, "ok": 0, "shed": 0, "deadline": 0,
+              "unavailable": 0, "other": 0}
+    t_end = time.perf_counter() + duration_s
+    async with client_cls(
+        [f"127.0.0.1:{port}"], "DCN", channels_per_host=channels_per_host,
+        timeout_s=deadline_s, scoreboard=True, failover_attempts=1,
+    ) as client:
+
+        async def worker(w: int):
+            # Staggered ramp: real load is a ramp, and an instantaneous
+            # stampede would measure only the cold first moments.
+            await asyncio.sleep(min(w, 40) * 0.05)
+            i = 0
+            while time.perf_counter() < t_end:
+                i += 1
+                counts["sent"] += 1
+                try:
+                    await client.predict(
+                        pool[sched[(w * 997 + i) % len(sched)]]
+                    )
+                    counts["ok"] += 1
+                except PredictClientError as e:
+                    code = getattr(e.code, "name", str(e.code))
+                    if code == "RESOURCE_EXHAUSTED":
+                        counts["shed"] += 1
+                    elif code == "DEADLINE_EXCEEDED":
+                        counts["deadline"] += 1
+                    elif code == "UNAVAILABLE":
+                        counts["unavailable"] += 1
+                    else:
+                        counts["other"] += 1
+
+        await asyncio.gather(*(worker(w) for w in range(workers)))
+        counts["pushbacks"] = client.counters.pushbacks_received
+        counts["retry_after_honored"] = client.counters.retry_after_honored
+        sb = client.scoreboard.snapshot() if client.scoreboard else {}
+        counts["ejections"] = sb.get("ejections", 0)
+    counts["duration_s"] = duration_s
+    counts["goodput_qps"] = round(counts["ok"] / duration_s, 1)
+    return counts
+
+
+def _overload_flag() -> bool:
+    """--overload: run the admission A/B phase (static limit vs adaptive
+    controller on the identical overloaded workload). Skipped by default —
+    the phase deliberately drives the stack past capacity, which has no
+    business inside the headline windows."""
+    return "--overload" in sys.argv[1:]
+
+
 def _skew_flag() -> float | None:
     """--skew[=EXPONENT]: run the cache-plane A/B phase on a seeded
     zipfian workload (client/bench.py make_zipfian_payloads +
@@ -1417,6 +1482,106 @@ def child_main() -> None:
             finally:
                 await server.stop(0)
 
+        async def serve_overload_ab():
+            nonlocal stage
+            stage = "overload_ab"
+            # Admission A/B (ISSUE 5 acceptance): the IDENTICAL seeded
+            # zipfian ~3x-capacity workload against the live stack, first
+            # under the static queue_capacity_candidates bound, then under
+            # the adaptive AdmissionController. Capacity is pinned with a
+            # deterministic injected batcher.dispatch delay so both passes
+            # overload the same server, not two weather samples; both
+            # passes run the SAME short-TTL score cache (the deployment
+            # the brownout machinery assumes), flushed between passes.
+            # Goodput = in-deadline successes/s: the static bound drops
+            # expired hot keys and queues their recomputes past the
+            # deadline (dead work, blind retries), the controller sheds
+            # early with retry-after pushback and serves hot keys STALE
+            # through the brownout window while the device catches up.
+            from distributed_tf_serving_tpu import faults
+            from distributed_tf_serving_tpu.cache import ScoreCache
+            from distributed_tf_serving_tpu.client import (
+                make_zipfian_payloads,
+                zipfian_indices,
+            )
+            from distributed_tf_serving_tpu.serving import overload as overload_mod
+            from distributed_tf_serving_tpu.utils.config import OverloadConfig
+
+            server, port = create_server_async(impl, "127.0.0.1:0")
+            await server.start()
+            try:
+                batcher.max_batch_candidates = min(8192, batcher.buckets[-1])
+                deadline_s = 2.0
+                delay_s = 0.03
+                workers = scale.overload_tasks
+                duration_s = 10.0
+                pool_n = 128
+                pool = make_zipfian_payloads(
+                    pool_n, CANDIDATES, NUM_FIELDS, skew=1.1, seed=901,
+                    catalog=max(CANDIDATES * 4, 256),
+                )
+                sched = zipfian_indices(4096, pool_n, skew=1.1, seed=902)
+                cache = ScoreCache(ttl_s=1.5)
+                faults.get().add(
+                    "batcher.dispatch", "delay", rate=1.0, delay_s=delay_s
+                )
+                batcher.score_cache = cache
+                try:
+                    log(stage, f"{workers} workers x {duration_s}s, deadline "
+                               f"{deadline_s}s, dispatch delay {delay_s}s, "
+                               f"zipf pool {pool_n}: STATIC pass")
+                    static = await overload_ab_pass(
+                        ShardedPredictClient, port, pool, sched, deadline_s,
+                        workers, duration_s, scale.channels_per_host,
+                    )
+                    ctrl = OverloadConfig(
+                        enabled=True, target_queue_wait_ms=50.0,
+                        adjust_interval_s=0.25, brownout_after_intervals=3,
+                        shed_after_intervals=10, recover_after_intervals=8,
+                        stale_while_overloaded_s=60.0,
+                        max_limit_candidates=6144, min_limit_candidates=1024,
+                    ).build()
+                    ctrl.bind(batcher.buckets[-1],
+                              batcher.queue_capacity_candidates)
+                    cache.flush()  # identical cold start for both passes
+                    batcher.overload = ctrl
+                    try:
+                        log(stage, "ADAPTIVE pass (identical workload)")
+                        adaptive = await overload_ab_pass(
+                            ShardedPredictClient, port, pool, sched,
+                            deadline_s, workers, duration_s,
+                            scale.channels_per_host,
+                        )
+                    finally:
+                        batcher.overload = None
+                finally:
+                    batcher.score_cache = None
+                    faults.reset()
+                    # Drop the module-level fast-path gate the controller's
+                    # construction armed: later phases (host_ceiling) must
+                    # not pay overload metadata scans for a detached plane.
+                    overload_mod.deactivate()
+                res["overload_ab"] = {
+                    "deadline_s": deadline_s,
+                    "dispatch_delay_s": delay_s,
+                    "workers": workers,
+                    "duration_s_each_pass": duration_s,
+                    "zipf_pool": pool_n,
+                    "cache_ttl_s": 1.5,
+                    "static": static,
+                    "adaptive": adaptive,
+                    "controller": ctrl.snapshot(),
+                    "stale_serves": cache.snapshot()["stale_serves"],
+                    "goodput_gain": round(
+                        adaptive["goodput_qps"]
+                        / max(static["goodput_qps"], 1e-9),
+                        3,
+                    ),
+                }
+                log(stage, json.dumps(res["overload_ab"]))
+            finally:
+                await server.stop(0)
+
         asyncio.run(serve_windows())
         report = res["report"]
         s = report.summary()
@@ -1471,6 +1636,8 @@ def child_main() -> None:
         skew = _skew_flag()
         if skew is not None:
             asyncio.run(serve_cache_ab(skew))
+        if _overload_flag():
+            asyncio.run(serve_overload_ab())
         batcher.stop()
 
         asyncio.run(measure_host_ceiling())
@@ -1571,6 +1738,11 @@ def child_main() -> None:
             # cache-off/cache-on, hit/coalesced/dedup counters + score
             # bit-identity. None when --skew was not passed.
             "cache": res.get("cache"),
+            # Admission A/B (--overload): identical overloaded workload,
+            # static bound vs adaptive controller — goodput (in-deadline
+            # successes/s), shed/deadline taxonomy, pushback vs ejection.
+            # None when --overload was not passed.
+            "overload_ab": res.get("overload_ab"),
             "phases_us": phases,
             "phases_us_unique": phases_unique,
         })

@@ -88,12 +88,15 @@ class CacheHandle:
     leader). Leaders pass this back to complete()/abort(); a leader handle
     also pins ITS _Flight object, so closing the flight can never pop (and
     resolve) a DIFFERENT flight that replaced it in the map after a
-    generation bump."""
+    generation bump. `stale` marks a hit served PAST its TTL under the
+    brownout stale-window (serving/overload.py): the caller must flag the
+    response degraded and must never re-fill from it."""
 
-    __slots__ = ("key", "model", "gen", "hit", "waiter", "leader", "flight")
+    __slots__ = ("key", "model", "gen", "hit", "waiter", "leader", "flight",
+                 "stale")
 
     def __init__(self, key, model, gen, hit=None, waiter=None, leader=False,
-                 flight=None):
+                 flight=None, stale=False):
         self.key = key
         self.model = model
         self.gen = gen
@@ -101,6 +104,7 @@ class CacheHandle:
         self.waiter = waiter
         self.leader = leader
         self.flight = flight
+        self.stale = stale
 
 
 class ScoreCache:
@@ -156,12 +160,13 @@ class ScoreCache:
         with self._gen_lock:
             return self._gens.get(model, 0)
 
+    _COUNTER_KEYS = ("hits", "misses", "coalesced", "evictions",
+                     "expirations", "invalidations", "fills", "stale_serves")
+
     def _count(self, model: str, field: str, n: int = 1) -> None:
         with self._stats_lock:
             m = self._per_model.setdefault(
-                model,
-                {"hits": 0, "misses": 0, "coalesced": 0, "evictions": 0,
-                 "expirations": 0, "invalidations": 0, "fills": 0},
+                model, {k: 0 for k in self._COUNTER_KEYS}
             )
             m[field] += n
 
@@ -186,10 +191,20 @@ class ScoreCache:
         """Store read without hit/miss accounting (begin() attributes the
         outcome itself, so a coalesced join counts as coalesced — not as
         a miss on top)."""
+        return self._get_within(key, 0.0)[0]
+
+    def _get_within(self, key: tuple, stale_s: float):
+        """(value, stale) store read: a FRESH entry reads as (value,
+        False); an entry past its TTL but within `stale_s` of it reads as
+        (value, True) WITHOUT being dropped or LRU-promoted — the brownout
+        stale-serve path (serving/overload.py) borrows it, it does not
+        revalidate it. Past the stale window (or on a stale generation)
+        the entry is dropped on sight exactly as before."""
         model = key[0]
         gen = self._gen_of(model)
         idx = self._shard_of(key)
         now = self._clock()
+        stale = False
         with self._locks[idx]:
             shard = self._shards[idx]
             entry = shard.get(key)
@@ -198,29 +213,36 @@ class ScoreCache:
                     del shard[key]
                     self._bytes[idx] -= entry.nbytes
                     entry = None
-                elif now >= entry.expires_t:
+                elif now >= entry.expires_t + stale_s:
                     del shard[key]
                     self._bytes[idx] -= entry.nbytes
                     self._count(model, "expirations")
                     entry = None
+                elif now >= entry.expires_t:
+                    stale = True  # expired but inside the stale window
                 else:
                     shard.move_to_end(key)
-        return entry.value if entry is not None else None
+        return (entry.value if entry is not None else None), stale
 
-    def begin(self, model: str, version, output_keys, arrays: dict) -> CacheHandle:
+    def begin(
+        self, model: str, version, output_keys, arrays: dict,
+        stale_s: float = 0.0,
+    ) -> CacheHandle:
         """One-stop submit-path entry: digest + lookup + single-flight join.
         Returns a handle where exactly one of these holds:
-        - handle.hit is the cached outputs (serve it, done);
+        - handle.hit is the cached outputs (serve it, done) — with
+          handle.stale True when `stale_s` > 0 allowed an expired entry
+          (brownout: mark the response degraded, never re-fill);
         - handle.waiter is a Future another in-flight identical request
           will resolve (hand it to the caller, done);
         - handle.leader is True: compute, then complete(handle, future).
         """
         key = self.make_key(model, version, output_keys, arrays)
         gen = self._gen_of(model)
-        hit = self._get(key)
+        hit, stale = self._get_within(key, stale_s)
         if hit is not None:
-            self._count(model, "hits")
-            return CacheHandle(key, model, gen, hit=hit)
+            self._count(model, "stale_serves" if stale else "hits")
+            return CacheHandle(key, model, gen, hit=hit, stale=stale)
         flight = None
         if self.coalesce:
             with self._flight_lock:
@@ -409,13 +431,9 @@ class ScoreCache:
         with self._stats_lock:
             per_model = {m: dict(c) for m, c in sorted(self._per_model.items())}
         totals = {
-            k: sum(c[k] for c in per_model.values())
-            for k in ("hits", "misses", "coalesced", "evictions",
-                      "expirations", "invalidations", "fills")
-        } if per_model else {
-            k: 0 for k in ("hits", "misses", "coalesced", "evictions",
-                           "expirations", "invalidations", "fills")
-        }
+            k: sum(c.get(k, 0) for c in per_model.values())
+            for k in self._COUNTER_KEYS
+        } if per_model else {k: 0 for k in self._COUNTER_KEYS}
         looked = totals["hits"] + totals["misses"]
         return {
             "enabled": True,

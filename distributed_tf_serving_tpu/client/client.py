@@ -72,6 +72,35 @@ class ResilienceCounters:
     failovers: int = 0
     backoff_sleeps: int = 0
     partial_responses: int = 0
+    # Overload plane (serving/overload.py): RESOURCE_EXHAUSTED sheds seen
+    # (the backend is busy, not dead), and backoffs that honored a
+    # server-sent retry-after-ms pushback hint.
+    pushbacks_received: int = 0
+    retry_after_honored: int = 0
+
+
+# Overload-plane wire metadata (serving/overload.py repeats these; the
+# client package must stay importable without the serving package's jax
+# dependency, so the literals live on both sides).
+_CRITICALITY_KEY = "x-dts-criticality"
+_RETRY_AFTER_KEY = "retry-after-ms"
+
+
+def _retry_after_ms_of(err) -> int | None:
+    """The server's retry-after-ms pushback hint from an RPC error's
+    trailing metadata (None when absent/unparseable — hints are advisory,
+    a malformed one must never fail the failover path)."""
+    get = getattr(err, "trailing_metadata", None)
+    if get is None:
+        return None
+    try:
+        md = get() if callable(get) else get
+        for key, value in md or ():
+            if key == _RETRY_AFTER_KEY:
+                return max(int(value), 0)
+    except Exception:  # noqa: BLE001 — advisory only
+        return None
+    return None
 
 
 @dataclasses.dataclass
@@ -93,11 +122,15 @@ class _ShardAttemptError(Exception):
     """Internal: one failed shard attempt, tagged with the backend that
     failed it (the failover loop and hedge arbiter route on this)."""
 
-    def __init__(self, host_idx: int, code, details: str):
+    def __init__(self, host_idx: int, code, details: str,
+                 retry_after_ms: int | None = None):
         super().__init__(details)
         self.host_idx = host_idx
         self.code = code  # grpc.StatusCode-like (has .name)
         self.details = details
+        # Server pushback hint (overload plane): the failover backoff
+        # waits at least this long before the next attempt.
+        self.retry_after_ms = retry_after_ms
 
     @property
     def code_name(self) -> str:
@@ -215,6 +248,7 @@ class ShardedPredictClient:
         keepalive_time_ms: int = 10_000,
         keepalive_timeout_ms: int = 5_000,
         score_cache=None,
+        criticality: str = "",
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -284,6 +318,12 @@ class ShardedPredictClient:
 
             score_cache = ScoreCache()
         self.score_cache = score_cache or None
+        # Criticality lane (overload plane): sent as x-dts-criticality
+        # metadata on every RPC. "critical" / "default" / "sheddable" —
+        # overloaded servers running [overload] shed sheddable traffic
+        # first. "" (default) sends nothing; the server treats absent as
+        # "default".
+        self.criticality = str(criticality or "").strip().lower()
         self.counters = ResilienceCounters()
         self._health_stubs: list[object | None] = [None] * len(self.hosts)
         # Long-lived plaintext channels per host, created once and shared
@@ -344,11 +384,15 @@ class ShardedPredictClient:
         if hedge:
             attrs["hedge"] = True
         with tracing.start_span("client.rpc", attrs=attrs) as span:
-            metadata = (
-                (("traceparent",
-                  tracing.make_traceparent(span.trace_id, span.span_id)),)
-                if span is not None else None
-            )
+            md = []
+            if span is not None:
+                md.append(
+                    ("traceparent",
+                     tracing.make_traceparent(span.trace_id, span.span_id))
+                )
+            if self.criticality:
+                md.append((_CRITICALITY_KEY, self.criticality))
+            metadata = tuple(md) or None
             t0 = time.perf_counter()
             try:
                 if faults.active():
@@ -385,8 +429,29 @@ class ShardedPredictClient:
                 code_name = getattr(code, "name", str(code))
                 if span is not None:
                     span.attrs["code"] = code_name
+                retry_after_ms = None
+                if code_name == "RESOURCE_EXHAUSTED":
+                    # Overload pushback: the backend ANSWERED (alive, just
+                    # shedding). Pick up its retry-after-ms hint and record
+                    # "busy" — never "dead" — on the scoreboard, so a
+                    # shedding backend is steered around without consuming
+                    # its ejection budget (the cascade fix: ejecting it
+                    # would pile its traffic onto the remaining hosts and
+                    # overload them next).
+                    retry_after_ms = _retry_after_ms_of(e)
+                    self.counters.pushbacks_received += 1
+                    if span is not None and retry_after_ms:
+                        span.attrs["retry_after_ms"] = retry_after_ms
                 if self.scoreboard is not None:
-                    if code_name in _FAILOVER_CODES:
+                    if code_name == "RESOURCE_EXHAUSTED":
+                        self.scoreboard.record_failure(
+                            host_idx, kind="pushback",
+                            retry_after_s=(
+                                retry_after_ms / 1e3
+                                if retry_after_ms else None
+                            ),
+                        )
+                    elif code_name in _FAILOVER_CODES:
                         self.scoreboard.record_failure(host_idx)
                     else:
                         # A deterministic request error PROVES the backend is
@@ -394,7 +459,9 @@ class ShardedPredictClient:
                         self.scoreboard.record_success(
                             host_idx, time.perf_counter() - t0
                         )
-                raise _ShardAttemptError(host_idx, code, e.details()) from e
+                raise _ShardAttemptError(
+                    host_idx, code, e.details(), retry_after_ms=retry_after_ms
+                ) from e
             if self.scoreboard is not None:
                 self.scoreboard.record_success(host_idx, time.perf_counter() - t0)
             return resp
@@ -567,15 +634,32 @@ class ShardedPredictClient:
                 # just granted — the except below releases it, or the
                 # backend would be steered around forever (_one_rpc covers
                 # only its own await).
-                if attempt and self.backoff_initial_s:
+                if attempt:
                     # Exponential with 0.5x-1.5x jitter: retries decorrelate
                     # across clients instead of synchronizing into a storm.
-                    base = min(
-                        self.backoff_initial_s * (2 ** (attempt - 1)),
-                        self.backoff_max_s,
-                    )
-                    self.counters.backoff_sleeps += 1
-                    await asyncio.sleep(base * (0.5 + self._jitter.random()))
+                    sleep_s = 0.0
+                    if self.backoff_initial_s:
+                        base = min(
+                            self.backoff_initial_s * (2 ** (attempt - 1)),
+                            self.backoff_max_s,
+                        )
+                        sleep_s = base * (0.5 + self._jitter.random())
+                    hint_ms = getattr(last, "retry_after_ms", None)
+                    if hint_ms:
+                        # Server pushback (overload plane): wait AT LEAST
+                        # the retry-after-ms hint — the server sized it
+                        # from its backlog's drain time, which it knows
+                        # and this client can only guess. Capped by the
+                        # operator's backoff ceiling; honored even with
+                        # backoff disabled (the hint is the whole point
+                        # of pushback).
+                        sleep_s = max(
+                            sleep_s, min(hint_ms / 1e3, self.backoff_max_s)
+                        )
+                        self.counters.retry_after_honored += 1
+                    if sleep_s > 0:
+                        self.counters.backoff_sleeps += 1
+                        await asyncio.sleep(sleep_s)
                 if (
                     self.health_probe
                     and self.scoreboard is not None
@@ -890,6 +974,7 @@ def client_from_config(cfg) -> ShardedPredictClient:
         health_probe=cfg.health_probe,
         keepalive_time_ms=cfg.keepalive_time_ms,
         keepalive_timeout_ms=cfg.keepalive_timeout_ms,
+        criticality=cfg.criticality,
     )
 
 

@@ -13,7 +13,16 @@ predictions per second") routes AROUND sick backends instead:
 - **half-open probing**: once the ejection interval passes, exactly ONE
   in-flight request (or an explicit grpc.health.v1 Check, see
   client.ShardedPredictClient.health_probe) is allowed through; success
-  recovers the backend, failure re-ejects it with a doubled interval.
+  recovers the backend, failure re-ejects it with a doubled interval;
+- **pushback is "busy", not "dead"** (overload plane, serving/overload.py):
+  a RESOURCE_EXHAUSTED shed is recorded with kind="pushback" — it proves
+  the backend ALIVE (it answered), so it never consumes the consecutive-
+  failure ejection budget. Instead the host is marked busy for the
+  server's retry-after hint (or a configured default): steering prefers
+  non-busy healthy hosts and hedges never target a busy one. Without this
+  distinction a healthy-but-shedding backend gets ejected and its traffic
+  piles onto the remaining hosts, overloading them next — the ejection
+  cascade that turns one hot host into a fleet-wide brownout.
 
 The scoreboard only STEERS (pick()); the client still owns retry/hedge
 mechanics. Pure in-process bookkeeping: one lock, an injectable clock so
@@ -40,6 +49,11 @@ class ScoreboardConfig:
     max_ejection_s: float = 60.0
     # EWMA smoothing for per-backend latency (0 < alpha <= 1).
     ewma_alpha: float = 0.2
+    # How long a pushback (kind="pushback" failure — an overload shed)
+    # biases steering away from the busy host when the server sent no
+    # retry-after hint. Short on purpose: overload drains in queue-wait
+    # units, not ejection units.
+    pushback_busy_s: float = 0.25
 
 
 @dataclasses.dataclass
@@ -52,6 +66,11 @@ class _HostState:
     ewma_ms: float | None = None
     successes: int = 0
     failures: int = 0
+    # Overload pushback: the host is alive but shedding. Steering prefers
+    # other healthy hosts until busy_until passes; the ejection machinery
+    # never sees these.
+    pushbacks: int = 0
+    busy_until: float = 0.0
 
 
 class BackendScoreboard:
@@ -76,6 +95,7 @@ class BackendScoreboard:
         self.ejections = 0
         self.probes = 0
         self.recoveries = 0
+        self.pushbacks = 0
 
     # ------------------------------------------------------------ recording
 
@@ -96,9 +116,48 @@ class BackendScoreboard:
                 st.current_ejection_s = 0.0
                 self.recoveries += 1
 
-    def record_failure(self, idx: int) -> None:
+    def record_failure(
+        self, idx: int, kind: str = "failure",
+        retry_after_s: float | None = None,
+    ) -> None:
+        """One failed attempt on backend `idx`.
+
+        kind="failure" (default): a reroutable failure — the backend may be
+        dead; counts toward the consecutive-failure ejection budget.
+        kind="pushback": an overload shed (RESOURCE_EXHAUSTED with the
+        serving stack's retry-after hint) — the backend ANSWERED, so it is
+        provably alive; it is marked busy for `retry_after_s` (or the
+        configured pushback_busy_s) and steered around, but the ejection
+        budget is untouched. A pushback landing on a half-open/ejected
+        host is the probe succeeding at being alive: the host recovers to
+        HEALTHY (busy) instead of re-ejecting with a doubled interval —
+        without this, a fleet-wide overload turns into a fleet-wide
+        ejection cascade and the survivors inherit ALL the traffic."""
         with self._lock:
             st = self._states[idx]
+            if kind == "pushback":
+                st.pushbacks += 1
+                self.pushbacks += 1
+                busy = (
+                    retry_after_s
+                    if retry_after_s is not None
+                    else self.config.pushback_busy_s
+                )
+                st.busy_until = max(st.busy_until, self._clock() + busy)
+                # A pushback PROVES the host answers, exactly like a
+                # success does: the consecutive-failure streak is over.
+                # Leaving it at/above the threshold would let ONE later
+                # transient failure instantly re-eject a host that just
+                # demonstrated it is alive — a hair-trigger version of the
+                # very cascade this kind= split exists to prevent.
+                st.consecutive_failures = 0
+                if st.state != HEALTHY:
+                    # Alive-but-busy beats ejected: recover, keep the bias.
+                    st.state = HEALTHY
+                    st.probe_inflight = False
+                    st.current_ejection_s = 0.0
+                    self.recoveries += 1
+                return
             st.failures += 1
             st.consecutive_failures += 1
             if st.state == HALF_OPEN:
@@ -140,12 +199,19 @@ class BackendScoreboard:
         from `preferred`, else any half-open host with a free slot, else —
         everything ejected — the rotation's first non-excluded host
         (sending somewhere beats failing without trying). None only when
-        every host is excluded (failover exhausted the list)."""
+        every host is excluded (failover exhausted the list).
+
+        Pushback bias: among HEALTHY hosts, one the overload plane marked
+        busy (a recent RESOURCE_EXHAUSTED shed) is passed over while a
+        non-busy healthy peer exists — but when EVERY healthy host is
+        busy the rotation applies unchanged (spreading load across busy
+        hosts beats refusing to send)."""
         n = len(self.hosts)
         order = [(preferred + k) % n for k in range(n) if (preferred + k) % n not in exclude]
         if not order:
             return None
         with self._lock:
+            now = self._clock()
             for i in order:
                 self._advance_locked(self._states[i])
             home = self._states[order[0]]
@@ -158,8 +224,12 @@ class BackendScoreboard:
                 self.probes += 1
                 return order[0]
             for i in order:
-                if self._states[i].state == HEALTHY:
+                st = self._states[i]
+                if st.state == HEALTHY and st.busy_until <= now:
                     return i
+            for i in order:
+                if self._states[i].state == HEALTHY:
+                    return i  # every healthy host busy: rotation order
             for i in order:
                 st = self._states[i]
                 if st.state == HALF_OPEN and not st.probe_inflight:
@@ -182,14 +252,18 @@ class BackendScoreboard:
 
     def hedge_target(self, exclude: tuple[int, ...]) -> int | None:
         """Best extra host for a hedged attempt: healthy, lowest EWMA,
-        not already in use. None = nowhere sensible to hedge."""
+        not already in use. None = nowhere sensible to hedge. A host the
+        overload plane marked busy is never hedged into — a hedge is
+        OPTIONAL duplicate work, exactly what a shedding backend asked
+        not to receive."""
         with self._lock:
+            now = self._clock()
             best, best_ms = None, None
             for i, st in enumerate(self._states):
                 if i in exclude:
                     continue
                 self._advance_locked(st)
-                if st.state != HEALTHY:
+                if st.state != HEALTHY or st.busy_until > now:
                     continue
                 ms = st.ewma_ms if st.ewma_ms is not None else float("inf")
                 if best is None or ms < best_ms:
@@ -200,10 +274,12 @@ class BackendScoreboard:
 
     def snapshot(self) -> dict:
         with self._lock:
+            now = self._clock()
             return {
                 "ejections": self.ejections,
                 "probes": self.probes,
                 "recoveries": self.recoveries,
+                "pushbacks": self.pushbacks,
                 "backends": {
                     host: {
                         "state": st.state,
@@ -211,6 +287,8 @@ class BackendScoreboard:
                         "consecutive_failures": st.consecutive_failures,
                         "successes": st.successes,
                         "failures": st.failures,
+                        "pushbacks": st.pushbacks,
+                        "busy": st.busy_until > now,
                     }
                     for host, st in zip(self.hosts, self._states)
                 },

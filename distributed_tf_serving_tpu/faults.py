@@ -17,6 +17,14 @@ Named sites (the instrumented hooks):
 - ``client.rpc``        one per-backend shard RPC (client._shard_call;
                         ``key`` is the backend host string, so a rule can
                         target one backend of a fan-out)
+- ``pressure``          the overload controller's tick
+                        (serving/overload.py _maybe_tick): an ``error``
+                        rule whose ``code`` names a pressure state
+                        (``BROWNOUT``/``SHED``/``NOMINAL``) pins the
+                        NOMINAL->BROWNOUT->SHED state machine there while
+                        the rule is installed — brownout stale-serve and
+                        shed-lane behavior become testable without
+                        generating real overload
 
 Rule kinds:
 

@@ -59,6 +59,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import faults
+from . import overload as overload_mod
 from ..cache import collapse_rows
 from ..models.base import Model
 from ..models.registry import Servable
@@ -94,6 +95,22 @@ class QueueOverloadError(RuntimeError):
     """Queue admission refused: accepting more work would only build a
     backlog no deadline survives. Maps to RESOURCE_EXHAUSTED at the RPC
     layer — shedding beats queueing past the client's deadline."""
+
+
+class AdmissionRefusedError(QueueOverloadError):
+    """The adaptive overload plane (serving/overload.py) refused this
+    request: capacity/lane shedding (`reason` "shed") or doomed-work
+    refusal ("doomed" — the backlog's estimated wait already exceeds the
+    request's remaining deadline budget). Carries the retry-after-ms
+    pushback hint the RPC layer forwards in trailing metadata. Subclasses
+    QueueOverloadError so the status mapping (RESOURCE_EXHAUSTED) and
+    every existing handler stay correct."""
+
+    def __init__(self, message: str, reason: str = "shed",
+                 retry_after_ms: int | None = None):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_ms = retry_after_ms
 
 
 class DeviceWedgedError(RuntimeError):
@@ -477,8 +494,15 @@ class DynamicBatcher:
         donate_buffers: bool = True,
         score_cache=None,
         dedup: bool = False,
+        overload=None,
     ):
         self.compress_transfer = compress_transfer
+        # Overload plane (serving/overload.py): an AdmissionController
+        # replaces the static queue_capacity_candidates check with a
+        # self-tuning limit, criticality lanes, deadline-aware refusal,
+        # and the brownout stale-serve gate. None (default) keeps the
+        # static bound and costs one attribute read per submit.
+        self.overload = overload
         # Cache plane (cache/): an exact-match ScoreCache short-circuits
         # whole-request repeats at submit (hit = no queue, no device, no
         # dispatch slot; identical concurrent misses single-flight onto one
@@ -524,6 +548,11 @@ class DynamicBatcher:
             else 16 * self.buckets[-1],
             self.buckets[-1],
         )
+        if self.overload is not None:
+            # Resolve the controller's auto limit bounds against this
+            # batcher's real geometry (min = one largest bucket, max = the
+            # static capacity the controller replaces).
+            self.overload.bind(self.buckets[-1], self.queue_capacity_candidates)
         # Wedge threshold for the circuit breaker. Default is above any sane
         # steady-state batch but below the 120s RPC deadline; first compiles
         # belong in warmup(), not live traffic.
@@ -601,6 +630,28 @@ class DynamicBatcher:
             native.warm_async()
         return self
 
+    def drain(self, timeout_s: float) -> bool:
+        """Block until every accepted item has fully completed — queue
+        empty, no staged groups, no dispatch in progress, no readback in
+        flight — or `timeout_s` elapses. True = fully drained. The
+        graceful-shutdown path (serving/server.py GracefulShutdown) calls
+        this AFTER new admissions are refused, so the wait is bounded by
+        the work already accepted, not by arriving traffic."""
+        deadline = time.perf_counter() + max(timeout_s, 0.0)
+        with self._cv:
+            while (
+                self._items
+                or self._staged_groups
+                or self._inflight
+                or self._dispatch_pending
+                or self._dispatching_since is not None
+            ):
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.05))
+        return True
+
     def stop(self) -> None:
         if self._started:
             with self._cv:
@@ -651,6 +702,7 @@ class DynamicBatcher:
         output_keys: tuple[str, ...] | None = None,
         deadline_s: float | None = None,
         span: "tracing.Span | None" = None,
+        criticality: str | None = None,
         _warmup: bool = False,
     ) -> Future:
         """Enqueue one request's arrays; returns a Future of output arrays
@@ -660,12 +712,16 @@ class DynamicBatcher:
         expires is shed (RequestDeadlineError -> DEADLINE_EXCEEDED) before
         wasting a dispatch slot. `span` (when per-request tracing is on) is
         the RPC's span handle: the batcher attaches queue-wait and device-
-        stage phase child spans to it from its own threads.
+        stage phase child spans to it from its own threads. `criticality`
+        (overload plane) picks the admission lane — sheddable traffic is
+        refused first under pressure; warmup rides the probe lane.
 
         Admission control (SURVEY.md §5 failure-detection obligations): a
         wedged device fails the request immediately (DeviceWedgedError, and
-        the backlog is shed with it), and a backlog past
-        queue_capacity_candidates is refused (QueueOverloadError) instead of
+        the backlog is shed with it), and a backlog past the admission
+        limit — the static queue_capacity_candidates bound, or the
+        adaptive overload controller's self-tuned limit when armed — is
+        refused (QueueOverloadError / AdmissionRefusedError) instead of
         queueing work no deadline survives."""
         if self._stopping:
             raise RuntimeError("batcher is stopped")
@@ -679,14 +735,32 @@ class DynamicBatcher:
         # including the wedge/overload checks, deliberately: cached scores
         # are servable even while the device is wedged or the queue full.
         cache = self.score_cache
+        ov = self.overload
         handle = None
         if cache is not None and not _warmup:
+            # Brownout stale-serve (overload plane): while pressure is past
+            # NOMINAL, an entry up to stale_while_overloaded_s past its TTL
+            # still answers — marked degraded, never re-filled — so hot-key
+            # traffic keeps getting scores while the device catches up.
+            stale_s = (
+                ov.stale_window_s
+                if ov is not None and ov.stale_serve_active()
+                else 0.0
+            )
             with request_trace.span("cache.lookup"):
                 handle = cache.begin(
-                    servable.name, servable.version, output_keys, arrays
+                    servable.name, servable.version, output_keys, arrays,
+                    stale_s=stale_s,
                 )
             if handle.hit is not None:
-                if span is not None:
+                if handle.stale:
+                    ov.note_brownout_serve()
+                    overload_mod.mark_degraded("stale")
+                    if span is not None:
+                        span.attrs["brownout_stale"] = True
+                        span.annotate("overload.stale_serve",
+                                      stale_window_s=stale_s)
+                elif span is not None:
                     span.attrs["cache_hit"] = True
                 fut: Future = Future()
                 fut.set_result(handle.hit)
@@ -698,7 +772,7 @@ class DynamicBatcher:
         try:
             return self._submit_miss(
                 servable, arrays, n, output_keys, deadline_s, span, _warmup,
-                handle, cache,
+                handle, cache, criticality,
             )
         except BaseException as exc:
             if handle is not None and handle.leader:
@@ -710,7 +784,7 @@ class DynamicBatcher:
 
     def _submit_miss(
         self, servable, arrays, n, output_keys, deadline_s, span, _warmup,
-        handle, cache=None,
+        handle, cache=None, criticality=None,
     ) -> Future:
         """The no-cache-hit tail of submit(): admission, prepare, enqueue
         (exactly the pre-cache-plane submit body). The cache handle, when
@@ -720,6 +794,7 @@ class DynamicBatcher:
         # the copy/fold cost — overload is exactly when the host can least
         # afford it. Capacity is reserved under the lock so concurrent
         # submits cannot overshoot while this one prepares its arrays.
+        ov = self.overload
         with self._cv:
             stuck_s = self._wedged_for(time.perf_counter())
             if stuck_s:
@@ -730,7 +805,27 @@ class DynamicBatcher:
                 self._shed_queued(exc)
                 raise exc
             backlog = self._queued_candidates + self._staged_candidates
-            if backlog + n > self.queue_capacity_candidates:
+            if ov is not None:
+                # Adaptive admission: self-tuned limit + criticality lane
+                # + doomed-work refusal, with a retry-after pushback hint
+                # on every refusal (serving/overload.py).
+                lane = (
+                    overload_mod.PROBE if _warmup
+                    else overload_mod.normalize_criticality(criticality)
+                )
+                decision = ov.admit(n, backlog, lane=lane, deadline_s=deadline_s)
+                if not decision.admitted:
+                    if span is not None:
+                        span.annotate(
+                            "overload.shed", reason=decision.reason,
+                            lane=lane, retry_after_ms=decision.retry_after_ms,
+                        )
+                    raise AdmissionRefusedError(
+                        decision.message,
+                        reason=decision.reason or "shed",
+                        retry_after_ms=decision.retry_after_ms,
+                    )
+            elif backlog + n > self.queue_capacity_candidates:
                 raise QueueOverloadError(
                     f"queue holds {backlog} candidates (queued + staged); "
                     f"admitting {n} more would exceed capacity "
@@ -1611,6 +1706,17 @@ class DynamicBatcher:
                     None if all(it.warmup for it in group) else time.perf_counter()
                 )
             servable = group[0].servable
+            stage_t0 = time.perf_counter()
+            ov = self.overload  # capture: detachable mid-flight (bench A/B)
+            if ov is not None:
+                # Feed the controller the group's measured queue waits —
+                # the controlled variable of the adaptive admission loop.
+                # Warmup items are exempt (their waits include compiles).
+                waits = [
+                    stage_t0 - it.enqueue_t for it in group if not it.warmup
+                ]
+                if waits:
+                    ov.note_queue_waits(waits)
             if phases is not None:
                 # Queue wait is per-item (each enqueued at its own time);
                 # attached directly, not through the shared batch sink.
@@ -1708,7 +1814,8 @@ class DynamicBatcher:
                 _replay_group_phases(group, phases)
                 phases = None  # a later submit() failure must not re-replay
             self._completers.submit(
-                self._complete, batch_id, group, fetch, issue_t0, meta, scatter
+                self._complete, batch_id, group, fetch, issue_t0, meta, scatter,
+                stage_t0,
             )
         except Exception as exc:  # propagate to every waiter, keep serving
             if phases is not None:
@@ -1730,6 +1837,7 @@ class DynamicBatcher:
         self, batch_id: int, group: list[_WorkItem], outputs,
         issue_t0: float | None = None, meta: dict | None = None,
         scatter: "np.ndarray | None" = None,
+        stage_t0: float | None = None,
     ) -> None:
         phases: list | None = (
             [] if tracing.enabled() and any(it.span is not None for it in group)
@@ -1756,6 +1864,17 @@ class DynamicBatcher:
                     waited,
                 )
             downloaded = sum(v.nbytes for v in host.values())
+            ov = self.overload  # capture: detachable mid-flight (bench A/B)
+            if (
+                ov is not None
+                and stage_t0 is not None
+                and not any(it.warmup for it in group)
+            ):
+                # Per-candidate service time (dispatch start -> readback
+                # done): the EWMA estimate that prices backlogs for the
+                # doomed-work refusal and the retry-after hint. Warmup
+                # batches are excluded (compile time is not service time).
+                ov.note_batch(sum(it.n for it in group), done_t - stage_t0)
             window = max(done_t - issue_t0 if issue_t0 is not None else waited, waited)
             with self._cv:  # counters race across completer threads otherwise
                 self.stats.bytes_downloaded += downloaded

@@ -52,6 +52,7 @@ from .. import codec
 from ..proto import serving_apis_pb2 as apis
 from ..utils import tracing
 from ..utils.tracing import request_trace
+from . import overload as overload_mod
 from .service import PredictionServiceImpl, ServiceError
 
 log = logging.getLogger("dts_tpu.rest")
@@ -66,10 +67,40 @@ _HTTP_STATUS = {
 }
 
 
-def _json_error(code: str, message: str) -> web.Response:
-    return web.json_response(
+def _json_error(
+    code: str, message: str, retry_after_ms: int | None = None
+) -> web.Response:
+    resp = web.json_response(
         {"error": message}, status=_HTTP_STATUS.get(code, 500)
     )
+    if retry_after_ms:
+        # Overload pushback (serving/overload.py): the standard header in
+        # whole seconds (ceil — a 25 ms hint must not round to "now") plus
+        # the precise hint the in-tree client honors.
+        resp.headers["Retry-After"] = str(max((retry_after_ms + 999) // 1000, 1))
+        resp.headers[overload_mod.RETRY_AFTER_KEY] = str(int(retry_after_ms))
+    return resp
+
+
+def _criticality_of(request: web.Request) -> str | None:
+    """The request's criticality lane from the x-dts-criticality header
+    (overload plane). Only scanned while a controller is armed."""
+    if not overload_mod.active():
+        return None
+    value = request.headers.get(overload_mod.CRITICALITY_KEY)
+    return overload_mod.normalize_criticality(value) if value else None
+
+
+def _mark_degraded(resp: web.Response) -> web.Response:
+    """Brownout stale-serves announce themselves in an X-DTS-Degraded
+    response header, mirroring the gRPC trailing-metadata marker (the
+    contextvar is task-local, so this request's handler task sees exactly
+    its own marker)."""
+    if overload_mod.active():
+        degraded = overload_mod.consume_degraded()
+        if degraded:
+            resp.headers[overload_mod.DEGRADED_KEY] = degraded
+    return resp
 
 
 class RestGateway:
@@ -222,6 +253,11 @@ class RestGateway:
 
     async def _observed(self, name: str, handler, request) -> web.Response:
         t0 = time.perf_counter()
+        if overload_mod.active():
+            # Clear any degraded marker a FAILED previous request left in
+            # this context (markers are consumed only on the success path,
+            # and aiohttp reuses one task per keep-alive connection).
+            overload_mod.consume_degraded()
         model = request.match_info.get("model")
         if tracing.enabled():
             # Server root span for the REST surface: adopts the caller's
@@ -294,7 +330,9 @@ class RestGateway:
                 codec.from_ndarray(
                     arr, use_tensor_content=True, out=req.inputs[key]
                 )
-            resp = await self.impl.predict_async(req)
+            resp = await self.impl.predict_async(
+                req, criticality=_criticality_of(request)
+            )
             outputs = {
                 k: codec.to_ndarray(v).tolist() for k, v in resp.outputs.items()
             }
@@ -307,14 +345,18 @@ class RestGateway:
                     predictions = [
                         {k: outputs[k][i] for k in names} for i in range(n)
                     ]
-                return web.json_response({"predictions": predictions})
-            if len(outputs) == 1:
-                return web.json_response(
-                    {"outputs": next(iter(outputs.values()))}
+                return _mark_degraded(
+                    web.json_response({"predictions": predictions})
                 )
-            return web.json_response({"outputs": outputs})
+            if len(outputs) == 1:
+                return _mark_degraded(
+                    web.json_response({"outputs": next(iter(outputs.values()))})
+                )
+            return _mark_degraded(web.json_response({"outputs": outputs}))
         except ServiceError as e:
-            return _json_error(e.code, str(e))
+            return _json_error(
+                e.code, str(e), retry_after_ms=e.retry_after_ms
+            )
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             log.exception("internal error serving REST predict")
             return _json_error("INTERNAL", f"internal error: {e}")
@@ -415,7 +457,9 @@ class RestGateway:
             if kind == "classify":
                 req = apis.ClassificationRequest()
                 self._build_example_request(request, req, body)
-                resp = await self.impl.classify_async(req)
+                resp = await self.impl.classify_async(
+                    req, criticality=_criticality_of(request)
+                )
                 # TF-Serving REST shape (json_tensor.cc): one
                 # [[label, score], ...] list per example, same order.
                 results = [
@@ -425,11 +469,15 @@ class RestGateway:
             else:
                 req = apis.RegressionRequest()
                 self._build_example_request(request, req, body)
-                resp = await self.impl.regress_async(req)
+                resp = await self.impl.regress_async(
+                    req, criticality=_criticality_of(request)
+                )
                 results = [r.value for r in resp.result.regressions]
-            return web.json_response({"results": results})
+            return _mark_degraded(web.json_response({"results": results}))
         except ServiceError as e:
-            return _json_error(e.code, str(e))
+            return _json_error(
+                e.code, str(e), retry_after_ms=e.retry_after_ms
+            )
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             log.exception("internal error serving REST %s", kind)
             return _json_error("INTERNAL", f"internal error: {e}")
@@ -452,7 +500,8 @@ class RestGateway:
         stats = getattr(self.impl.batcher, "stats", None)
         return web.Response(
             body=self.metrics.prometheus_text(
-                stats, cache=self.impl.cache_stats()
+                stats, cache=self.impl.cache_stats(),
+                overload=self.impl.overload_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -473,6 +522,12 @@ class RestGateway:
         cache = self.impl.cache_stats()
         if cache is not None:
             snap["cache"] = cache
+        overload = self.impl.overload_stats()
+        if overload is not None:
+            # Overload plane (ISSUE 5): adaptive limit, pressure state,
+            # queue-wait p99 vs target, shed/doomed/brownout counters.
+            snap["overload"] = overload
+        snap["draining"] = bool(getattr(self.impl, "draining", False))
         logger = getattr(self.impl, "request_logger", None)
         if logger is not None:
             # Written/dropped accounting for the sampled PredictionLog

@@ -19,6 +19,8 @@ import argparse
 import dataclasses
 import json
 import logging
+import signal
+import threading
 import time
 from concurrent import futures
 
@@ -40,6 +42,7 @@ from ..utils.config import ServerConfig, load_config
 from ..utils.metrics import ServerMetrics
 from ..utils import tracing
 from ..utils.tracing import request_trace
+from . import overload as overload_mod
 from .batcher import DynamicBatcher
 from .service import PredictionServiceImpl, ServiceError
 
@@ -69,6 +72,44 @@ def _traceparent_of(context) -> str | None:
     return None
 
 
+def _criticality_of(context) -> str | None:
+    """The request's criticality lane from invocation metadata (overload
+    plane; x-dts-criticality). Only scanned while a controller is armed —
+    one module-bool read otherwise."""
+    if not overload_mod.active():
+        return None
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == overload_mod.CRITICALITY_KEY:
+                return overload_mod.normalize_criticality(value)
+    except Exception:  # noqa: BLE001 — a metadata quirk must not fail the RPC
+        return None
+    return None
+
+
+def _push_overload_metadata(context, exc: ServiceError | None) -> None:
+    """Overload-plane trailing metadata, shared by both transports: the
+    retry-after-ms pushback hint on refusals, and the degraded marker on
+    brownout stale-served successes (exc None). set_trailing_metadata
+    exists on both sync and aio contexts and is a no-op cost when the
+    plane is off (callers gate on overload.active())."""
+    try:
+        if exc is not None:
+            ra = getattr(exc, "retry_after_ms", None)
+            if ra:
+                context.set_trailing_metadata(
+                    ((overload_mod.RETRY_AFTER_KEY, str(int(ra))),)
+                )
+        else:
+            degraded = overload_mod.consume_degraded()
+            if degraded:
+                context.set_trailing_metadata(
+                    ((overload_mod.DEGRADED_KEY, degraded),)
+                )
+    except Exception:  # noqa: BLE001 — hints are advisory, never fatal
+        pass
+
+
 class _SyncServicerBase:
     """Shared adapter plumbing for sync servicers: ServiceError -> grpc
     status mapping + per-RPC metrics (+ the per-request server root span
@@ -82,6 +123,12 @@ class _SyncServicerBase:
         t0 = time.perf_counter()
         ok = False
         model = _model_of(request)
+        overload_on = overload_mod.active()
+        if overload_on:
+            # Clear any degraded marker a failed PREVIOUS request left in
+            # this handler thread's context (markers are consumed only on
+            # the success path).
+            overload_mod.consume_degraded()
         if tracing.enabled():
             # Server-side LOCAL ROOT: adopts the client's trace id (and
             # parents onto the exact shard-attempt span that carried the
@@ -100,8 +147,16 @@ class _SyncServicerBase:
             else:
                 resp = fn(request)
             ok = True
+            if overload_on:
+                # Brownout stale-serves announce themselves in trailing
+                # metadata so callers can tell degraded from fresh.
+                _push_overload_metadata(context, None)
             return resp
         except ServiceError as e:
+            if overload_on:
+                # Overload refusals carry the retry-after-ms pushback hint
+                # the client's failover backoff honors.
+                _push_overload_metadata(context, e)
             context.abort(_status(e.code), str(e))
         except Exception as e:  # internal bug: surface as INTERNAL, keep serving
             log.exception("internal error serving %s", name)
@@ -128,33 +183,45 @@ class GrpcPredictionService(_SyncServicerBase):
 
     def Predict(self, request, context):
         deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
         return self._call(
             "Predict",
-            lambda req: self.impl.predict(req, deadline_s=deadline_s),
+            lambda req: self.impl.predict(
+                req, deadline_s=deadline_s, criticality=crit
+            ),
             request, context,
         )
 
     def Classify(self, request, context):
         deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
         return self._call(
             "Classify",
-            lambda req: self.impl.classify(req, deadline_s=deadline_s),
+            lambda req: self.impl.classify(
+                req, deadline_s=deadline_s, criticality=crit
+            ),
             request, context,
         )
 
     def Regress(self, request, context):
         deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
         return self._call(
             "Regress",
-            lambda req: self.impl.regress(req, deadline_s=deadline_s),
+            lambda req: self.impl.regress(
+                req, deadline_s=deadline_s, criticality=crit
+            ),
             request, context,
         )
 
     def MultiInference(self, request, context):
         deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
         return self._call(
             "MultiInference",
-            lambda req: self.impl.multi_inference(req, deadline_s=deadline_s),
+            lambda req: self.impl.multi_inference(
+                req, deadline_s=deadline_s, criticality=crit
+            ),
             request, context,
         )
 
@@ -198,9 +265,13 @@ class GrpcHealthService:
         served = self.impl.registry.models()
         if not service:
             ready = any(served.values())
+            # A draining server (SIGTERM received, GracefulShutdown in
+            # progress) reports NOT_SERVING so load balancers stop routing
+            # to it while accepted work finishes.
             return (
                 health_proto.SERVING
-                if (self.impl.warmup_complete and ready)
+                if (self.impl.warmup_complete and ready
+                    and not getattr(self.impl, "draining", False))
                 else health_proto.NOT_SERVING
             )
         if served.get(service):
@@ -311,6 +382,9 @@ class _AioServicerBase:
         t0 = time.perf_counter()
         ok = False
         model = _model_of(request)
+        overload_on = overload_mod.active()
+        if overload_on:
+            overload_mod.consume_degraded()  # clear a failed predecessor's marker
         if tracing.enabled():
             span_ctx = tracing.start_root(
                 f"server.{name}",
@@ -333,8 +407,12 @@ class _AioServicerBase:
                 if hasattr(resp, "__await__"):
                     resp = await resp
             ok = True
+            if overload_on:
+                _push_overload_metadata(context, None)
             return resp
         except ServiceError as e:
+            if overload_on:
+                _push_overload_metadata(context, e)
             await context.abort(_status(e.code), str(e))
         except grpc.aio.AbortError:
             raise
@@ -362,25 +440,34 @@ class AioGrpcPredictionService(_AioServicerBase):
 
     async def Predict(self, request, context):
         deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
         return await self._call(
             "Predict",
-            lambda req: self.impl.predict_async(req, deadline_s=deadline_s),
+            lambda req: self.impl.predict_async(
+                req, deadline_s=deadline_s, criticality=crit
+            ),
             request, context,
         )
 
     async def Classify(self, request, context):
         deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
         return await self._call(
             "Classify",
-            lambda req: self.impl.classify_async(req, deadline_s=deadline_s),
+            lambda req: self.impl.classify_async(
+                req, deadline_s=deadline_s, criticality=crit
+            ),
             request, context,
         )
 
     async def Regress(self, request, context):
         deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
         return await self._call(
             "Regress",
-            lambda req: self.impl.regress_async(req, deadline_s=deadline_s),
+            lambda req: self.impl.regress_async(
+                req, deadline_s=deadline_s, criticality=crit
+            ),
             request, context,
         )
 
@@ -393,10 +480,16 @@ class AioGrpcPredictionService(_AioServicerBase):
         # MultiInference with a long deadline against a saturated batcher
         # must not freeze every other in-flight RPC.
         deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
         entry_t = time.perf_counter()
         loop = asyncio.get_running_loop()
 
         def run(req, _fn=self.impl.multi_inference):
+            overload_on = overload_mod.active()
+            if overload_on:
+                # Pool threads keep their contextvar context across uses:
+                # drop any marker a FAILED earlier request left behind.
+                overload_mod.consume_degraded()
             # Re-derive the REMAINING budget at executor start: time spent
             # queued behind other executor work belongs to the client's
             # budget, not on top of it.
@@ -404,10 +497,20 @@ class AioGrpcPredictionService(_AioServicerBase):
                 None if deadline_s is None
                 else deadline_s - (time.perf_counter() - entry_t)
             )
-            return _fn(req, deadline_s=left)
+            resp = _fn(req, deadline_s=left, criticality=crit)
+            # run_in_executor does NOT propagate contextvars back, so a
+            # brownout stale-serve marker set in THIS thread must ride the
+            # return value or the aio transport would mark stale results
+            # fresh.
+            return resp, (
+                overload_mod.consume_degraded() if overload_on else None
+            )
 
-        def dispatch(req):
-            return loop.run_in_executor(None, run, req)
+        async def dispatch(req):
+            resp, degraded = await loop.run_in_executor(None, run, req)
+            if degraded:
+                overload_mod.mark_degraded(degraded)
+            return resp
 
         return await self._call("MultiInference", dispatch, request, context)
 
@@ -721,6 +824,117 @@ def _start_model_config_watchers(cfg, model_configs, registry, batcher, model_co
     return lifecycle
 
 
+class GracefulShutdown:
+    """Drain-aware teardown — ONE path for every way the server stops.
+
+    SIGTERM (the deploy orchestrator's stop signal), REST-startup failure,
+    and normal wait_for_termination exit all converge here, replacing the
+    historical server.stop(0)-here / server.stop(2).wait()-there split.
+    The sequence:
+
+    1. `impl.draining = True`: the grpc.health.v1 servicer flips to
+       NOT_SERVING (load balancers stop routing) and every NEW inference
+       admission is refused UNAVAILABLE with a "draining" detail — fan-out
+       clients reroute to another backend immediately.
+    2. Version watchers stop (no new loads/warmups enter the batcher).
+    3. `batcher.drain(grace_s)`: queued + staged + in-flight batches run
+       to completion, bounded by the grace period — work the server
+       ACCEPTED is work it answers.
+    4. `server.stop(grace)` with the grace budget REMAINING after the
+       drain (plus a small floor so handler threads can encode the
+       responses the drain just completed), then batcher/request-log
+       teardown.
+
+    Idempotent and thread-safe: the first caller runs the sequence,
+    everyone else (the SIGTERM thread racing the finally block, say)
+    blocks until it finishes. `shutdown()` is safe from any thread;
+    `install_signal_handler()` must run on the main thread."""
+
+    # Floor for the post-drain RPC grace: even a fully-drained server
+    # needs a beat for handler threads to serialize responses.
+    MIN_RPC_GRACE_S = 1.0
+
+    def __init__(
+        self,
+        impl,
+        batcher,
+        grace_s: float = 5.0,
+        watcher=None,
+        request_logger=None,
+    ):
+        self.impl = impl
+        self.batcher = batcher
+        self.grace_s = max(float(grace_s), 0.0)
+        self.watcher = watcher
+        self.request_logger = request_logger
+        self.server = None  # attached once created (create_server[_async])
+        self.drained: bool | None = None
+        self._lock = threading.Lock()
+        self._started = False
+        self._done = threading.Event()
+
+    def install_signal_handler(self) -> bool:
+        """Route SIGTERM through the drain sequence (main thread only —
+        CPython restriction; embedded/test callers just call shutdown())."""
+        try:
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+        except ValueError:  # not the main thread
+            return False
+        return True
+
+    def _on_sigterm(self, signum, frame) -> None:
+        # Handlers run on the main thread, which is parked inside
+        # wait_for_termination — the drain must run elsewhere so stop()
+        # can unblock it.
+        log.info("SIGTERM: draining (grace %.1fs)", self.grace_s)
+        threading.Thread(
+            target=self.shutdown, name="graceful-drain", daemon=True
+        ).start()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._started:
+                run_it = False
+            else:
+                self._started = True
+                run_it = True
+        if not run_it:
+            self._done.wait()
+            return
+        try:
+            t0 = time.perf_counter()
+            # 1. Refuse new work; health goes NOT_SERVING.
+            self.impl.draining = True
+            # 2. No new loads/warmups behind the drain.
+            if self.watcher is not None:
+                self.watcher.stop()
+            # 3. Answer everything already accepted, bounded by grace.
+            self.drained = self.batcher.drain(self.grace_s)
+            if not self.drained:
+                log.warning(
+                    "drain grace %.1fs expired with work still in flight; "
+                    "stopping anyway", self.grace_s,
+                )
+            # 4. Stop the transport with whatever grace remains (handlers
+            # are unblocking off the just-completed batcher futures), then
+            # the batcher and the log writer.
+            left = max(
+                self.grace_s - (time.perf_counter() - t0),
+                self.MIN_RPC_GRACE_S,
+            )
+            if self.server is not None:
+                self.server.stop(left).wait()
+            self.batcher.stop()
+            if self.request_logger is not None:
+                self.request_logger.close()
+            log.info(
+                "shutdown complete (drained=%s, %.1fs)",
+                self.drained, time.perf_counter() - t0,
+            )
+        finally:
+            self._done.set()
+
+
 def build_stack(
     cfg: ServerConfig,
     checkpoint: str | None = None,
@@ -728,6 +942,7 @@ def build_stack(
     model_config: ModelConfig | None = None,
     model_base_path: str | None = None,
     cache_config=None,
+    overload_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -738,7 +953,12 @@ def build_stack(
     model_config_list entry). cache_config (the TOML [cache] section, a
     utils.config.CacheConfig) arms the cache plane: an exact-match score
     cache + single-flight coalescing at submit, intra-batch dedup in the
-    batcher, generation invalidation wired to every version watcher."""
+    batcher, generation invalidation wired to every version watcher.
+    overload_config (the TOML [overload] section, a utils.config.
+    OverloadConfig) arms the adaptive overload plane: a self-tuning
+    admission limit replaces the static queue_capacity_candidates bound,
+    with criticality lanes, doomed-work refusal, brownout stale-serve
+    (through the score cache, when armed), and retry-after pushback."""
     # Validate the multi-model config (and its exclusivity) BEFORE any
     # threads exist — a typo'd file must leave nothing to tear down.
     model_configs = None
@@ -777,6 +997,19 @@ def build_stack(
             cache_config.max_entries, cache_config.max_bytes,
             cache_config.ttl_s, cache_config.coalesce, cache_config.dedup,
         )
+    overload_ctrl = (
+        overload_config.build() if overload_config is not None else None
+    )
+    if overload_ctrl is not None:
+        log.info(
+            "adaptive overload control on: target_queue_wait_ms=%.1f "
+            "brownout_after=%d shed_after=%d stale_while_overloaded_s=%.1f "
+            "— `overload` block in /monitoring",
+            overload_config.target_queue_wait_ms,
+            overload_config.brownout_after_intervals,
+            overload_config.shed_after_intervals,
+            overload_config.stale_while_overloaded_s,
+        )
     batcher = DynamicBatcher(
         buckets=cfg.buckets,
         max_wait_us=cfg.max_wait_us,
@@ -797,6 +1030,7 @@ def build_stack(
             cache_config.enabled and cache_config.dedup
             if cache_config is not None else False
         ),
+        overload=overload_ctrl,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
     # Health gating: the grpc.health.v1 servicer reports the overall server
@@ -973,6 +1207,14 @@ def serve(argv=None) -> None:
         "the capacity/ttl/coalesce/dedup knobs",
     )
     parser.add_argument(
+        "--overload", action="store_true", default=None,
+        help="adaptive overload control (serving/overload.py): self-tuning "
+        "admission limit driven by queue-wait vs target, criticality "
+        "lanes, doomed-work refusal, brownout stale-serve, retry-after "
+        "pushback. Equivalent to [overload] enabled=true; the [overload] "
+        "section carries the target/limit/brownout/stale knobs",
+    )
+    parser.add_argument(
         "--batching-parameters-file", dest="batching_parameters_file",
         help="tensorflow_model_server-format batching config (text-format "
         "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
@@ -1018,7 +1260,7 @@ def serve(argv=None) -> None:
     )
     args = parser.parse_args(argv)
 
-    from ..utils.config import CacheConfig, ObservabilityConfig
+    from ..utils.config import CacheConfig, ObservabilityConfig, OverloadConfig
 
     cfgs = load_config(args.config) if args.config else {"server": ServerConfig()}
     cfg = cfgs["server"]
@@ -1028,6 +1270,9 @@ def serve(argv=None) -> None:
     cache_config = cfgs.get("cache") or CacheConfig()
     if args.cache:
         cache_config = dataclasses.replace(cache_config, enabled=True)
+    overload_config = cfgs.get("overload") or OverloadConfig()
+    if args.overload:
+        overload_config = dataclasses.replace(overload_config, enabled=True)
     model_config = cfgs.get("model")
     if model_config is not None:
         # Explicit CLI architecture flags win over the TOML [model] section
@@ -1081,6 +1326,16 @@ def serve(argv=None) -> None:
         model_config=model_config,
         model_base_path=args.model_base_path,
         cache_config=cache_config,
+        overload_config=overload_config,
+    )
+    # ONE teardown path for every exit: SIGTERM, REST-startup failure, and
+    # normal termination all drain through this (admissions refused, queued
+    # + in-flight work answered up to [overload] drain_grace_s, transport
+    # stopped with the remaining grace).
+    shutdown = GracefulShutdown(
+        impl, batcher,
+        grace_s=overload_config.drain_grace_s,
+        watcher=watcher,
     )
     request_logger = None
     if cfg.request_log_file:
@@ -1090,6 +1345,7 @@ def serve(argv=None) -> None:
             cfg.request_log_file, sampling_rate=cfg.request_log_sampling
         )
         impl.request_logger = request_logger
+        shutdown.request_logger = request_logger
         log.info("request logging to %s (sampling %.4f)",
                  cfg.request_log_file, cfg.request_log_sampling)
     if obs.apply() is not None:
@@ -1104,14 +1360,17 @@ def serve(argv=None) -> None:
         credentials=credentials,
     )
     server.start()
+    shutdown.server = server
+    # SIGTERM = drain: health NOT_SERVING, new admissions refused
+    # UNAVAILABLE("draining"), accepted work answered up to the grace.
+    shutdown.install_signal_handler()
     if credentials is not None:
         log.info("gRPC port is TLS-secured (--ssl-config-file)")
     if args.rest_port:
         try:
             bound = start_rest_in_thread(impl, cfg.host, args.rest_port, metrics)
         except RuntimeError as exc:
-            server.stop(0)
-            batcher.stop()
+            shutdown.shutdown()
             raise SystemExit(str(exc)) from exc
         log.info("REST gateway on %s:%d (/v1/models/...)", cfg.host, bound)
     log.info(
@@ -1133,12 +1392,9 @@ def serve(argv=None) -> None:
             server.wait_for_termination()
     finally:
         log.info("shutting down")
-        if watcher is not None:
-            watcher.stop()
-        server.stop(2).wait()
-        batcher.stop()
-        if request_logger is not None:
-            request_logger.close()
+        # Same drain path as SIGTERM (no-op if the signal already ran it:
+        # shutdown() is idempotent and blocks until the first run finishes).
+        shutdown.shutdown()
 
 
 if __name__ == "__main__":

@@ -46,11 +46,16 @@ SIGNATURE_DEF_FIELD = "signature_def"
 
 
 class ServiceError(Exception):
-    """Carries a grpc-compatible status code name ('NOT_FOUND', ...)."""
+    """Carries a grpc-compatible status code name ('NOT_FOUND', ...).
+    `retry_after_ms`, when set (overload-plane refusals), is the pushback
+    hint the transport adapters forward in trailing metadata (gRPC) or
+    the Retry-After header (REST)."""
 
-    def __init__(self, code: str, message: str):
+    def __init__(self, code: str, message: str,
+                 retry_after_ms: int | None = None):
         super().__init__(message)
         self.code = code
+        self.retry_after_ms = retry_after_ms
 
 
 def _wrap_lookup(fn):
@@ -85,6 +90,11 @@ class PredictionServiceImpl:
         # CURRENT source (deploy tools replay their full config) while
         # rejecting an actual move this mode cannot honor.
         self.served_sources: dict[str, tuple[str, str]] = {}
+        # Graceful drain (serving/server.py GracefulShutdown): True once a
+        # SIGTERM/shutdown started — new inference admissions are refused
+        # with UNAVAILABLE "draining" while queued + in-flight work
+        # completes, and the grpc.health.v1 servicer reports NOT_SERVING.
+        self.draining = False
 
     def _log_request(self, kind: str, request) -> None:
         if self.request_logger is not None:
@@ -111,6 +121,25 @@ class PredictionServiceImpl:
                 "no score cache is configured ([cache] enabled=false)",
             )
         return cache.flush(model)
+
+    def overload_stats(self) -> dict | None:
+        """Overload-plane snapshot (adaptive limit, pressure state, shed /
+        doomed / brownout counters) — the `overload` block in /monitoring
+        and the dts_tpu_overload_* Prometheus series. None when no
+        controller is armed ([overload] enabled=false)."""
+        ctrl = getattr(self.batcher, "overload", None)
+        return ctrl.snapshot() if ctrl is not None else None
+
+    def _refuse_if_draining(self) -> None:
+        """Drain-aware admission gate: once shutdown started, new
+        inference work is refused (UNAVAILABLE, so fan-out clients reroute
+        to another backend) while already-accepted work completes."""
+        if self.draining:
+            raise ServiceError(
+                "UNAVAILABLE",
+                "server is draining (shutdown in progress); retry against "
+                "another backend",
+            )
 
     def is_configured(self, name: str) -> bool:
         """True when this server is CONFIGURED to serve `name` (a watcher
@@ -275,7 +304,13 @@ class PredictionServiceImpl:
         return different codes for the same failure. Re-raises anything
         that is not a batcher failure."""
         if isinstance(exc, (BatchTooLargeError, QueueOverloadError)):
-            return ServiceError("RESOURCE_EXHAUSTED", str(exc))
+            # Overload-plane refusals (AdmissionRefusedError) carry a
+            # retry-after-ms pushback hint; it rides the ServiceError so
+            # the transport can attach it as trailing metadata.
+            return ServiceError(
+                "RESOURCE_EXHAUSTED", str(exc),
+                retry_after_ms=getattr(exc, "retry_after_ms", None),
+            )
         if isinstance(exc, DeviceWedgedError):
             return ServiceError("UNAVAILABLE", str(exc))
         if isinstance(exc, RequestDeadlineError):
@@ -341,6 +376,7 @@ class PredictionServiceImpl:
         arrays: dict[str, np.ndarray],
         output_keys: tuple[str, ...] | None = None,
         deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> dict[str, np.ndarray]:
         timeout = self._effective_timeout(deadline_s)
         fut = None
@@ -351,6 +387,7 @@ class PredictionServiceImpl:
             fut = self.batcher.submit(
                 servable, arrays, output_keys=output_keys,
                 deadline_s=deadline_s, span=tracing.current_span(),
+                criticality=criticality,
             )
             return fut.result(timeout=timeout)
         except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
@@ -362,6 +399,7 @@ class PredictionServiceImpl:
         arrays: dict[str, np.ndarray],
         output_keys: tuple[str, ...] | None = None,
         deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> dict[str, np.ndarray]:
         """_run for coroutine servers (server.create_server_async): the
         batcher Future is awaited instead of blocked on, so one event-loop
@@ -377,6 +415,7 @@ class PredictionServiceImpl:
             fut = self.batcher.submit(
                 servable, arrays, output_keys=output_keys,
                 deadline_s=deadline_s, span=tracing.current_span(),
+                criticality=criticality,
             )
             return await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=timeout
@@ -428,14 +467,17 @@ class PredictionServiceImpl:
         return servable, arrays, out_names, fetch_keys
 
     def predict(
-        self, request: apis.PredictRequest, deadline_s: float | None = None
+        self, request: apis.PredictRequest, deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> apis.PredictResponse:
+        self._refuse_if_draining()
         deadline_t = self._clock_deadline(deadline_s)
         servable, arrays, out_names, fetch_keys = self._predict_prepare(request)
         with request_trace.span("predict.execute"):
             outputs = self._run(
                 servable, arrays, output_keys=fetch_keys,
                 deadline_s=self._budget_left(deadline_t),
+                criticality=criticality,
             )
         resp = self._predict_finish(request, servable, out_names, outputs)
         # Log only SUCCEEDED requests: the file's contract is direct
@@ -445,16 +487,19 @@ class PredictionServiceImpl:
         return resp
 
     async def predict_async(
-        self, request: apis.PredictRequest, deadline_s: float | None = None
+        self, request: apis.PredictRequest, deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> apis.PredictResponse:
         """Predict for coroutine servers: identical semantics, awaits the
         batch instead of blocking a handler thread on it."""
+        self._refuse_if_draining()
         deadline_t = self._clock_deadline(deadline_s)
         servable, arrays, out_names, fetch_keys = self._predict_prepare(request)
         with request_trace.span("predict.execute"):
             outputs = await self._run_async(
                 servable, arrays, output_keys=fetch_keys,
                 deadline_s=self._budget_left(deadline_t),
+                criticality=criticality,
             )
         resp = self._predict_finish(request, servable, out_names, outputs)
         self._log_request("predict", request)
@@ -535,16 +580,23 @@ class PredictionServiceImpl:
             raise ServiceError("INVALID_ARGUMENT", str(e)) from e
         return servable, arrays
 
-    def _run_examples(self, request, deadline_s: float | None = None):
+    def _run_examples(
+        self, request, deadline_s: float | None = None,
+        criticality: str | None = None,
+    ):
         deadline_t = self._clock_deadline(deadline_s)
         servable, arrays = self._examples_prepare(request)
         outputs = self._run(
             servable, arrays, output_keys=("prediction_node",),
             deadline_s=self._budget_left(deadline_t),
+            criticality=criticality,
         )
         return servable, outputs
 
-    async def _run_examples_async(self, request, deadline_s: float | None = None):
+    async def _run_examples_async(
+        self, request, deadline_s: float | None = None,
+        criticality: str | None = None,
+    ):
         """_run_examples for coroutine servers (the REST gateway's
         :classify/:regress routes ride the same event loop as :predict)."""
         deadline_t = self._clock_deadline(deadline_s)
@@ -552,6 +604,7 @@ class PredictionServiceImpl:
         outputs = await self._run_async(
             servable, arrays, output_keys=("prediction_node",),
             deadline_s=self._budget_left(deadline_t),
+            criticality=criticality,
         )
         return servable, outputs
 
@@ -570,26 +623,35 @@ class PredictionServiceImpl:
         return resp
 
     def _classify_impl(
-        self, request: apis.ClassificationRequest, deadline_s: float | None = None
+        self, request: apis.ClassificationRequest, deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> apis.ClassificationResponse:
         """classify() minus request logging (multi_inference sub-calls ride
         this so a logged MultiInference record is not double-counted as its
         constituent classifications)."""
-        servable, outputs = self._run_examples(request, deadline_s=deadline_s)
+        servable, outputs = self._run_examples(
+            request, deadline_s=deadline_s, criticality=criticality
+        )
         return self._classify_finish(request, servable, outputs)
 
     def classify(
-        self, request: apis.ClassificationRequest, deadline_s: float | None = None
+        self, request: apis.ClassificationRequest, deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> apis.ClassificationResponse:
-        resp = self._classify_impl(request, deadline_s=deadline_s)
+        self._refuse_if_draining()
+        resp = self._classify_impl(
+            request, deadline_s=deadline_s, criticality=criticality
+        )
         self._log_request("classify", request)
         return resp
 
     async def classify_async(
-        self, request: apis.ClassificationRequest, deadline_s: float | None = None
+        self, request: apis.ClassificationRequest, deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> apis.ClassificationResponse:
+        self._refuse_if_draining()
         servable, outputs = await self._run_examples_async(
-            request, deadline_s=deadline_s
+            request, deadline_s=deadline_s, criticality=criticality
         )
         resp = self._classify_finish(request, servable, outputs)
         self._log_request("classify", request)
@@ -605,23 +667,32 @@ class PredictionServiceImpl:
         return resp
 
     def _regress_impl(
-        self, request: apis.RegressionRequest, deadline_s: float | None = None
+        self, request: apis.RegressionRequest, deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> apis.RegressionResponse:
-        servable, outputs = self._run_examples(request, deadline_s=deadline_s)
+        servable, outputs = self._run_examples(
+            request, deadline_s=deadline_s, criticality=criticality
+        )
         return self._regress_finish(request, servable, outputs)
 
     def regress(
-        self, request: apis.RegressionRequest, deadline_s: float | None = None
+        self, request: apis.RegressionRequest, deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> apis.RegressionResponse:
-        resp = self._regress_impl(request, deadline_s=deadline_s)
+        self._refuse_if_draining()
+        resp = self._regress_impl(
+            request, deadline_s=deadline_s, criticality=criticality
+        )
         self._log_request("regress", request)
         return resp
 
     async def regress_async(
-        self, request: apis.RegressionRequest, deadline_s: float | None = None
+        self, request: apis.RegressionRequest, deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> apis.RegressionResponse:
+        self._refuse_if_draining()
         servable, outputs = await self._run_examples_async(
-            request, deadline_s=deadline_s
+            request, deadline_s=deadline_s, criticality=criticality
         )
         resp = self._regress_finish(request, servable, outputs)
         self._log_request("regress", request)
@@ -630,8 +701,10 @@ class PredictionServiceImpl:
     # --------------------------------------------------------- MultiInference
 
     def multi_inference(
-        self, request: apis.MultiInferenceRequest, deadline_s: float | None = None
+        self, request: apis.MultiInferenceRequest, deadline_s: float | None = None,
+        criticality: str | None = None,
     ) -> apis.MultiInferenceResponse:
+        self._refuse_if_draining()
         if not request.tasks:
             raise ServiceError("INVALID_ARGUMENT", "MultiInferenceRequest has no tasks")
         # Sub-calls run sequentially, so each gets the budget REMAINING at
@@ -654,13 +727,17 @@ class PredictionServiceImpl:
             method = task.method_name
             if method == "tensorflow/serving/classify":
                 sub = apis.ClassificationRequest(model_spec=task.model_spec, input=request.input)
-                out = self._classify_impl(sub, deadline_s=remaining())
+                out = self._classify_impl(
+                    sub, deadline_s=remaining(), criticality=criticality
+                )
                 r = resp.results.add()
                 r.model_spec.CopyFrom(out.model_spec)
                 r.classification_result.CopyFrom(out.result)
             elif method == "tensorflow/serving/regress":
                 sub = apis.RegressionRequest(model_spec=task.model_spec, input=request.input)
-                out = self._regress_impl(sub, deadline_s=remaining())
+                out = self._regress_impl(
+                    sub, deadline_s=remaining(), criticality=criticality
+                )
                 r = resp.results.add()
                 r.model_spec.CopyFrom(out.model_spec)
                 r.regression_result.CopyFrom(out.result)

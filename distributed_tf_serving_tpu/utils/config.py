@@ -148,6 +148,11 @@ class ClientConfig:
     # Route by version label instead of latest ("" = unset; upstream
     # ModelSpec.version_label routing, e.g. "stable"/"canary").
     version_label: str = ""
+    # Request criticality lane sent in gRPC metadata (x-dts-criticality):
+    # "critical" / "default" / "sheddable". Overloaded servers running the
+    # [overload] plane shed sheddable traffic first. "" = unset (servers
+    # treat it as "default").
+    criticality: str = ""
     # TLS toward an --ssl-config-file server ("" = plaintext). PATHS here
     # (unlike the server's inline-PEM textproto): client configs name the
     # deployed cert files. key+cert both set => mTLS identity.
@@ -232,6 +237,65 @@ class CacheConfig:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class OverloadConfig:
+    """Overload-control knobs (serving/overload.py): the adaptive
+    admission controller, criticality lanes, brownout stale-serve, and
+    the drain grace the SIGTERM handler honors. Everything defaults OFF;
+    when off the batcher keeps its static queue_capacity_candidates bound
+    and pays one attribute read per submit."""
+
+    # Master switch: build an AdmissionController and hand it to the
+    # batcher (replacing the static queue_capacity_candidates check).
+    enabled: bool = False
+    # The controlled variable: windowed queue-wait p99 is steered toward
+    # this target by growing/shrinking the admission limit.
+    target_queue_wait_ms: float = 50.0
+    # Sliding window the p99 is computed over, and how often the AIMD
+    # controller ticks (opportunistically, from the submit path).
+    queue_wait_window_s: float = 10.0
+    adjust_interval_s: float = 0.5
+    # AIMD step sizes: additive growth while under target, multiplicative
+    # shrink while over.
+    increase_candidates: int = 1024
+    decrease_factor: float = 0.7
+    # Limit clamp in candidates. 0 = auto: min one largest bucket (a
+    # full-size request always admits on an idle queue), max the static
+    # queue capacity the controller replaces.
+    min_limit_candidates: int = 0
+    max_limit_candidates: int = 0
+    # EWMA smoothing for per-candidate service time (deadline pricing).
+    service_ewma_alpha: float = 0.2
+    # Refuse at enqueue when the backlog's estimated wait already exceeds
+    # the request's remaining deadline budget (doomed work).
+    deadline_refusal: bool = True
+    # Pressure state machine: consecutive over-target ticks before
+    # NOMINAL->BROWNOUT and before BROWNOUT->SHED; consecutive under-
+    # target ticks before stepping one level back down.
+    brownout_after_intervals: int = 4
+    shed_after_intervals: int = 12
+    recover_after_intervals: int = 6
+    # Brownout stale-serve: while pressure is past NOMINAL, score-cache
+    # entries up to this far past their TTL still serve (marked degraded,
+    # never re-filled). 0 disables stale serving.
+    stale_while_overloaded_s: float = 30.0
+    # Clamp for the retry-after-ms pushback hint on refusals.
+    retry_after_floor_ms: int = 25
+    retry_after_cap_ms: int = 2000
+    # SIGTERM drain: how long the server waits for queued + in-flight
+    # batches to finish before stopping (honored whether or not the
+    # adaptive controller is enabled).
+    drain_grace_s: float = 5.0
+
+    def build(self):
+        """AdmissionController per this config, or None when disabled."""
+        if not self.enabled:
+            return None
+        from ..serving.overload import AdmissionController
+
+        return AdmissionController(self)
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -243,6 +307,7 @@ _SECTIONS = {
     "client": ClientConfig,
     "observability": ObservabilityConfig,
     "cache": CacheConfig,
+    "overload": OverloadConfig,
 }
 
 

@@ -403,7 +403,7 @@ class ServerMetrics:
             }
         return out
 
-    def prometheus_text(self, batcher_stats=None, cache=None) -> str:
+    def prometheus_text(self, batcher_stats=None, cache=None, overload=None) -> str:
         """Prometheus exposition (text format 0.0.4) of the same data
         snapshot() serves as JSON. Metric names mirror tensorflow_model_
         server's monitoring surface (`:tensorflow:serving:request_count` /
@@ -515,6 +515,10 @@ class ServerMetrics:
                  cache.get("expirations", 0)),
                 ("dts_tpu_cache_invalidations_total", "counter",
                  cache.get("invalidations", 0)),
+                # Brownout stale-serves (overload plane): expired entries
+                # answered inside the stale window while pressure was on.
+                ("dts_tpu_cache_stale_serves_total", "counter",
+                 cache.get("stale_serves", 0)),
                 ("dts_tpu_cache_hit_rate", "gauge", cache.get("hit_rate", 0.0)),
                 ("dts_tpu_cache_entries", "gauge", cache.get("entries", 0)),
                 ("dts_tpu_cache_value_bytes", "gauge",
@@ -533,6 +537,51 @@ class ServerMetrics:
                             f'{mc}{{{base},event="{event}"}} '
                             f'{counters.get(event, 0)}'
                         )
+        if overload is not None:
+            # Overload plane (ISSUE 5): the AdmissionController snapshot
+            # dict as dts_tpu_overload_* series — the adaptive limit +
+            # controlled-variable gauges, shed/doomed/brownout counters,
+            # per-lane sheds, and a one-hot pressure-state gauge (the
+            # standard Prometheus encoding for an enum, so dashboards can
+            # `max by (state)` it).
+            for metric, kind, value in (
+                ("dts_tpu_overload_limit_candidates", "gauge",
+                 overload.get("limit", 0)),
+                ("dts_tpu_overload_queue_wait_p99_ms", "gauge",
+                 overload.get("queue_wait_p99_ms", 0.0)),
+                ("dts_tpu_overload_target_queue_wait_ms", "gauge",
+                 overload.get("target_queue_wait_ms", 0.0)),
+                ("dts_tpu_overload_admitted_total", "counter",
+                 overload.get("admitted", 0)),
+                ("dts_tpu_overload_sheds_total", "counter",
+                 overload.get("sheds", 0)),
+                ("dts_tpu_overload_doomed_refusals_total", "counter",
+                 overload.get("doomed_refusals", 0)),
+                ("dts_tpu_overload_brownout_serves_total", "counter",
+                 overload.get("brownout_serves", 0)),
+                ("dts_tpu_overload_limit_increases_total", "counter",
+                 overload.get("limit_increases", 0)),
+                ("dts_tpu_overload_limit_decreases_total", "counter",
+                 overload.get("limit_decreases", 0)),
+                ("dts_tpu_overload_state_changes_total", "counter",
+                 overload.get("state_changes", 0)),
+            ):
+                lines.append(f"# TYPE {metric} {kind}")
+                lines.append(f"{metric} {value}")
+            by_lane = overload.get("sheds_by_lane") or {}
+            if by_lane:
+                ls = "dts_tpu_overload_lane_sheds_total"
+                lines.append(f"# TYPE {ls} counter")
+                for lane, n in sorted(by_lane.items()):
+                    lines.append(f'{ls}{{lane="{esc(lane)}"}} {n}')
+            st = "dts_tpu_overload_pressure_state"
+            lines.append(f"# TYPE {st} gauge")
+            current = overload.get("state", "nominal")
+            for state in ("nominal", "brownout", "shed"):
+                lines.append(
+                    f'{st}{{state="{esc(state)}"}} '
+                    f'{1 if state == current else 0}'
+                )
         return "\n".join(lines) + "\n"
 
 

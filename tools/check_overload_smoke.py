@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Gate for the tier-1 overload smoke (tools/ci_tier1.sh
+TIER1_OVERLOAD_SMOKE=1).
+
+Reads the SOAK_OVERLOAD=1 soak's JSON line and asserts the overload
+plane's acceptance conditions (ISSUE 5):
+
+- the adaptive controller actually SHED under the ~3x load (nonzero
+  sheds, with RESOURCE_EXHAUSTED visible to clients as pushback);
+- brownout stale-serve actually ANSWERED hot-key traffic from the score
+  cache past its TTL (nonzero brownout serves);
+- the shedding backend was NEVER ejected by its own client (zero
+  scoreboard ejections — pushback registers as busy, not dead), and at
+  least one client backoff honored a server retry-after-ms hint;
+- goodput (in-deadline successes/s) stayed above a floor — the plane
+  degrades, it does not collapse.
+
+Exits nonzero with a reason otherwise, so CI fails with evidence instead
+of a silent green. The floor defaults low enough for a shared CI core and
+can be raised via OVERLOAD_GOODPUT_FLOOR.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tier1_overload_soak.json"
+    floor = float(os.environ.get("OVERLOAD_GOODPUT_FLOOR", "10.0"))
+    lines = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    continue
+    if not lines:
+        print(f"overload smoke: no JSON line in {path}", file=sys.stderr)
+        return 1
+    line = lines[-1]
+    ov = line.get("overload") or {}
+    ctrl = ov.get("controller") or {}
+    problems = []
+    if ctrl.get("sheds", 0) <= 0:
+        problems.append(f"controller never shed (controller: {ctrl})")
+    if ctrl.get("brownout_serves", 0) <= 0:
+        problems.append(
+            "zero brownout stale-serves (pressure state history: "
+            f"state={ctrl.get('state')} changes={ctrl.get('state_changes')})"
+        )
+    if ov.get("client_pushbacks", 0) <= 0:
+        problems.append(
+            "clients saw no RESOURCE_EXHAUSTED pushback — sheds never "
+            "reached a client, or the pushback accounting is broken"
+        )
+    if ov.get("client_retry_after_honored", 0) <= 0:
+        problems.append(
+            "no client backoff honored a retry-after-ms hint — refusals "
+            "are missing the trailing-metadata hint, or the client ignores it"
+        )
+    if ov.get("client_ejections", 0) != 0:
+        problems.append(
+            f"{ov.get('client_ejections')} scoreboard ejection(s) of the "
+            "overloaded backend — pushback must register as busy, never "
+            "consume the ejection budget (the cascade this plane exists "
+            "to prevent)"
+        )
+    if ov.get("goodput_qps", 0.0) < floor:
+        problems.append(
+            f"goodput {ov.get('goodput_qps')} qps below floor {floor} — "
+            "the plane collapsed instead of degrading"
+        )
+    if line.get("grpc_err", 0) and not line.get("grpc_ok", 0):
+        problems.append("every gRPC request errored during the overload soak")
+    if problems:
+        for p in problems:
+            print(f"overload smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print(
+        "overload smoke ok: goodput={} qps sheds={} (by_lane={}) doomed={} "
+        "brownout_serves={} pushbacks={} retry_after_honored={} "
+        "ejections=0 queue_wait_p99_ms={}".format(
+            ov.get("goodput_qps"), ctrl.get("sheds"),
+            ctrl.get("sheds_by_lane"), ctrl.get("doomed_refusals"),
+            ctrl.get("brownout_serves"), ov.get("client_pushbacks"),
+            ov.get("client_retry_after_honored"),
+            ctrl.get("queue_wait_p99_ms"),
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,4 +48,21 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_CACHE_SMOKE:-0}" = "1" ]; then
         python tools/soak.py | tee "$CACHE_LINE" || rc=1
     python tools/check_cache_smoke.py "$CACHE_LINE" || rc=1
 fi
+
+# Overload smoke (TIER1_OVERLOAD_SMOKE=1): a short SOAK_OVERLOAD=1 soak —
+# ~3x sustainable load with a mid-run burst against the adaptive admission
+# plane — must show nonzero sheds, nonzero brownout stale-serves, client
+# pushback with a honored retry-after hint, ZERO scoreboard ejections of
+# the overloaded backend, and goodput above a floor
+# (tools/check_overload_smoke.py). Runs the soak's own overload defaults
+# (24+12 burst workers, 1000-candidate requests): the mode's knobs were
+# tuned as a set, and shrinking them piecemeal starves the shed path.
+if [ "$rc" -eq 0 ] && [ "${TIER1_OVERLOAD_SMOKE:-0}" = "1" ]; then
+    OVERLOAD_LINE="${TIER1_OVERLOAD_LINE:-/tmp/tier1_overload_soak.json}"
+    echo "tier1: overload smoke (SOAK_OVERLOAD=1, line $OVERLOAD_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_OVERLOAD_SECONDS:-12}" SOAK_OVERLOAD=1 \
+        python tools/soak.py | tee "$OVERLOAD_LINE" || rc=1
+    python tools/check_overload_smoke.py "$OVERLOAD_LINE" || rc=1
+fi
 exit $rc

@@ -36,6 +36,25 @@ bounded-queue request logger under the mixed load — note it adds a
 SerializeToString per sampled request, so A/Bs against logging-off soaks
 are not apples-to-apples).
 
+Overload mode (SOAK_OVERLOAD=1): the adaptive overload plane (ISSUE 5,
+serving/overload.py) under ~3x sustainable load. Capacity is made
+deterministic with an injected batcher.dispatch delay
+(SOAK_OVERLOAD_DISPATCH_DELAY_S, default 0.03 -> ~33 batches/s), the
+worker pool is sized ~3x what that drains, and a mid-run BURST
+(SOAK_OVERLOAD_BURST_WORKERS, default +grpc_workers/2) runs from 40% to
+70% of the soak. The batcher runs an AdmissionController (self-tuning
+limit, criticality lanes, doomed-work refusal, brownout stale-serve
+through a short-TTL score cache on a zipfian workload) and gRPC workers
+carry a short deadline (SOAK_OVERLOAD_DEADLINE_S, default 2.0) so goodput
+= in-deadline successes/s. One worker in three sends
+criticality=sheddable. The client runs the scoreboard with
+failover_attempts=1: RESOURCE_EXHAUSTED sheds must register as PUSHBACK
+(busy), never ejection. The JSON line gains an `overload` block —
+goodput_qps, the controller snapshot (sheds / doomed_refusals /
+brownout_serves / limit / queue_wait_p99_ms), cache stale_serves, and
+client pushback counters — gated in CI by tools/check_overload_smoke.py
+(nonzero sheds, nonzero brownout serves, zero ejections, goodput floor).
+
 Chaos mode (SOAK_CHAOS=1, seeded by SOAK_CHAOS_SEED): deterministic fault
 injection (distributed_tf_serving_tpu/faults.py) rides the same soak —
 low-rate injected RPC errors + delays at the client.rpc / batcher.dispatch
@@ -57,6 +76,7 @@ valid and non-empty via tools/check_trace.py.
 """
 
 import asyncio
+import contextlib
 import json
 import os
 import sys
@@ -106,9 +126,21 @@ def main() -> None:
     platform = jax.devices()[0].platform
     tpu = platform != "cpu"
     seconds = float(os.environ.get("SOAK_SECONDS", "300"))
-    grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "8"))
+    # Overload mode (SOAK_OVERLOAD=1): adaptive admission under ~3x
+    # sustainable load with a mid-run burst; see module docstring.
+    overload_mode = os.environ.get("SOAK_OVERLOAD", "0") == "1"
+    overload_deadline_s = float(os.environ.get("SOAK_OVERLOAD_DEADLINE_S", "2.0"))
+    dispatch_delay_s = float(
+        os.environ.get("SOAK_OVERLOAD_DISPATCH_DELAY_S", "0.03")
+    )
+    grpc_workers = int(
+        os.environ.get("SOAK_GRPC_WORKERS", "24" if overload_mode else "8")
+    )
     rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "4"))
     candidates = int(os.environ.get("SOAK_CANDIDATES", "1000"))
+    burst_workers = int(
+        os.environ.get("SOAK_OVERLOAD_BURST_WORKERS", str(max(grpc_workers // 2, 4)))
+    ) if overload_mode else 0
     chaos = os.environ.get("SOAK_CHAOS", "0") == "1"
     # Cache mode (SOAK_CACHE=1): the batcher runs with the score cache +
     # single-flight + intra-batch dedup armed, and the gRPC workers switch
@@ -138,6 +170,16 @@ def main() -> None:
         faults.get().add("client.rpc", "delay", rate=0.05, delay_s=0.02)
         faults.get().add("batcher.dispatch", "delay", rate=0.05, delay_s=0.01)
         faults.get().add("readback", "delay", rate=0.05, delay_s=0.005)
+    if overload_mode:
+        from distributed_tf_serving_tpu import faults
+
+        # Deterministic capacity: EVERY dispatch eats a fixed injected
+        # delay, so "sustainable load" is ~1/delay batches/s regardless of
+        # how fast this host's CPU runs the tiny soak model — the worker
+        # pool above is sized ~3x that, which is the overload.
+        faults.get().add(
+            "batcher.dispatch", "delay", rate=1.0, delay_s=dispatch_delay_s
+        )
 
     # Bench-scale servable on the accelerator; small on the CPU platform so
     # the one core spends its budget on the serving stack, not the forward.
@@ -166,10 +208,53 @@ def main() -> None:
         # cache plane's behavior under load, not TTL churn (TTL/eviction
         # correctness is tests/test_cache.py's job).
         score_cache = ScoreCache(ttl_s=max(seconds * 2, 600.0))
+    elif overload_mode:
+        from distributed_tf_serving_tpu.cache import ScoreCache
+
+        # SHORT TTL on purpose: hot zipfian entries must actually expire
+        # mid-soak so the brownout stale-serve window (entries past TTL
+        # still answering while pressure > NOMINAL) gets exercised.
+        score_cache = ScoreCache(
+            ttl_s=float(os.environ.get("SOAK_OVERLOAD_CACHE_TTL_S", "1.5"))
+        )
+    overload_ctrl = None
+    if overload_mode:
+        from distributed_tf_serving_tpu.utils.config import OverloadConfig
+
+        # Faster-than-default control cadence so short CI smokes (8-12s)
+        # traverse NOMINAL -> BROWNOUT and shed well inside the run.
+        overload_ctrl = OverloadConfig(
+            enabled=True,
+            target_queue_wait_ms=float(
+                os.environ.get("SOAK_OVERLOAD_TARGET_MS", "50")
+            ),
+            adjust_interval_s=0.25,
+            brownout_after_intervals=3,
+            shed_after_intervals=10,
+            recover_after_intervals=8,
+            stale_while_overloaded_s=float(
+                os.environ.get("SOAK_OVERLOAD_STALE_S", "60")
+            ),
+            # Tighter-than-auto ceiling: the limit starts at max and only
+            # ratchets DOWN from observed queue wait, so the static-bound
+            # default (16x the largest bucket) would let the opening
+            # stampede queue several seconds deep — blowing every client
+            # deadline before the controller's first shrink tick.
+            max_limit_candidates=int(
+                os.environ.get("SOAK_OVERLOAD_MAX_LIMIT", "6144")
+            ),
+            # Let the limit shrink BELOW one largest bucket (the auto min):
+            # at 1024 the sheddable lane's ceiling (0.7x) is smaller than
+            # one 1000-candidate request, so sustained pressure visibly
+            # sheds the sheddable lane — the ordering the smoke gate reads.
+            min_limit_candidates=int(
+                os.environ.get("SOAK_OVERLOAD_MIN_LIMIT", "1024")
+            ),
+        ).build()
     buckets = (1024, 2048, 4096, 8192, 16384) if tpu else (1024, 2048)
     batcher = DynamicBatcher(
         buckets=buckets, max_wait_us=2000, completion_workers=12,
-        score_cache=score_cache, dedup=cache_mode,
+        score_cache=score_cache, dedup=cache_mode, overload=overload_ctrl,
     ).start()
     batcher.max_batch_candidates = buckets[-1]
     for b in buckets:
@@ -189,12 +274,20 @@ def main() -> None:
     ]
     cache_block: dict = {}
     zipf_pool, zipf_sched = None, None
-    if cache_mode:
+    use_zipf = cache_mode or overload_mode
+    if use_zipf:
         # Zipfian workload: hot payloads repeat (score-cache hits +
         # coalescing) and hot rows recur across distinct payloads
         # (intra-batch dedup). Seeded, so reruns replay the same stream.
+        # Overload mode rides the same stream but over a WIDER pool: hot
+        # keys + a short cache TTL make brownout stale-serve observable,
+        # while the cold tail keeps real misses flowing into admission so
+        # the shed path stays exercised (a fully-cached pool would let
+        # stale-serve absorb everything and the gate's shed counter idle).
         zipf_pool = make_zipfian_payloads(
-            32, candidates, NUM_FIELDS, skew=cache_skew,
+            int(os.environ.get("SOAK_OVERLOAD_POOL", "128"))
+            if overload_mode and not cache_mode else 32,
+            candidates, NUM_FIELDS, skew=cache_skew,
             seed=int(os.environ.get("SOAK_CACHE_SEED", "0")),
             catalog=max(candidates * 4, 256),
         )
@@ -202,6 +295,7 @@ def main() -> None:
             4096, len(zipf_pool), skew=cache_skew,
             seed=int(os.environ.get("SOAK_CACHE_SEED", "0")) + 1,
         )
+    if cache_mode:
         # Pre-flight bit-identity probe through the real batcher. The
         # reference is computed with the WHOLE cache plane disarmed
         # (score cache detached, dedup off) — comparing a cached copy
@@ -270,33 +364,57 @@ def main() -> None:
         key = detail[:120]
         counts["errors"][key] = counts["errors"].get(key, 0) + 1
 
+    async def one_grpc_request(client, wid: int, i: int) -> None:
+        if use_zipf:
+            # Seeded zipfian stream: worker w walks the schedule from
+            # its own offset, so concurrent workers frequently hold
+            # the SAME hot payload in flight (single-flight coverage)
+            # while the tail keeps misses coming.
+            payload = zipf_pool[
+                zipf_sched[(wid * 997 + i) % len(zipf_sched)]
+            ]
+        else:
+            # Interleave regimes every 7 requests, like the r4 soak:
+            # the cache's regime detector must ride the transitions
+            # without false bypass or stale hits.
+            phase = (i // 7 + wid) % 3
+            payload = (
+                wide, compact, unique_pool[(i + wid) % len(unique_pool)]
+            )[phase]
+        try:
+            await client.predict(payload, sort_scores=True)
+            counts["grpc_ok"] += 1
+        except PredictClientError as e:
+            note_error("grpc", f"{getattr(e.code, 'name', e.code)}: {e}")
+        except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
+            note_error("grpc", f"{type(e).__name__}: {e}")
+
     async def grpc_worker(client, wid: int):
+        if overload_mode:
+            # Staggered ramp: real load arrives as a ramp, not a step.
+            # An instantaneous 24-worker stampede onto a cold controller
+            # (limit still at max, no service-time EWMA yet) would queue
+            # past every deadline before the first shrink tick.
+            await asyncio.sleep(min(wid, 40) * 0.05)
         i = 0
         while time.perf_counter() < deadline:
             i += 1
-            if cache_mode:
-                # Seeded zipfian stream: worker w walks the schedule from
-                # its own offset, so concurrent workers frequently hold
-                # the SAME hot payload in flight (single-flight coverage)
-                # while the tail keeps misses coming.
-                payload = zipf_pool[
-                    zipf_sched[(wid * 997 + i) % len(zipf_sched)]
-                ]
-            else:
-                # Interleave regimes every 7 requests, like the r4 soak:
-                # the cache's regime detector must ride the transitions
-                # without false bypass or stale hits.
-                phase = (i // 7 + wid) % 3
-                payload = (
-                    wide, compact, unique_pool[(i + wid) % len(unique_pool)]
-                )[phase]
-            try:
-                await client.predict(payload, sort_scores=True)
-                counts["grpc_ok"] += 1
-            except PredictClientError as e:
-                note_error("grpc", f"{getattr(e.code, 'name', e.code)}: {e}")
-            except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
-                note_error("grpc", f"{type(e).__name__}: {e}")
+            await one_grpc_request(client, wid, i)
+
+    # Mid-run burst (overload mode): extra workers spike the offered load
+    # from 40% to 70% of the soak — the adaptive limit must absorb the
+    # step up (shed harder / brown out) and recover after it steps down.
+    burst_t0 = deadline - seconds * 0.6
+    burst_t1 = deadline - seconds * 0.3
+
+    async def burst_worker(client, wid: int):
+        now = time.perf_counter()
+        if now < burst_t0:
+            await asyncio.sleep(burst_t0 - now)
+        i = 0
+        while time.perf_counter() < min(burst_t1, deadline):
+            i += 1
+            await one_grpc_request(client, 1000 + wid, i)
 
     async def rest_worker(session, wid: int):
         i = 0
@@ -374,29 +492,64 @@ def main() -> None:
             "retained": len(tracing.recorder().spans()),
         })
 
+    client_counters: list[dict] = []
+
     async def drive():
         server, gport = create_server_async(impl, "127.0.0.1:0")
         await server.start()
         runner, rport = await start_rest_gateway(impl, port=0)
         try:
-            async with ShardedPredictClient(
-                [f"127.0.0.1:{gport}"], "DCN", channels_per_host=3,
+            client_kwargs = dict(
+                channels_per_host=3,
                 # Chaos soaks run the resilience layer live: scoreboard on,
                 # one failover attempt so injected UNAVAILABLEs reroute
-                # (same single host — exercises the backoff path).
-                scoreboard=chaos,
-                failover_attempts=1 if chaos else 0,
-            ) as client, aiohttp.ClientSession(
-                f"http://127.0.0.1:{rport}"
-            ) as session:
+                # (same single host — exercises the backoff path). Overload
+                # soaks run it too: sheds must land as PUSHBACK (busy) on
+                # the scoreboard and the one retry honors retry-after-ms.
+                scoreboard=chaos or overload_mode,
+                failover_attempts=1 if (chaos or overload_mode) else 0,
+            )
+            if overload_mode:
+                # The RPC deadline IS the goodput bar: a success under
+                # this client is by construction an in-deadline success.
+                client_kwargs["timeout_s"] = overload_deadline_s
+            async with contextlib.AsyncExitStack() as stack:
+                client = await stack.enter_async_context(
+                    ShardedPredictClient(
+                        [f"127.0.0.1:{gport}"], "DCN", **client_kwargs
+                    )
+                )
+                # One worker in three sends criticality=sheddable — the
+                # lane an overloaded server drops first.
+                shed_client = (
+                    await stack.enter_async_context(
+                        ShardedPredictClient(
+                            [f"127.0.0.1:{gport}"], "DCN",
+                            criticality="sheddable", **client_kwargs,
+                        )
+                    )
+                    if overload_mode else None
+                )
+                session = await stack.enter_async_context(
+                    aiohttp.ClientSession(f"http://127.0.0.1:{rport}")
+                )
                 try:
                     await asyncio.gather(
-                        *(grpc_worker(client, w) for w in range(grpc_workers)),
+                        *(grpc_worker(
+                            shed_client
+                            if (shed_client is not None and w % 3 == 2)
+                            else client,
+                            w,
+                        ) for w in range(grpc_workers)),
+                        *(burst_worker(client, w) for w in range(burst_workers)),
                         *(rest_worker(session, w) for w in range(rest_workers)),
                         control_worker(gport),
                     )
                 finally:
                     resilience.update(client.resilience_counters())
+                    client_counters.append(client.resilience_counters())
+                    if shed_client is not None:
+                        client_counters.append(shed_client.resilience_counters())
                     prom_out = os.environ.get("SOAK_PROM_OUT", "")
                     if prom_out:
                         # Client resilience state in Prometheus text, next
@@ -488,6 +641,33 @@ def main() -> None:
             if cache_mode else None
         ),
         "resilience": resilience or None,
+        "overload": (
+            {
+                # Goodput: every grpc_ok ran under timeout_s == the
+                # deadline, so successes ARE in-deadline successes.
+                "goodput_qps": round(counts["grpc_ok"] / wall, 1),
+                "deadline_s": overload_deadline_s,
+                "dispatch_delay_s": dispatch_delay_s,
+                "grpc_workers": grpc_workers,
+                "burst_workers": burst_workers,
+                "controller": batcher.overload.snapshot(),
+                "stale_serves": score_cache.snapshot()["stale_serves"],
+                # Aggregated across BOTH clients (default + sheddable):
+                # the smoke gate reads these — sheds must register as
+                # pushback (busy), never as ejection.
+                "client_pushbacks": sum(
+                    c.get("pushbacks_received", 0) for c in client_counters
+                ),
+                "client_retry_after_honored": sum(
+                    c.get("retry_after_honored", 0) for c in client_counters
+                ),
+                "client_ejections": sum(
+                    c.get("scoreboard", {}).get("ejections", 0)
+                    for c in client_counters
+                ),
+            }
+            if overload_mode else None
+        ),
         "trace": trace_block or None,
         "chaos": None,
         "input_cache": (
@@ -502,10 +682,11 @@ def main() -> None:
             else None
         ),
     }
-    if chaos:
+    if chaos or overload_mode:
         from distributed_tf_serving_tpu import faults
 
-        line["chaos"] = faults.get().snapshot()
+        if chaos:
+            line["chaos"] = faults.get().snapshot()
         faults.reset()
     batcher.stop()
     print(json.dumps(line))
