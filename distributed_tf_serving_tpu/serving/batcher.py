@@ -495,8 +495,15 @@ class DynamicBatcher:
         score_cache=None,
         dedup: bool = False,
         overload=None,
+        utilization=None,
     ):
         self.compress_transfer = compress_transfer
+        # Utilization plane (serving/utilization.py): an OccupancyLedger
+        # fed one interval per completed batch from the existing
+        # dispatch/readback sites, plus cheap wait-interval records while
+        # the batcher idles (the device-idle causes the gap waterfall
+        # attributes). None (default) costs one attribute read per hook.
+        self.utilization = utilization
         # Overload plane (serving/overload.py): an AdmissionController
         # replaces the static queue_capacity_candidates check with a
         # self-tuning limit, criticality lanes, deadline-aware refusal,
@@ -820,12 +827,19 @@ class DynamicBatcher:
                             "overload.shed", reason=decision.reason,
                             lane=lane, retry_after_ms=decision.retry_after_ms,
                         )
+                    if (util := self.utilization) is not None:
+                        # Gap-attribution event: an empty queue during a
+                        # shed storm is refused traffic, not absent
+                        # traffic (idle cause "admission_shed").
+                        util.note_shed()
                     raise AdmissionRefusedError(
                         decision.message,
                         reason=decision.reason or "shed",
                         retry_after_ms=decision.retry_after_ms,
                     )
             elif backlog + n > self.queue_capacity_candidates:
+                if (util := self.utilization) is not None:
+                    util.note_shed()
                 raise QueueOverloadError(
                     f"queue holds {backlog} candidates (queued + staged); "
                     f"admitting {n} more would exceed capacity "
@@ -1424,7 +1438,18 @@ class DynamicBatcher:
                     return it
                 if self._stopping:
                     return None
-                self._cv.wait()
+                if (util := self.utilization) is not None:
+                    # Idle-cause record for the gap waterfall: the device
+                    # sat idle because no work arrived (on this rig, the
+                    # transport/client-bound share of wall time). Clock
+                    # reads only on the idle path.
+                    token = util.wait_begin("queue_empty")
+                    try:
+                        self._cv.wait()
+                    finally:
+                        util.wait_end(token)
+                else:
+                    self._cv.wait()
 
     def _coalesce_next(self, item: _WorkItem, total: int, deadline: float) -> _WorkItem | None:
         """Next same-target item within the (pipeline-extended) window, or
@@ -1446,7 +1471,18 @@ class DynamicBatcher:
                     if self._stopping:
                         return None
                     if now < deadline:
-                        self._cv.wait(deadline - now)
+                        if (util := self.utilization) is not None:
+                            # Coalesce fill: the host deliberately holds
+                            # the batch open — device idle charged to
+                            # host_pack (clamped out where the pipeline
+                            # keeps the device busy underneath).
+                            token = util.wait_begin("host_pack")
+                            try:
+                                self._cv.wait(deadline - now)
+                            finally:
+                                util.wait_end(token)
+                        else:
+                            self._cv.wait(deadline - now)
                         continue
                     busy = len(self._inflight) + self._dispatch_pending
                     if busy < self.pipeline_depth or self._wedged_for(now):
@@ -1458,7 +1494,16 @@ class DynamicBatcher:
                     if not free_ride_counted:
                         self.stats.fill_waits += 1
                         free_ride_counted = True
-                    self._cv.wait(0.005)
+                    if (util := self.utilization) is not None:
+                        # Pipeline saturated: dispatch blocked behind
+                        # in-flight readbacks (idle cause readback_wait).
+                        token = util.wait_begin("readback_wait")
+                        try:
+                            self._cv.wait(0.005)
+                        finally:
+                            util.wait_end(token)
+                    else:
+                        self._cv.wait(0.005)
                 nxt = self._items[0]
                 if nxt.future.cancelled() or (
                     nxt.deadline_t is not None
@@ -1677,6 +1722,8 @@ class DynamicBatcher:
         stage phases and fault annotations land in it here and are
         replayed onto every member request's span."""
         pending_closed = sid is None
+        util = None  # assigned once the batch passes the early-out checks
+        util_handed_off = False
 
         def sink_ctx():
             # Fresh context per use: collect_phases is a generator context
@@ -1695,6 +1742,7 @@ class DynamicBatcher:
                     self._staged_candidates -= total
             if all(it.future.cancelled() for it in group):
                 return  # every waiter gave up; skip the device work
+            all_warm = all(it.warmup for it in group)
             with self._cv:
                 # An all-warmup group is exempt from the wedge clock:
                 # hot-load warmup (warmup_via_queue during a version
@@ -1703,10 +1751,18 @@ class DynamicBatcher:
                 # every rollout. A live request coalesced into the group
                 # re-arms the clock.
                 self._dispatching_since = (
-                    None if all(it.warmup for it in group) else time.perf_counter()
+                    None if all_warm else time.perf_counter()
                 )
             servable = group[0].servable
             stage_t0 = time.perf_counter()
+            # Utilization ledger: captured here (detachable mid-flight,
+            # the overload/cache precedent) and handed to the completer so
+            # the depth gauge's inc/dec stay paired even if the plane is
+            # swapped while this batch is in flight. Warmup batches are
+            # compile time, not device occupancy.
+            util = None if all_warm else self.utilization
+            if util is not None:
+                util.depth_inc()
             ov = self.overload  # capture: detachable mid-flight (bench A/B)
             if ov is not None:
                 # Feed the controller the group's measured queue waits —
@@ -1815,8 +1871,9 @@ class DynamicBatcher:
                 phases = None  # a later submit() failure must not re-replay
             self._completers.submit(
                 self._complete, batch_id, group, fetch, issue_t0, meta, scatter,
-                stage_t0,
+                stage_t0, util=util, bucket=bucket,
             )
+            util_handed_off = True
         except Exception as exc:  # propagate to every waiter, keep serving
             if phases is not None:
                 # The spans must show the phases (and any injected-fault
@@ -1827,6 +1884,10 @@ class DynamicBatcher:
                 if not it.future.done():
                     it.future.set_exception(exc)
         finally:
+            if util is not None and not util_handed_off:
+                # A device-stage failure never reaches _complete: close
+                # the gauge here so in_flight cannot drift upward.
+                util.depth_dec()
             with self._cv:
                 self._dispatching_since = None
                 if not pending_closed:
@@ -1838,6 +1899,7 @@ class DynamicBatcher:
         issue_t0: float | None = None, meta: dict | None = None,
         scatter: "np.ndarray | None" = None,
         stage_t0: float | None = None,
+        util=None, bucket: int = 0,
     ) -> None:
         phases: list | None = (
             [] if tracing.enabled() and any(it.span is not None for it in group)
@@ -1864,6 +1926,7 @@ class DynamicBatcher:
                     waited,
                 )
             downloaded = sum(v.nbytes for v in host.values())
+            total_n = sum(it.n for it in group)
             ov = self.overload  # capture: detachable mid-flight (bench A/B)
             if (
                 ov is not None
@@ -1874,7 +1937,17 @@ class DynamicBatcher:
                 # done): the EWMA estimate that prices backlogs for the
                 # doomed-work refusal and the retry-after hint. Warmup
                 # batches are excluded (compile time is not service time).
-                ov.note_batch(sum(it.n for it in group), done_t - stage_t0)
+                ov.note_batch(total_n, done_t - stage_t0)
+            if util is not None and stage_t0 is not None:
+                # THE interval append the utilization plane is built on:
+                # one (stage-start, readback-issued, readback-done) triple
+                # per batch closes the preceding idle gap, extends the
+                # busy union, and feeds the windowed gap waterfall.
+                util.note_batch(
+                    stage_t0, issue_t0 if issue_t0 is not None else done_t,
+                    done_t, bucket=bucket, candidates=total_n,
+                    d2h_wait_s=waited,
+                )
             window = max(done_t - issue_t0 if issue_t0 is not None else waited, waited)
             with self._cv:  # counters race across completer threads otherwise
                 self.stats.bytes_downloaded += downloaded
@@ -1930,6 +2003,8 @@ class DynamicBatcher:
                 if not it.future.done():
                     it.future.set_exception(exc)
         finally:
+            if util is not None:
+                util.depth_dec()
             # The breaker closes itself here: once the stuck (or healthy)
             # readback finishes, the wedge condition clears with it — and
             # any coalescer free-riding the busy pipeline is woken, since

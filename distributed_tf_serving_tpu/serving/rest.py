@@ -162,6 +162,15 @@ class RestGateway:
             # counters + occupancy/config, and the operator flush control.
             web.get("/cachez", self.cachez),
             web.post("/cachez/flush", self.cachez_flush),
+            # Utilization plane (ISSUE 6): the occupancy ledger's gap
+            # waterfall (wall time decomposed into device/H2D/D2H plus
+            # idle-by-cause, summing to wall) + the live
+            # achieved_fraction_of_device_limit estimate, and on-demand
+            # deep capture (jax.profiler device trace + host-thread stack
+            # sampling over one window).
+            web.get("/utilz", self.utilz),
+            web.get("/profilez", self.profilez_status),
+            web.post("/profilez/start", self.profilez_start),
         ])
 
     # ------------------------------------------------------------- helpers
@@ -502,6 +511,7 @@ class RestGateway:
             body=self.metrics.prometheus_text(
                 stats, cache=self.impl.cache_stats(),
                 overload=self.impl.overload_stats(),
+                utilization=self.impl.utilization_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -527,6 +537,11 @@ class RestGateway:
             # Overload plane (ISSUE 5): adaptive limit, pressure state,
             # queue-wait p99 vs target, shed/doomed/brownout counters.
             snap["overload"] = overload
+        utilization = self.impl.utilization_stats()
+        if utilization is not None:
+            # Utilization plane (ISSUE 6): occupancy ledger + gap
+            # waterfall + live achieved_fraction_of_device_limit.
+            snap["utilization"] = utilization
         snap["draining"] = bool(getattr(self.impl, "draining", False))
         logger = getattr(self.impl, "request_logger", None)
         if logger is not None:
@@ -550,6 +565,50 @@ class RestGateway:
         body = rec.tracez(limit=limit)
         body["enabled"] = tracing.enabled()
         return web.json_response(body, dumps=dumps)
+
+    async def utilz(self, request: web.Request) -> web.Response:
+        """GET /utilz[?window=S]: the utilization-attribution surface —
+        occupancy ledger counters, idle-gap histogram by blocking cause,
+        the windowed gap waterfall (components sum to wall), and the live
+        achieved_fraction_of_device_limit estimate. `{"enabled": false}`
+        when no ledger is armed ([utilization] enabled=false), so probes
+        need no config knowledge."""
+        window = request.query.get("window")
+        if window is not None:
+            try:
+                window = float(window)
+            except ValueError:
+                return _json_error("INVALID_ARGUMENT", "window must be a number")
+        stats = self.impl.utilization_stats(window)
+        return web.json_response(
+            stats if stats is not None else {"enabled": False}
+        )
+
+    async def profilez_status(self, request: web.Request) -> web.Response:
+        """GET /profilez: is a deep capture running, and where will its
+        artifacts land."""
+        from .utilization import profiler_capture
+
+        return web.json_response(profiler_capture().status())
+
+    async def profilez_start(self, request: web.Request) -> web.Response:
+        """POST /profilez/start?seconds=N: one-shot deep capture —
+        jax.profiler device trace + host-thread stack sampling over the
+        same window (tools/profile_host.py methodology). Returns the
+        artifact paths immediately; the capture stops itself after N
+        seconds. A concurrent capture is refused with 409 (the jax
+        profiler is process-global)."""
+        from .utilization import CaptureInProgressError, profiler_capture
+
+        try:
+            seconds = float(request.query.get("seconds", "3"))
+        except ValueError:
+            return _json_error("INVALID_ARGUMENT", "seconds must be a number")
+        try:
+            info = profiler_capture().start(seconds)
+        except CaptureInProgressError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"started": True, **info})
 
     async def cachez(self, request: web.Request) -> web.Response:
         """GET /cachez: the score-cache introspection surface — aggregate +

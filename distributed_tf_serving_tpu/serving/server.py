@@ -943,6 +943,7 @@ def build_stack(
     model_base_path: str | None = None,
     cache_config=None,
     overload_config=None,
+    utilization_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -958,7 +959,12 @@ def build_stack(
     OverloadConfig) arms the adaptive overload plane: a self-tuning
     admission limit replaces the static queue_capacity_candidates bound,
     with criticality lanes, doomed-work refusal, brownout stale-serve
-    (through the score cache, when armed), and retry-after pushback."""
+    (through the score cache, when armed), and retry-after pushback.
+    utilization_config (the TOML [utilization] section, a utils.config.
+    UtilizationConfig) arms the device-utilization attribution plane:
+    an occupancy ledger + gap waterfall behind GET /utilz, the
+    `utilization` block in /monitoring, dts_tpu_utilization_* Prometheus
+    series, and a per-device counter track in the Chrome export."""
     # Validate the multi-model config (and its exclusivity) BEFORE any
     # threads exist — a typo'd file must leave nothing to tear down.
     model_configs = None
@@ -997,6 +1003,22 @@ def build_stack(
             cache_config.max_entries, cache_config.max_bytes,
             cache_config.ttl_s, cache_config.coalesce, cache_config.dedup,
         )
+    utilization_ledger = (
+        utilization_config.build() if utilization_config is not None else None
+    )
+    if utilization_ledger is not None:
+        # Name the ledger's track after the real device (jax is already
+        # initialized by this point on every build_stack path).
+        try:
+            utilization_ledger.device = str(jax.devices()[0])
+        except Exception:  # noqa: BLE001 — a label, never a dependency
+            pass
+        log.info(
+            "utilization attribution on: ring=%d window_s=%.1f "
+            "calibrated=%s — GET /utilz on the REST surface",
+            utilization_config.ring, utilization_config.window_seconds,
+            bool(utilization_config.calibration_file),
+        )
     overload_ctrl = (
         overload_config.build() if overload_config is not None else None
     )
@@ -1031,6 +1053,7 @@ def build_stack(
             if cache_config is not None else False
         ),
         overload=overload_ctrl,
+        utilization=utilization_ledger,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
     # Health gating: the grpc.health.v1 servicer reports the overall server
@@ -1215,6 +1238,16 @@ def serve(argv=None) -> None:
         "section carries the target/limit/brownout/stale knobs",
     )
     parser.add_argument(
+        "--utilization", action="store_true", default=None,
+        help="device-utilization attribution (serving/utilization.py): "
+        "occupancy ledger + idle-gap waterfall (GET /utilz on the REST "
+        "surface, `utilization` block in /monitoring, "
+        "dts_tpu_utilization_* Prometheus series, Perfetto counter "
+        "track) with a live achieved_fraction_of_device_limit estimate. "
+        "Equivalent to [utilization] enabled=true; the [utilization] "
+        "section carries the ring/window/calibration knobs",
+    )
+    parser.add_argument(
         "--batching-parameters-file", dest="batching_parameters_file",
         help="tensorflow_model_server-format batching config (text-format "
         "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
@@ -1260,7 +1293,12 @@ def serve(argv=None) -> None:
     )
     args = parser.parse_args(argv)
 
-    from ..utils.config import CacheConfig, ObservabilityConfig, OverloadConfig
+    from ..utils.config import (
+        CacheConfig,
+        ObservabilityConfig,
+        OverloadConfig,
+        UtilizationConfig,
+    )
 
     cfgs = load_config(args.config) if args.config else {"server": ServerConfig()}
     cfg = cfgs["server"]
@@ -1273,6 +1311,11 @@ def serve(argv=None) -> None:
     overload_config = cfgs.get("overload") or OverloadConfig()
     if args.overload:
         overload_config = dataclasses.replace(overload_config, enabled=True)
+    utilization_config = cfgs.get("utilization") or UtilizationConfig()
+    if args.utilization:
+        utilization_config = dataclasses.replace(
+            utilization_config, enabled=True
+        )
     model_config = cfgs.get("model")
     if model_config is not None:
         # Explicit CLI architecture flags win over the TOML [model] section
@@ -1327,6 +1370,7 @@ def serve(argv=None) -> None:
         model_base_path=args.model_base_path,
         cache_config=cache_config,
         overload_config=overload_config,
+        utilization_config=utilization_config,
     )
     # ONE teardown path for every exit: SIGTERM, REST-startup failure, and
     # normal termination all drain through this (admissions refused, queued

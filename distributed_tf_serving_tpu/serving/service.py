@@ -130,6 +130,15 @@ class PredictionServiceImpl:
         ctrl = getattr(self.batcher, "overload", None)
         return ctrl.snapshot() if ctrl is not None else None
 
+    def utilization_stats(self, window_s: float | None = None) -> dict | None:
+        """Utilization-plane snapshot (occupancy ledger + gap waterfall +
+        live achieved_fraction_of_device_limit) — the body of GET /utilz,
+        the `utilization` block in /monitoring, and the
+        dts_tpu_utilization_* Prometheus series. None when no ledger is
+        armed ([utilization] enabled=false)."""
+        ledger = getattr(self.batcher, "utilization", None)
+        return ledger.snapshot(window_s) if ledger is not None else None
+
     def _refuse_if_draining(self) -> None:
         """Drain-aware admission gate: once shutdown started, new
         inference work is refused (UNAVAILABLE, so fan-out clients reroute

@@ -296,6 +296,58 @@ class OverloadConfig:
         return AdmissionController(self)
 
 
+@dataclasses.dataclass(frozen=True)
+class UtilizationConfig:
+    """Utilization-attribution knobs (serving/utilization.py): the
+    per-device occupancy ledger + gap waterfall behind GET /utilz, the
+    `utilization` block in /monitoring, the dts_tpu_utilization_*
+    Prometheus series, and the Perfetto counter track in the Chrome
+    export. Off by default; when off every batcher hook is one attribute
+    read (the tracing/cache/overload precedent)."""
+
+    # Master switch: build an OccupancyLedger and hand it to the batcher.
+    enabled: bool = False
+    # Ring bound for retained batch intervals / idle gaps / wait records
+    # (the windowed waterfall's memory + lookback bound).
+    ring: int = 4096
+    # Default waterfall window for /utilz and /monitoring.
+    window_seconds: float = 60.0
+    # Optional per-bucket pure-device-step table (us) calibrating the
+    # live achieved_fraction_of_device_limit estimate — the bench's
+    # artifacts/device_envelope.json format ({bucket: us} or
+    # {bucket: [lo, hi]}). "" = uncalibrated (busy-fraction fallback,
+    # labeled as such in the waterfall).
+    calibration_file: str = ""
+    # Where POST /profilez/start drops capture artifacts (jax profiler
+    # trace + host_stacks.json). "" = a tempdir subfolder.
+    profile_dir: str = ""
+
+    def build(self):
+        """OccupancyLedger per this config (registered as a Chrome
+        counter-track source), or None when disabled. Applies
+        profile_dir to the process-global capture slot either way —
+        /profilez is on-demand and available regardless of the ledger."""
+        from ..serving import utilization as util_mod
+
+        if self.profile_dir:
+            util_mod.profiler_capture().base_dir = self.profile_dir
+        if not self.enabled:
+            return None
+        calibration = (
+            util_mod.load_calibration(self.calibration_file)
+            if self.calibration_file else None
+        )
+        ledger = util_mod.OccupancyLedger(
+            ring=self.ring,
+            window_s=self.window_seconds,
+            calibration=calibration,
+        )
+        from . import tracing as tracing_mod
+
+        tracing_mod.register_counter_source(ledger)
+        return ledger
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -308,6 +360,7 @@ _SECTIONS = {
     "observability": ObservabilityConfig,
     "cache": CacheConfig,
     "overload": OverloadConfig,
+    "utilization": UtilizationConfig,
 }
 
 

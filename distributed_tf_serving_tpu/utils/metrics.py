@@ -403,7 +403,9 @@ class ServerMetrics:
             }
         return out
 
-    def prometheus_text(self, batcher_stats=None, cache=None, overload=None) -> str:
+    def prometheus_text(
+        self, batcher_stats=None, cache=None, overload=None, utilization=None
+    ) -> str:
         """Prometheus exposition (text format 0.0.4) of the same data
         snapshot() serves as JSON. Metric names mirror tensorflow_model_
         server's monitoring surface (`:tensorflow:serving:request_count` /
@@ -582,6 +584,51 @@ class ServerMetrics:
                     f'{st}{{state="{esc(state)}"}} '
                     f'{1 if state == current else 0}'
                 )
+        if utilization is not None:
+            # Utilization plane (ISSUE 6): the OccupancyLedger snapshot as
+            # dts_tpu_utilization_* series — busy/achieved fractions and
+            # the pipeline-depth gauge, the windowed waterfall components
+            # (labeled), and the lifetime idle-gap attribution counters
+            # (labeled by blocking cause).
+            wf = utilization.get("waterfall") or {}
+            for metric, kind, value in (
+                ("dts_tpu_utilization_busy_fraction", "gauge",
+                 wf.get("busy_fraction", 0.0)),
+                ("dts_tpu_utilization_achieved_fraction_of_device_limit",
+                 "gauge", wf.get("achieved_fraction_of_device_limit", 0.0)),
+                ("dts_tpu_utilization_window_wall_seconds", "gauge",
+                 wf.get("wall_s", 0.0)),
+                ("dts_tpu_utilization_waterfall_sum_over_wall", "gauge",
+                 wf.get("sum_over_wall", 0.0)),
+                ("dts_tpu_utilization_in_flight", "gauge",
+                 utilization.get("in_flight", 0)),
+                ("dts_tpu_utilization_max_in_flight", "gauge",
+                 utilization.get("max_in_flight", 0)),
+                ("dts_tpu_utilization_batches_total", "counter",
+                 utilization.get("batches", 0)),
+                ("dts_tpu_utilization_busy_seconds_total", "counter",
+                 utilization.get("busy_s", 0.0)),
+                ("dts_tpu_utilization_sheds_total", "counter",
+                 utilization.get("sheds", 0)),
+            ):
+                lines.append(f"# TYPE {metric} {kind}")
+                lines.append(f"{metric} {value}")
+            comps = wf.get("components_s") or {}
+            if comps:
+                cm = "dts_tpu_utilization_component_seconds"
+                lines.append(f"# TYPE {cm} gauge")
+                for comp, secs in sorted(comps.items()):
+                    lines.append(f'{cm}{{component="{esc(comp)}"}} {secs}')
+            gaps = utilization.get("idle_gaps") or {}
+            if gaps:
+                gc = "dts_tpu_utilization_idle_gaps_total"
+                gs = "dts_tpu_utilization_idle_gap_seconds_total"
+                lines.append(f"# TYPE {gc} counter")
+                lines.append(f"# TYPE {gs} counter")
+                for cause, blk in sorted(gaps.items()):
+                    base = f'cause="{esc(cause)}"'
+                    lines.append(f'{gc}{{{base}}} {blk.get("count", 0)}')
+                    lines.append(f'{gs}{{{base}}} {blk.get("total_s", 0.0)}')
         return "\n".join(lines) + "\n"
 
 

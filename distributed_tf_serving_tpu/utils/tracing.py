@@ -47,6 +47,7 @@ import os
 import random
 import threading
 import time
+import weakref
 from collections import defaultdict, deque
 
 # --------------------------------------------------------------------------
@@ -429,6 +430,22 @@ def replay_phases(span: Span, phases: list) -> None:
 
 
 # --------------------------------------------------------------------------
+# Counter-track sources for the Chrome export (the utilization plane's
+# per-device occupancy track, ISSUE 6). Registered objects expose
+# `chrome_counter_events(t_base, pid) -> list[dict]`; a WeakSet so a
+# retired ledger (bench teardown, tests) drops out of every later export
+# without an unregister call.
+
+_COUNTER_SOURCES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_counter_source(source) -> None:
+    """Add a counter-track provider to every future chrome_trace()
+    export. Weakly held: dropping the object deregisters it."""
+    _COUNTER_SOURCES.add(source)
+
+
+# --------------------------------------------------------------------------
 # Recorder: bounded retention + tail sampling + exporters.
 
 
@@ -649,6 +666,16 @@ class TraceRecorder:
                             if k not in ("t", "message")
                         },
                     })
+        # Counter tracks (per-device occupancy from the utilization
+        # ledger): appended on their own pids AFTER the span pids, sharing
+        # t_base so the tracks align with the spans on the timeline.
+        pid_next = len(trace_pids)
+        for source in list(_COUNTER_SOURCES):
+            try:
+                events.extend(source.chrome_counter_events(t_base, pid_next))
+                pid_next += 1
+            except Exception:  # noqa: BLE001 — a sick source must not
+                pass           # poison the whole export
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",

@@ -1,0 +1,613 @@
+"""Utilization-attribution plane (serving/utilization.py, ISSUE 6):
+occupancy-ledger interval accounting and idle-gap cause attribution under
+a fake clock, the components-sum-to-wall waterfall invariant, the
+pipeline-depth gauge, calibrated achieved-fraction estimates, the Chrome
+counter track, /utilz + /profilez routes over a real REST gateway,
+batcher integration on the CPU backend, [utilization] config parsing, and
+disabled-mode inertness."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_tf_serving_tpu.serving.utilization import (
+    CaptureInProgressError,
+    HostStackSampler,
+    OccupancyLedger,
+    ProfilerCapture,
+    load_calibration,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def make_ledger(**kw):
+    clk = FakeClock()
+    return OccupancyLedger(device="fake:0", clock=clk, **kw), clk
+
+
+# ------------------------------------------------------------ ledger core
+
+
+def test_interval_accounting_and_busy_union():
+    ledger, clk = make_ledger()
+    # Two overlapping batches (pipelined): busy is the UNION, not the sum.
+    ledger.note_batch(1001.0, 1001.2, 1002.0, bucket=64, candidates=50)
+    ledger.note_batch(1001.5, 1001.7, 1003.0, bucket=64, candidates=60)
+    assert ledger.batches == 2
+    assert ledger.candidates == 110
+    assert ledger.busy_s == pytest.approx(2.0)  # 1001..1003, not 2.5
+
+
+def test_gap_attributed_to_queue_empty_wait():
+    ledger, clk = make_ledger()
+    ledger.note_batch(1000.5, 1000.6, 1001.0)
+    # Batcher idles on an empty queue 1001..1004, then a batch runs.
+    clk.t = 1001.0
+    tok = ledger.wait_begin("queue_empty")
+    clk.t = 1004.0
+    ledger.wait_end(tok)
+    ledger.note_batch(1004.0, 1004.1, 1004.5)
+    gaps = ledger.snapshot()["idle_gaps"]
+    assert gaps["queue_empty"]["count"] == 1
+    assert gaps["queue_empty"]["total_s"] == pytest.approx(3.0)
+    assert gaps["host_pack"]["count"] == 0
+
+
+def test_gap_attributed_to_readback_wait():
+    ledger, clk = make_ledger()
+    ledger.note_batch(1000.2, 1000.3, 1001.0)
+    clk.t = 1001.0
+    tok = ledger.wait_begin("readback_wait")
+    clk.t = 1002.8
+    ledger.wait_end(tok)
+    ledger.note_batch(1003.0, 1003.1, 1003.5)
+    gaps = ledger.snapshot()["idle_gaps"]
+    # 1.8s of the 2.0s gap waited on the saturated pipeline: dominant.
+    assert gaps["readback_wait"]["count"] == 1
+
+
+def test_shed_reattributes_queue_empty_to_admission_shed():
+    ledger, clk = make_ledger()
+    ledger.note_batch(1000.2, 1000.3, 1001.0)
+    clk.t = 1001.0
+    tok = ledger.wait_begin("queue_empty")
+    clk.t = 1002.0
+    ledger.note_shed()  # traffic existed — admission refused it
+    clk.t = 1003.0
+    ledger.wait_end(tok)
+    ledger.note_batch(1003.0, 1003.1, 1003.5)
+    gaps = ledger.snapshot()["idle_gaps"]
+    assert gaps["admission_shed"]["count"] == 1
+    assert gaps["queue_empty"]["count"] == 0
+    assert ledger.sheds == 1
+
+
+def test_unexplained_gap_residual_is_host_pack():
+    ledger, clk = make_ledger()
+    ledger.note_batch(1000.2, 1000.3, 1001.0)
+    # No waits recorded: the host was doing per-batch work the whole gap.
+    ledger.note_batch(1001.4, 1001.5, 1002.0)
+    gaps = ledger.snapshot()["idle_gaps"]
+    assert gaps["host_pack"]["count"] == 1
+    assert gaps["host_pack"]["total_s"] == pytest.approx(0.4)
+
+
+def test_gap_histogram_buckets():
+    ledger, clk = make_ledger()
+    ledger.note_batch(1000.1, 1000.2, 1000.3)
+    ledger.note_batch(1000.3005, 1000.301, 1000.302)   # 0.5 ms gap
+    ledger.note_batch(1000.352, 1000.353, 1000.354)    # 50 ms gap
+    ledger.note_batch(1002.354, 1002.355, 1002.356)    # 2 s gap
+    hist = ledger.snapshot()["idle_gaps"]["host_pack"]["le_ms"]
+    assert hist["1.0"] == 1
+    assert hist["100.0"] == 1
+    assert hist["+Inf"] == 1
+
+
+def test_waterfall_components_sum_to_wall():
+    ledger, clk = make_ledger()
+    tok = None
+    # A mixed timeline: queue-empty wait, overlapping batches, a shed
+    # storm, live idle tail — the invariant must hold regardless.
+    clk.t = 1001.0
+    tok = ledger.wait_begin("queue_empty")
+    clk.t = 1003.0
+    ledger.wait_end(tok)
+    ledger.note_batch(1003.0, 1003.4, 1004.0, bucket=1024,
+                      candidates=1000, d2h_wait_s=0.2)
+    ledger.note_batch(1003.8, 1003.9, 1005.0, bucket=1024,
+                      candidates=800, d2h_wait_s=0.3)
+    clk.t = 1005.5
+    ledger.note_shed()
+    clk.t = 1007.0
+    wf = ledger.waterfall(window_s=60.0)
+    assert wf["sum_s"] == pytest.approx(wf["wall_s"], rel=1e-9)
+    assert wf["sum_over_wall"] == pytest.approx(1.0)
+    comps = wf["components_s"]
+    # Busy union 1003..1005 = 2s split across device/h2d/d2h.
+    assert comps["device"] + comps["h2d_dispatch"] + comps["d2h"] == \
+        pytest.approx(2.0)
+    assert comps["d2h"] == pytest.approx(0.5)
+    assert comps["idle_queue_empty"] == pytest.approx(2.0)
+    assert all(v >= 0 for v in comps.values())
+
+
+def test_windowed_waterfall_clamps_old_intervals():
+    ledger, clk = make_ledger()
+    ledger.note_batch(1001.0, 1001.1, 1002.0)
+    clk.t = 1100.0
+    ledger.note_batch(1098.0, 1098.1, 1099.0)
+    wf = ledger.waterfall(window_s=10.0)
+    # Only the recent batch is inside the 10s window.
+    assert wf["batches"] == 1
+    assert wf["wall_s"] == pytest.approx(10.0)
+    assert wf["components_s"]["device"] + \
+        wf["components_s"]["h2d_dispatch"] + \
+        wf["components_s"]["d2h"] == pytest.approx(1.0)
+    assert wf["sum_s"] == pytest.approx(wf["wall_s"])
+
+
+def test_idle_tail_before_first_batch_is_other_not_host_pack():
+    # Review finding: an armed ledger with ZERO completed batches (still
+    # warming/compiling) must not report 30s of "host_pack" — startup is
+    # `other` until the first batch lands, matching note_batch's
+    # exemption; recorded waits still attribute their share.
+    ledger, clk = make_ledger()
+    clk.t = 1030.0
+    wf = ledger.waterfall(window_s=60.0)
+    assert wf["components_s"]["idle_host_pack"] == 0.0
+    assert wf["components_s"]["other"] == pytest.approx(30.0)
+    assert wf["sum_s"] == pytest.approx(wf["wall_s"])
+    # ...but a live open queue_empty wait still attributes the tail.
+    ledger.wait_begin("queue_empty")
+    clk.t = 1040.0
+    wf2 = ledger.waterfall(window_s=60.0)
+    assert wf2["components_s"]["idle_queue_empty"] == pytest.approx(10.0)
+    assert wf2["components_s"]["idle_host_pack"] == 0.0
+
+
+def test_in_flight_tail_is_not_host_pack():
+    # A batch executing RIGHT NOW (depth > 0, completion not yet
+    # recorded) is busy-in-waiting, not host work: the tail residual
+    # stays `other` until the completion records it as busy.
+    ledger, clk = make_ledger()
+    ledger.note_batch(1000.2, 1000.3, 1001.0)
+    ledger.depth_inc()
+    clk.t = 1003.0
+    wf = ledger.waterfall(window_s=60.0)
+    assert wf["components_s"]["idle_host_pack"] == 0.0
+    assert wf["components_s"]["other"] == pytest.approx(2.2)  # 1000..1000.2 + 1001..1003
+    ledger.depth_dec()
+    wf2 = ledger.waterfall(window_s=60.0)
+    assert wf2["components_s"]["idle_host_pack"] == pytest.approx(2.0)
+
+
+def test_pipeline_depth_gauge():
+    ledger, _clk = make_ledger()
+    ledger.depth_inc()
+    ledger.depth_inc()
+    assert ledger.in_flight == 2 and ledger.max_in_flight == 2
+    ledger.depth_dec()
+    ledger.depth_dec()
+    ledger.depth_dec()  # over-dec clamps at 0, never negative
+    assert ledger.in_flight == 0 and ledger.max_in_flight == 2
+
+
+def test_calibrated_achieved_fraction():
+    ledger, clk = make_ledger()
+    ledger.set_calibration({1024: 100.0, "2048": [150.0, 250.0]})  # us
+    ledger.note_batch(1001.0, 1001.1, 1002.0, bucket=1024, candidates=1000)
+    ledger.note_batch(1002.0, 1002.1, 1003.0, bucket=2048, candidates=2000)
+    clk.t = 1010.0
+    wf = ledger.waterfall(window_s=10.0)
+    # (100us + midpoint 200us) / 10s wall = 3e-5.
+    assert wf["calibration"] == "device_step_table"
+    assert wf["achieved_fraction_of_device_limit"] == pytest.approx(3e-5)
+    # Uncalibrated falls back to busy fraction, labeled.
+    ledger.set_calibration({})
+    wf2 = ledger.waterfall(window_s=10.0)
+    assert wf2["calibration"] == "busy_fraction"
+    assert wf2["achieved_fraction_of_device_limit"] == \
+        pytest.approx(wf2["busy_fraction"])
+
+
+def test_load_calibration_formats(tmp_path):
+    p = tmp_path / "env.json"
+    p.write_text(json.dumps(
+        {"device_step_us": {"1024": [10.0, 30.0], "2048": 50.0, "4096": 0.0}}
+    ))
+    # Zero-step entries are skipped by BOTH install paths (shared
+    # normalizer — review finding: the two copies disagreed on zeros).
+    assert load_calibration(str(p)) == {1024: 20.0, 2048: 50.0}
+    assert load_calibration(str(tmp_path / "missing.json")) == {}
+    ledger, _clk = make_ledger()
+    ledger.set_calibration({"1024": [10.0, 30.0], "2048": 50.0, "4096": 0.0})
+    assert ledger._calibration == {1024: 20.0, 2048: 50.0}
+
+
+def test_chrome_counter_events_monotonic_and_named():
+    ledger, clk = make_ledger()
+    ledger.note_batch(1001.0, 1001.1, 1003.0)
+    ledger.note_batch(1002.0, 1002.1, 1004.0)
+    events = ledger.chrome_counter_events(t_base=1000.0, pid=9)
+    meta = [e for e in events if e["ph"] == "M"]
+    counters = [e for e in events if e["ph"] == "C"]
+    assert any(
+        e["name"] == "thread_name" and e["args"]["name"] == "fake:0"
+        for e in meta
+    )
+    assert len(counters) == 4
+    ts = [e["ts"] for e in counters]
+    assert ts == sorted(ts)
+    assert all(isinstance(t, int) and t >= 0 for t in ts)
+    # Depth steps 1, 2, 1, 0 across the two overlapping batches.
+    assert [e["args"]["in_flight"] for e in counters] == [1, 2, 1, 0]
+
+
+def test_chrome_trace_export_carries_counter_track():
+    from distributed_tf_serving_tpu.utils import tracing
+
+    ledger, _clk = make_ledger()
+    ledger.note_batch(1001.0, 1001.1, 1002.0)
+    tracing.enable(buffer_size=8, sample_rate=1.0, seed=0)
+    try:
+        tracing.register_counter_source(ledger)
+        with tracing.start_root("server.Test"):
+            pass
+        doc = tracing.recorder().chrome_trace()
+    finally:
+        tracing.disable()
+    counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert counters, "export must carry the occupancy counter track"
+    names = {
+        (e["pid"], e["tid"]): e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    for c in counters:
+        assert names.get((c["pid"], c["tid"])) == "fake:0"
+
+
+# ------------------------------------------------- deep capture (host side)
+
+
+def test_host_stack_sampler_sees_threads():
+    import threading
+
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            time.sleep(0.001)
+
+    t = threading.Thread(target=busy, name="util-test-worker", daemon=True)
+    t.start()
+    sampler = HostStackSampler(interval_s=0.005).start()
+    time.sleep(0.1)
+    report = sampler.stop()
+    stop.set()
+    t.join()
+    assert report["samples"] > 0
+    assert "util-test-worker" in report["threads"]
+    top = report["threads"]["util-test-worker"][0]
+    assert top["count"] > 0 and "busy" in top["stack"]
+
+
+def test_profiler_capture_refuses_concurrent_and_writes_host_stacks(tmp_path):
+    started, stopped = [], []
+    cap = ProfilerCapture(
+        base_dir=str(tmp_path),
+        device_start=lambda d: started.append(d),
+        device_stop=lambda: stopped.append(True),
+    )
+    info = cap.start(seconds=0.1)
+    assert info["device_trace"] is True and started
+    assert cap.status()["active"] is True
+    with pytest.raises(CaptureInProgressError):
+        cap.start(seconds=0.1)
+    deadline = time.time() + 5
+    while cap.status()["active"] and time.time() < deadline:
+        time.sleep(0.02)
+    assert cap.status()["active"] is False
+    assert stopped
+    with open(info["host_stacks"]) as f:
+        report = json.load(f)
+    assert report["samples"] >= 1
+
+
+def test_profiler_capture_device_failure_still_captures_host(tmp_path):
+    def boom(_dir):
+        raise RuntimeError("no profiler in this build")
+
+    cap = ProfilerCapture(base_dir=str(tmp_path), device_start=boom)
+    info = cap.start(seconds=0.05)
+    assert info["device_trace"] is False
+    assert "no profiler" in info["device_trace_error"]
+    deadline = time.time() + 5
+    while cap.status()["active"] and time.time() < deadline:
+        time.sleep(0.02)
+    with open(info["host_stacks"]) as f:
+        assert json.load(f)["samples"] >= 1
+
+
+# ----------------------------------------------- batcher + REST integration
+
+
+F = 6
+VOCAB = 1 << 10
+
+
+def _stack(utilization=None):
+    from distributed_tf_serving_tpu.models import (
+        ModelConfig,
+        Servable,
+        ServableRegistry,
+        build_model,
+        ctr_signatures,
+    )
+    from distributed_tf_serving_tpu.serving import (
+        DynamicBatcher,
+        PredictionServiceImpl,
+    )
+
+    cfg = ModelConfig(
+        name="DCN", num_fields=F, vocab_size=VOCAB, embed_dim=4,
+        mlp_dims=(8,), num_cross_layers=1, cross_full_matrix=True,
+    )
+    model = build_model("dcn_v2", cfg)
+    sv = Servable(
+        name="DCN", version=1, model=model,
+        params=jax.jit(model.init)(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(F),
+    )
+    registry = ServableRegistry()
+    registry.load(sv)
+    batcher = DynamicBatcher(
+        buckets=(16, 32), max_wait_us=0, utilization=utilization
+    ).start()
+    return PredictionServiceImpl(registry, batcher), sv, batcher
+
+
+def _payload(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, VOCAB, size=(n, F)).astype(np.int64),
+        "feat_wts": rng.rand(n, F).astype(np.float32),
+    }
+
+
+def test_batcher_feeds_ledger_end_to_end():
+    ledger = OccupancyLedger(device="cpu:0")
+    impl, sv, batcher = _stack(utilization=ledger)
+    try:
+        for i in range(4):
+            batcher.submit(sv, _payload(seed=i)).result(timeout=60)
+        assert ledger.batches >= 4
+        assert ledger.busy_s > 0
+        assert ledger.in_flight == 0          # inc/dec stayed paired
+        assert ledger.max_in_flight >= 1
+        wf = ledger.waterfall(window_s=60.0)
+        assert wf["sum_s"] == pytest.approx(wf["wall_s"], rel=0.02)
+        assert 0 < wf["achieved_fraction_of_device_limit"] <= 1.0
+        assert impl.utilization_stats()["enabled"] is True
+    finally:
+        batcher.stop()
+
+
+def test_warmup_batches_do_not_count_as_occupancy():
+    ledger = OccupancyLedger(device="cpu:0")
+    impl, sv, batcher = _stack(utilization=ledger)
+    try:
+        batcher.warmup_via_queue(sv, buckets=(16,))
+        assert ledger.batches == 0  # compile time is not device occupancy
+    finally:
+        batcher.stop()
+
+
+def test_shed_hook_fires_on_queue_overload():
+    from distributed_tf_serving_tpu.serving import QueueOverloadError
+
+    ledger = OccupancyLedger(device="cpu:0")
+    impl, sv, batcher = _stack(utilization=ledger)
+    try:
+        batcher.queue_capacity_candidates = 32
+        with batcher._cv:
+            batcher._queued_candidates = 32  # simulate a full queue
+        with pytest.raises(QueueOverloadError):
+            batcher.submit(sv, _payload(n=8))
+        with batcher._cv:
+            batcher._queued_candidates = 0
+        assert ledger.sheds == 1
+    finally:
+        batcher.stop()
+
+
+def test_disabled_mode_is_inert():
+    impl, sv, batcher = _stack(utilization=None)
+    try:
+        batcher.submit(sv, _payload()).result(timeout=60)
+        assert impl.utilization_stats() is None
+    finally:
+        batcher.stop()
+
+
+def _run_rest(impl, handler):
+    import asyncio
+
+    aiohttp = pytest.importorskip("aiohttp")
+    from distributed_tf_serving_tpu.serving.rest import start_rest_gateway
+
+    async def go():
+        runner, port = await start_rest_gateway(impl, port=0)
+        try:
+            async with aiohttp.ClientSession(
+                f"http://127.0.0.1:{port}"
+            ) as session:
+                return await handler(session)
+        finally:
+            await runner.cleanup()
+
+    return asyncio.run(go())
+
+
+def test_utilz_route_and_monitoring_block_and_prometheus():
+    ledger = OccupancyLedger(device="cpu:0")
+    impl, sv, batcher = _stack(utilization=ledger)
+    try:
+        batcher.submit(sv, _payload()).result(timeout=60)
+
+        async def handler(session):
+            async with session.get("/utilz") as r:
+                utilz = await r.json()
+            async with session.get("/utilz?window=not-a-number") as r:
+                bad = r.status
+            async with session.get("/monitoring") as r:
+                mon = await r.json()
+            async with session.get("/monitoring/prometheus/metrics") as r:
+                prom = await r.text()
+            return utilz, bad, mon, prom
+
+        utilz, bad, mon, prom = _run_rest(impl, handler)
+        assert utilz["enabled"] is True and utilz["batches"] >= 1
+        wf = utilz["waterfall"]
+        assert abs(wf["sum_s"] - wf["wall_s"]) <= 0.02 * wf["wall_s"]
+        assert bad == 400
+        assert mon["utilization"]["batches"] >= 1
+        assert "dts_tpu_utilization_busy_fraction" in prom
+        assert 'dts_tpu_utilization_idle_gap_seconds_total{cause="queue_empty"}' in prom
+    finally:
+        batcher.stop()
+
+
+def test_utilz_route_disabled_answers_false():
+    impl, sv, batcher = _stack(utilization=None)
+    try:
+        async def handler(session):
+            async with session.get("/utilz") as r:
+                return await r.json()
+
+        assert _run_rest(impl, handler) == {"enabled": False}
+    finally:
+        batcher.stop()
+
+
+def test_profilez_routes(tmp_path, monkeypatch):
+    from distributed_tf_serving_tpu.serving import utilization as util_mod
+
+    cap = ProfilerCapture(
+        base_dir=str(tmp_path),
+        device_start=lambda d: None, device_stop=lambda: None,
+    )
+    monkeypatch.setattr(util_mod, "_CAPTURE", cap)
+    impl, sv, batcher = _stack(utilization=None)
+    try:
+        async def handler(session):
+            import asyncio
+
+            async with session.get("/profilez") as r:
+                idle = await r.json()
+            async with session.post("/profilez/start?seconds=0.2") as r:
+                first = r.status, await r.json()
+            async with session.post("/profilez/start?seconds=0.2") as r:
+                second = r.status, await r.json()
+            async with session.get("/profilez") as r:
+                active = await r.json()
+            async with session.post("/profilez/start?seconds=abc") as r:
+                bad = r.status
+            await asyncio.sleep(0.4)
+            async with session.get("/profilez") as r:
+                done = await r.json()
+            return idle, first, second, active, bad, done
+
+        idle, first, second, active, bad, done = _run_rest(impl, handler)
+        assert idle == {"active": False}
+        assert first[0] == 200 and first[1]["started"] is True
+        assert first[1]["artifact_dir"].startswith(str(tmp_path))
+        assert second[0] == 409 and "error" in second[1]
+        assert active["active"] is True
+        assert bad == 400
+        assert done["active"] is False
+    finally:
+        batcher.stop()
+
+
+# --------------------------------------------------------------- config
+
+
+def test_utilization_config_parsing(tmp_path):
+    from distributed_tf_serving_tpu.utils.config import load_config
+
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        "[utilization]\n"
+        "enabled = true\n"
+        "ring = 128\n"
+        "window_seconds = 12.5\n"
+    )
+    cfg = load_config(str(p))["utilization"]
+    assert cfg.enabled and cfg.ring == 128 and cfg.window_seconds == 12.5
+    ledger = cfg.build()
+    assert ledger is not None and ledger.window_s == 12.5
+    assert ledger._ring.maxlen == 128
+
+    p.write_text("[utilization]\nenabled = false\n")
+    assert load_config(str(p))["utilization"].build() is None
+
+    p.write_text("[utilization]\nnot_a_knob = 1\n")
+    with pytest.raises(ValueError, match="not_a_knob"):
+        load_config(str(p))
+
+
+def test_utilization_config_calibration_file(tmp_path):
+    from distributed_tf_serving_tpu.utils.config import UtilizationConfig
+
+    env = tmp_path / "envelope.json"
+    env.write_text(json.dumps({"device_step_us": {"1024": [100.0, 300.0]}}))
+    ledger = UtilizationConfig(
+        enabled=True, calibration_file=str(env)
+    ).build()
+    assert ledger._calibration == {1024: 200.0}
+
+
+def test_build_stack_utilization_master_switch():
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import (
+        ServerConfig,
+        UtilizationConfig,
+    )
+
+    cfg = ServerConfig(
+        model_kind="dcn_v2", model_name="DCN", num_fields=F,
+        buckets=(16, 32), warmup=False,
+    )
+    registry, batcher, impl, sv, mesh, watcher = build_stack(
+        cfg, utilization_config=UtilizationConfig(enabled=True)
+    )
+    try:
+        assert batcher.utilization is not None
+        batcher.submit(sv, _payload()).result(timeout=60)
+        assert batcher.utilization.batches >= 1
+    finally:
+        batcher.stop()
+    registry2, batcher2, *_rest = build_stack(
+        cfg, utilization_config=UtilizationConfig(enabled=False)
+    )
+    try:
+        assert batcher2.utilization is None
+    finally:
+        batcher2.stop()

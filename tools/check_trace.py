@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Schema validation for an exported Chrome-trace-event JSON artifact.
 
-Usage: python tools/check_trace.py PATH [--min-events N]
+Usage: python tools/check_trace.py PATH [--min-events N] [--require-counter-track]
 
 Asserts what Perfetto / chrome://tracing need to load the file — and what
 the CI smoke step (tools/ci_tier1.sh TIER1_TRACE_SMOKE=1, on a
@@ -12,7 +12,14 @@ SOAK_CHAOS=1 traced soak) promises about the tracing plane:
   non-negative, monotonicity-safe ts/dur (ts >= 0, dur >= 0, and an
   event never ends before it starts by construction);
 - at least one span event exists (the soak actually traced requests) and
-  span events carry the trace/span-id args the /tracez JSON cross-links.
+  span events carry the trace/span-id args the /tracez JSON cross-links;
+- counter ("C") events — the utilization plane's per-device occupancy
+  track — carry integer non-negative ts, NON-DECREASING within each
+  (pid, tid, name) track (Perfetto rejects time travel on counter
+  tracks), at least one numeric arg value, and a per-device track NAME:
+  every counter's (pid, tid) must have a thread_name metadata event with
+  a non-empty name (the device label). `--require-counter-track` makes
+  the track's presence mandatory (the SOAK_UTIL=1 smoke).
 
 Exits 0 on success; prints the failure and exits 1 otherwise — the CI
 step uploads the artifact on failure so the broken file is inspectable.
@@ -30,6 +37,7 @@ def fail(msg: str) -> "NoReturn":  # noqa: F821 — py3.10 typing comment only
 def main() -> None:
     argv = sys.argv[1:]
     min_events = 1
+    require_counters = False
     positional = []
     i = 0
     while i < len(argv):
@@ -42,13 +50,15 @@ def main() -> None:
             continue
         if a.startswith("--min-events="):
             min_events = int(a.split("=", 1)[1])
+        elif a == "--require-counter-track":
+            require_counters = True
         elif a.startswith("--"):
             fail(f"unknown flag {a!r}")
         else:
             positional.append(a)
         i += 1
     if not positional:
-        fail("usage: check_trace.py PATH [--min-events N]")
+        fail("usage: check_trace.py PATH [--min-events N] [--require-counter-track]")
     path = positional[0]
     try:
         with open(path) as f:
@@ -65,12 +75,19 @@ def main() -> None:
         fail(f"only {len(events)} events (< {min_events})")
 
     spans = 0
+    counters = 0
+    track_names: dict[tuple, str] = {}  # (pid, tid) -> thread_name
+    counter_last_ts: dict[tuple, int] = {}  # (pid, tid, name) -> last ts
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
             fail(f"event {i} is not an object")
         for key in ("name", "ph", "pid", "tid"):
             if key not in ev:
                 fail(f"event {i} missing {key!r}: {ev}")
+        if ev["ph"] == "M" and ev["name"] == "thread_name":
+            track_names[(ev["pid"], ev["tid"])] = (
+                (ev.get("args") or {}).get("name") or ""
+            )
         if ev["ph"] == "X":
             spans += 1
             for key in ("ts", "dur"):
@@ -84,11 +101,50 @@ def main() -> None:
             for key in ("trace_id", "span_id"):
                 if not args_blk.get(key):
                     fail(f"span event {i} ({ev['name']!r}) missing args.{key}")
+        if ev["ph"] == "C":
+            counters += 1
+            ts = ev.get("ts")
+            if not isinstance(ts, int) or ts < 0:
+                fail(
+                    f"counter event {i} ({ev['name']!r}) ts={ts!r} must be "
+                    "a non-negative integer"
+                )
+            track = (ev["pid"], ev["tid"], ev["name"])
+            if ts < counter_last_ts.get(track, 0):
+                fail(
+                    f"counter event {i} ({ev['name']!r}) ts={ts} goes "
+                    f"BACKWARD on track {track} (last "
+                    f"{counter_last_ts[track]}) — Perfetto rejects "
+                    "non-monotonic counter tracks"
+                )
+            counter_last_ts[track] = ts
+            args_blk = ev.get("args")
+            if not isinstance(args_blk, dict) or not any(
+                isinstance(v, (int, float)) for v in args_blk.values()
+            ):
+                fail(
+                    f"counter event {i} ({ev['name']!r}) needs at least "
+                    "one numeric args value"
+                )
     if spans == 0:
         fail("no complete ('X') span events — nothing was traced")
+    if counters:
+        # Per-device track names: every counter track must be labeled
+        # with its device via thread_name metadata.
+        for pid, tid, name in counter_last_ts:
+            if not track_names.get((pid, tid)):
+                fail(
+                    f"counter track {name!r} on (pid={pid}, tid={tid}) has "
+                    "no thread_name metadata (the per-device track label)"
+                )
+    if require_counters and counters == 0:
+        fail(
+            "no counter ('C') events — the device-occupancy counter track "
+            "is required (--require-counter-track)"
+        )
     print(
-        f"check_trace: OK: {len(events)} events, {spans} spans "
-        f"({path})"
+        f"check_trace: OK: {len(events)} events, {spans} spans, "
+        f"{counters} counter events ({path})"
     )
 
 

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""CI gate for the utilization-attribution smoke (ISSUE 6).
+
+Usage: python tools/check_util_smoke.py SOAK_LINE_JSON
+
+Reads the JSON line a SOAK_UTIL=1 soak printed (tools/ci_tier1.sh tees it
+to a file) and asserts what the plane promises:
+
+- the `utilization` block exists and the ledger saw NONZERO device-busy
+  intervals (batches > 0, busy_s > 0) — the hooks actually fed it;
+- the gap waterfall's components sum to the window's wall time within
+  2% (the ISSUE 6 acceptance bound; the decomposition is
+  sum-preserving by construction, so a violation means an accounting
+  bug, not weather);
+- a live achieved_fraction_of_device_limit estimate is present and sane
+  (0 < f <= 1.5 — a busy-fraction estimate can exceed 1 only through an
+  accounting bug; small headroom for rounding);
+- the in-flight gauge returned to 0 (inc/dec stayed paired under load);
+- the LIVE /utilz route answered enabled=true and the Prometheus
+  endpoint served dts_tpu_utilization_* series.
+
+Exits 0 on success; prints every failure and exits 1.
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        print("usage: check_util_smoke.py SOAK_LINE_JSON", file=sys.stderr)
+        sys.exit(2)
+    path = sys.argv[1]
+    line = None
+    try:
+        with open(path) as f:
+            for raw in reversed(f.read().strip().splitlines()):
+                try:
+                    parsed = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(parsed, dict) and "utilization" in parsed:
+                    line = parsed
+                    break
+    except OSError as e:
+        print(f"check_util_smoke: FAIL: cannot read {path}: {e}", file=sys.stderr)
+        sys.exit(1)
+    if line is None:
+        print(
+            f"check_util_smoke: FAIL: no JSON line with a `utilization` "
+            f"block in {path}", file=sys.stderr,
+        )
+        sys.exit(1)
+
+    util = line.get("utilization")
+    failures = []
+    if not isinstance(util, dict):
+        failures.append("`utilization` block missing or not an object")
+        util = {}
+    wf = util.get("waterfall") or {}
+    if util.get("batches", 0) <= 0:
+        failures.append(f"no device-busy intervals (batches={util.get('batches')})")
+    if util.get("busy_s", 0.0) <= 0:
+        failures.append(f"zero busy time (busy_s={util.get('busy_s')})")
+    wall = wf.get("wall_s", 0.0)
+    total = wf.get("sum_s", -1.0)
+    if wall <= 0:
+        failures.append(f"waterfall wall_s={wall!r} not positive")
+    elif abs(total - wall) > 0.02 * wall:
+        failures.append(
+            f"waterfall components sum {total}s != wall {wall}s "
+            f"(>2% off; components={wf.get('components_s')})"
+        )
+    frac = wf.get("achieved_fraction_of_device_limit")
+    if frac is None or not (0.0 < frac <= 1.5):
+        failures.append(
+            f"achieved_fraction_of_device_limit={frac!r} missing or insane"
+        )
+    if util.get("in_flight", -1) != 0:
+        failures.append(
+            f"pipeline-depth gauge did not return to 0 "
+            f"(in_flight={util.get('in_flight')})"
+        )
+    if not util.get("utilz_enabled"):
+        failures.append("live GET /utilz did not answer enabled=true")
+    if util.get("prometheus_series", 0) <= 0:
+        failures.append("no dts_tpu_utilization_* Prometheus series served")
+
+    if failures:
+        for f_ in failures:
+            print(f"check_util_smoke: FAIL: {f_}", file=sys.stderr)
+        sys.exit(1)
+    print(
+        "check_util_smoke: OK: "
+        f"batches={util['batches']} busy_s={util['busy_s']} "
+        f"sum/wall={wf.get('sum_over_wall')} "
+        f"achieved_fraction={frac} "
+        f"prom_series={util['prometheus_series']}"
+    )
+
+
+if __name__ == "__main__":
+    main()

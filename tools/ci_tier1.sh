@@ -26,13 +26,17 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | 
 
 if [ "$rc" -eq 0 ] && [ "${TIER1_TRACE_SMOKE:-0}" = "1" ]; then
     ARTIFACT="${TIER1_TRACE_ARTIFACT:-/tmp/tier1_soak_trace.json}"
-    echo "tier1: trace smoke (SOAK_CHAOS=1, artifact $ARTIFACT)"
+    echo "tier1: trace smoke (SOAK_CHAOS=1 SOAK_UTIL=1, artifact $ARTIFACT)"
+    # SOAK_UTIL=1 rides along so the exported Chrome trace carries the
+    # per-device occupancy counter track, which check_trace.py now
+    # schema-gates (monotonic counter ts, per-device track names).
     timeout -k 10 300 env JAX_PLATFORMS=cpu \
-        SOAK_SECONDS="${TIER1_SMOKE_SECONDS:-8}" SOAK_CHAOS=1 \
+        SOAK_SECONDS="${TIER1_SMOKE_SECONDS:-8}" SOAK_CHAOS=1 SOAK_UTIL=1 \
         SOAK_GRPC_WORKERS=2 SOAK_REST_WORKERS=1 SOAK_CANDIDATES=64 \
         SOAK_TRACE_OUT="$ARTIFACT" SOAK_TRACE_SAMPLE=0.5 \
         python tools/soak.py || rc=1
-    python tools/check_trace.py "$ARTIFACT" --min-events 10 || rc=1
+    python tools/check_trace.py "$ARTIFACT" --min-events 10 \
+        --require-counter-track || rc=1
 fi
 
 # Cache smoke (TIER1_CACHE_SMOKE=1): a short SOAK_CACHE=1 skewed soak must
@@ -64,5 +68,21 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_OVERLOAD_SMOKE:-0}" = "1" ]; then
         SOAK_SECONDS="${TIER1_OVERLOAD_SECONDS:-12}" SOAK_OVERLOAD=1 \
         python tools/soak.py | tee "$OVERLOAD_LINE" || rc=1
     python tools/check_overload_smoke.py "$OVERLOAD_LINE" || rc=1
+fi
+
+# Utilization smoke (TIER1_UTIL_SMOKE=1): a short SOAK_UTIL=1 soak with
+# the occupancy ledger armed must show nonzero device-busy intervals, a
+# gap waterfall whose components sum to wall within 2%, a sane live
+# achieved_fraction_of_device_limit, the /utilz route answering, and
+# dts_tpu_utilization_* Prometheus series present
+# (tools/check_util_smoke.py) — the utilization plane's tier-1 gate.
+if [ "$rc" -eq 0 ] && [ "${TIER1_UTIL_SMOKE:-0}" = "1" ]; then
+    UTIL_LINE="${TIER1_UTIL_LINE:-/tmp/tier1_util_soak.json}"
+    echo "tier1: utilization smoke (SOAK_UTIL=1, line $UTIL_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_SMOKE_SECONDS:-8}" SOAK_UTIL=1 \
+        SOAK_GRPC_WORKERS=4 SOAK_REST_WORKERS=1 SOAK_CANDIDATES=64 \
+        python tools/soak.py | tee "$UTIL_LINE" || rc=1
+    python tools/check_util_smoke.py "$UTIL_LINE" || rc=1
 fi
 exit $rc

@@ -16,9 +16,15 @@ Design choices that make the number honest:
 - cProfile wraps the one event loop carrying client+server+grpc-python;
   the batcher thread is profiled separately via its own profiler hook.
 - os.times() deltas split Python-attributed CPU from C-core/XLA threads.
+- a HostStackSampler (serving/utilization.py — the SAME sampler the
+  on-demand POST /profilez/start capture runs) samples every thread's
+  Python stack through the run, so the per-THREAD hot stacks ride the
+  JSON line next to the cProfile totals. One implementation, two
+  surfaces: this offline harness and the live endpoint cannot drift.
 
 Outputs one JSON line: cpu_ms_per_request (the figure of merit), the
-per-thread split, and top cumulative Python costs.
+per-thread split, the sampled host_stacks block, and top cumulative
+Python costs.
 """
 
 import asyncio
@@ -165,13 +171,19 @@ def main() -> None:
         finally:
             await server.stop(0)
 
+    from distributed_tf_serving_tpu.serving.utilization import HostStackSampler
+
     request_trace.reset()
     t0_wall = time.perf_counter()
     t0 = os.times()
+    sampler = HostStackSampler(
+        interval_s=float(os.environ.get("PROF_SAMPLE_INTERVAL_S", "0.02"))
+    ).start()
     prof = cProfile.Profile()
     prof.enable()
     report = asyncio.run(drive())
     prof.disable()
+    stacks = sampler.stop()
     t1 = os.times()
     wall = time.perf_counter() - t0_wall
 
@@ -199,6 +211,17 @@ def main() -> None:
         "batcher": {
             "requests_per_batch": round(batcher.stats.mean_requests_per_batch, 2),
             "batches": batcher.stats.batches,
+        },
+        # Sampled per-thread hot stacks (top 3 per thread, by sample
+        # count): where each thread actually SPENDS its time — the
+        # attribution cProfile's single-thread view cannot give.
+        "host_stacks": {
+            "samples": stacks["samples"],
+            "interval_s": stacks["interval_s"],
+            "threads": {
+                name: entries[:3]
+                for name, entries in stacks["threads"].items()
+            },
         },
     }
     batcher.stop()

@@ -64,6 +64,20 @@ The JSON line gains `chaos` (per-site fire counts) and `resilience`
 error taxonomy, so a chaos soak PASSES when the taxonomy shows nothing
 BUT the injected codes and the stack neither leaks nor wedges.
 
+Utilization mode (SOAK_UTIL=1): the device-utilization attribution plane
+(ISSUE 6, serving/utilization.py) rides the soak — the batcher runs an
+OccupancyLedger (busy/idle timeline, idle-gap cause attribution,
+pipeline-depth gauge), and before shutdown the soak probes the LIVE
+`GET /utilz` route and the Prometheus endpoint over HTTP. The JSON line
+gains a `utilization` block — the ledger snapshot (gap waterfall whose
+components must sum to wall, live achieved_fraction_of_device_limit),
+`utilz_enabled` from the live route, and `prometheus_series` (the count
+of dts_tpu_utilization_* exposition lines) — gated in CI by
+tools/check_util_smoke.py (nonzero busy intervals, components sum to
+wall within 2%, Prometheus series present). When SOAK_TRACE_OUT is also
+set, the exported Chrome trace carries the per-device occupancy counter
+track (tools/check_trace.py --require-counter-track).
+
 Tracing (SOAK_TRACE_OUT=/path/trace.json): per-request span tracing runs
 for the whole soak (utils/tracing.py; SOAK_TRACE_SAMPLE sets the tail-
 sampling rate, default 0.05 — errors/fault-annotated/slowest-N traces are
@@ -150,6 +164,7 @@ def main() -> None:
     # miss) and cached (the hit) must be bit-identical.
     cache_mode = os.environ.get("SOAK_CACHE", "0") == "1"
     cache_skew = float(os.environ.get("SOAK_CACHE_SKEW", "1.1"))
+    util_mode = os.environ.get("SOAK_UTIL", "0") == "1"
     trace_out = os.environ.get("SOAK_TRACE_OUT", "")
     if trace_out:
         from distributed_tf_serving_tpu.utils import tracing
@@ -251,10 +266,20 @@ def main() -> None:
                 os.environ.get("SOAK_OVERLOAD_MIN_LIMIT", "1024")
             ),
         ).build()
+    ledger = None
+    if util_mode:
+        from distributed_tf_serving_tpu.serving.utilization import OccupancyLedger
+        from distributed_tf_serving_tpu.utils import tracing as tracing_mod
+
+        ledger = OccupancyLedger(device=str(jax.devices()[0]))
+        # Counter-track source: a SOAK_TRACE_OUT export then carries the
+        # per-device occupancy track next to the request spans.
+        tracing_mod.register_counter_source(ledger)
     buckets = (1024, 2048, 4096, 8192, 16384) if tpu else (1024, 2048)
     batcher = DynamicBatcher(
         buckets=buckets, max_wait_us=2000, completion_workers=12,
         score_cache=score_cache, dedup=cache_mode, overload=overload_ctrl,
+        utilization=ledger,
     ).start()
     batcher.max_batch_candidates = buckets[-1]
     for b in buckets:
@@ -471,6 +496,23 @@ def main() -> None:
 
     resilience: dict = {}
     trace_block: dict = {}
+    util_block: dict = {}
+
+    async def probe_utilz(session) -> None:
+        """Probe the LIVE utilization surfaces (the same bytes an
+        operator's curl would get): /utilz route liveness + the
+        dts_tpu_utilization_* Prometheus series count."""
+        async with session.get("/utilz") as r:
+            body = await r.json()
+            util_block["utilz_enabled"] = (
+                r.status == 200 and bool(body.get("enabled"))
+            )
+        async with session.get("/monitoring/prometheus/metrics") as r:
+            text = await r.text()
+        util_block["prometheus_series"] = sum(
+            1 for ln in text.splitlines()
+            if ln.startswith("dts_tpu_utilization_")
+        )
 
     async def export_trace(session) -> None:
         """Probe the LIVE /tracez surface (the same bytes an operator's
@@ -557,6 +599,11 @@ def main() -> None:
                         # port of its own).
                         with open(prom_out, "w") as f:
                             f.write(client.resilience_prometheus_text())
+                    if util_mode:
+                        try:
+                            await probe_utilz(session)
+                        except Exception as e:  # noqa: BLE001 — report, keep line
+                            util_block["error"] = f"{type(e).__name__}: {e}"
                     if trace_out:
                         try:
                             await export_trace(session)
@@ -669,6 +716,13 @@ def main() -> None:
             if overload_mode else None
         ),
         "trace": trace_block or None,
+        # Utilization plane (SOAK_UTIL=1): ledger snapshot (gap waterfall
+        # summing to wall + live achieved fraction) plus the live-route
+        # probes — the CI gate (tools/check_util_smoke.py) reads this.
+        "utilization": (
+            {**ledger.snapshot(window_s=wall), **util_block}
+            if util_mode else None
+        ),
         "chaos": None,
         "input_cache": (
             {
