@@ -1192,6 +1192,18 @@ def child_main() -> None:
             calibration=load_calibration(_ENVELOPE),
         )
         span_tracing_mod.register_counter_source(ledger)
+        # Quality plane (ISSUE 7, opt-in via DTS_BENCH_QUALITY=1): score-
+        # distribution sketches ride the bench windows so the report
+        # carries a `quality` block next to the perf numbers — the
+        # disabled default keeps the headline comparable across rounds
+        # (armed, the completer pays the sketch + per-request digest).
+        quality_monitor = None
+        if os.environ.get("DTS_BENCH_QUALITY", "0") == "1":
+            from distributed_tf_serving_tpu.serving.quality import (
+                QualityMonitor,
+            )
+
+            quality_monitor = QualityMonitor(window_s=600.0)
         batcher = DynamicBatcher(
             buckets=scale.buckets,
             max_wait_us=2000,
@@ -1205,6 +1217,7 @@ def child_main() -> None:
             async_readback=True,
             pipelined_dispatch=True,
             utilization=ledger,
+            quality=quality_monitor,
         ).start()
         impl = PredictionServiceImpl(registry, batcher)
         servable = Servable(
@@ -1360,6 +1373,11 @@ def child_main() -> None:
                 )
                 log("utilization", json.dumps(
                     res["utilization"]["waterfall"]))
+                if quality_monitor is not None:
+                    # Quality plane over the same headline windows: the
+                    # served-score sketch the report's `quality` block
+                    # carries (DTS_BENCH_QUALITY=1).
+                    res["quality"] = quality_monitor.snapshot()
 
                 stage = "latency_mode"
                 # VERDICT r4 task 4: MEASURE the latency operating point
@@ -1850,6 +1868,11 @@ def child_main() -> None:
             # achieved_fraction_of_device_limit estimate next to the
             # offline one above.
             "utilization": res.get("utilization"),
+            # Quality plane (ISSUE 7, DTS_BENCH_QUALITY=1): the served-
+            # score distribution sketch over the headline windows — per-
+            # (model, version) count/mean/percentiles; absent when the
+            # plane is off (the default, keeping headlines comparable).
+            "quality": res.get("quality"),
             # Output-transfer pipeline attribution (ISSUE 1): wire bytes
             # fetched vs. the full-fp32 all-outputs baseline, and the
             # fraction of the in-flight D2H window the completers never

@@ -57,6 +57,21 @@ def canonical_rows(arrays: dict[str, np.ndarray]) -> np.ndarray:
     return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
 
 
+def row_label_keys(arrays: dict[str, np.ndarray]) -> list[str]:
+    """Per-candidate JOIN KEYS for the label-feedback plane
+    (serving/quality.py): a 16-byte blake2b digest (hex) of each row's
+    canonical bytes — the same canonical_rows layout the dedup plane
+    keys row identity on, so the key a client computes over the arrays
+    it SENT equals the key the server computes over the arrays it
+    decoded. Plain blake2b (not the native hash128): both sides must
+    produce identical hex with or without the compiled host ops."""
+    rows = canonical_rows(arrays)
+    return [
+        hashlib.blake2b(rows[i].tobytes(), digest_size=16).hexdigest()
+        for i in range(rows.shape[0])
+    ]
+
+
 def features_digest(arrays: dict[str, np.ndarray]) -> bytes:
     """Stable 16-byte digest of a request's decoded feature tensors.
 

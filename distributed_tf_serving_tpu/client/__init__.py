@@ -19,7 +19,9 @@ from .client import (
     client_from_config,
     compact_payload,
     keepalive_channel_options,
+    label_keys,
     predict_sync,
+    report_label,
 )
 from .health import BackendScoreboard, ScoreboardConfig
 from .partition import (
@@ -42,6 +44,8 @@ __all__ = [
     "build_predict_request",
     "client_from_config",
     "compact_payload",
+    "label_keys",
+    "report_label",
     "predict_sync",
     "partition_bounds",
     "partition_list",

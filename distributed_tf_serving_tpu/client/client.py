@@ -1027,3 +1027,62 @@ def predict_sync(
         )
         resp = stub.Predict(req, timeout=timeout_s)
     return {k: codec.to_ndarray(v) for k, v in resp.outputs.items()}
+
+
+# ------------------------------------------------------- label feedback
+
+
+def label_keys(arrays: dict[str, np.ndarray]) -> list[str]:
+    """Per-candidate join keys for the server's label-feedback plane
+    (serving/quality.py): a hex digest of each row's canonical feature
+    bytes, computed over the EXACT arrays this client sends — the server
+    computes the same digest over the arrays it decodes, so the keys meet
+    in the middle with no id plumbing through the Predict protocol.
+    Compute over the same encoding you send (a compact_payload request
+    needs keys over the compact arrays)."""
+    from ..cache.digest import row_label_keys
+
+    return row_label_keys(arrays)
+
+
+def report_label(
+    rest_base_url: str,
+    key: str | list[str],
+    label: float | list[float],
+    ts: float | None = None,
+    timeout_s: float = 5.0,
+) -> dict:
+    """Report outcome labels to a server's label-feedback plane
+    (POST /labelz on the REST surface, serving/quality.py): the
+    client-side half of the windowed-AUC/calibration loop. `key` is a
+    per-row digest from label_keys() (or a trace id, optionally
+    `#<row>`); a key and its BINARY label (0/1 — the AUC ranks exact
+    class membership) pair positionally when lists are given. `ts` is
+    the label EVENT's epoch time, feeding the server's feedback-delay
+    telemetry (never window membership). Returns the server's
+    {"joined": n, "orphaned": m} — an orphaned label means the server
+    no longer holds (or never sampled) that key's score. Blocking,
+    stdlib-only (urllib): label feedback is an offline/batch path, not
+    the serving hot path."""
+    import json as json_mod
+    import urllib.request
+
+    keys = key if isinstance(key, (list, tuple)) else [key]
+    labels = label if isinstance(label, (list, tuple)) else [label]
+    if len(keys) != len(labels):
+        raise ValueError(
+            f"{len(keys)} keys vs {len(labels)} labels — they pair positionally"
+        )
+    items = [
+        {"id": str(k), "label": float(lb),
+         **({"ts": float(ts)} if ts is not None else {})}
+        for k, lb in zip(keys, labels)
+    ]
+    req = urllib.request.Request(
+        rest_base_url.rstrip("/") + "/labelz",
+        data=json_mod.dumps({"labels": items}).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json_mod.loads(resp.read().decode("utf-8"))

@@ -392,6 +392,9 @@ class _WorkItem:
     # RPC): the batcher attaches queue-wait + per-phase child spans and
     # fault annotations to it from its own threads. None = untraced.
     span: "tracing.Span | None" = None
+    # Criticality lane (overload plane metadata), carried so the quality
+    # plane can label its observations per lane. None = unset.
+    criticality: str | None = None
 
 
 def _replay_group_phases(group: list["_WorkItem"], phases: list) -> None:
@@ -496,8 +499,16 @@ class DynamicBatcher:
         dedup: bool = False,
         overload=None,
         utilization=None,
+        quality=None,
     ):
         self.compress_transfer = compress_transfer
+        # Model-quality plane (serving/quality.py): a QualityMonitor fed
+        # one observe() per completed non-warmup request from _complete —
+        # scores are already in host f32 memory post-readback, so the
+        # hook costs no device work. Cache hits and brownout stale-serves
+        # never reach the completer, so only freshly computed scores are
+        # sketched. None (default) costs one attribute read per batch.
+        self.quality = quality
         # Utilization plane (serving/utilization.py): an OccupancyLedger
         # fed one interval per completed batch from the existing
         # dispatch/readback sites, plus cheap wait-interval records while
@@ -859,6 +870,7 @@ class DynamicBatcher:
                 deadline_t=(now + deadline_s) if deadline_s is not None else None,
                 warmup=_warmup,
                 span=span if tracing.enabled() else None,
+                criticality=criticality,
             )
         except BaseException:
             with self._cv:
@@ -1984,6 +1996,22 @@ class DynamicBatcher:
                 # handler finishes (and records) it.
                 _replay_group_phases(group, phases)
                 phases = None  # a set_result failure must not re-replay
+            q = self.quality  # capture: detachable mid-flight (bench A/B)
+            if q is not None and meta is None:
+                # Quality-plane feed, BEFORE the waiters unblock so a
+                # drift exemplar's `quality.drift` annotation is already
+                # on the span when the RPC handler finishes (and the tail
+                # sampler force-keeps) it. Top-k-compacted batches (meta)
+                # are excluded: topk_restore_host back-fills 0.0 off the
+                # head, so the full vector is not the model's prediction
+                # over the request — sketching it (or joining labels
+                # against the synthetic zeros) would poison the
+                # distribution, and sketching only the head would bias
+                # it high by construction.
+                try:
+                    self._observe_quality(q, group, host)
+                except Exception:  # noqa: BLE001 — the observability
+                    pass           # plane must never fail a batch
             off = 0
             for it in group:
                 sliced = {k: v[off : off + it.n] for k, v in host.items()}
@@ -2012,3 +2040,27 @@ class DynamicBatcher:
             with self._cv:
                 self._inflight.pop(batch_id, None)
                 self._cv.notify_all()
+
+    @staticmethod
+    def _observe_quality(q, group: list[_WorkItem], host: dict) -> None:
+        """Feed the quality plane one observation per non-warmup member
+        request: the model's score output sliced per item EXACTLY like
+        the result delivery below it (post-widen, post-dedup-scatter, so
+        the sketched scores are the scores clients receive). Requests
+        whose output filter dropped the score output contribute nothing
+        — there is no score to sketch."""
+        score_key = group[0].servable.model.score_output
+        scores = host.get(score_key)
+        if scores is None:
+            return
+        off = 0
+        for it in group:
+            s = scores[off : off + it.n]
+            off += it.n
+            if it.warmup:
+                continue  # compile traffic is not a prediction signal
+            q.observe(
+                it.servable.name, it.servable.version, s,
+                lane=it.criticality, span=it.span, arrays=it.arrays,
+                trace_id=it.span.trace_id if it.span is not None else None,
+            )

@@ -24,9 +24,13 @@ model server's version_labels map):
 - `GET  /v1/models/{model}/metadata` -> signature metadata (JSON).
 - `GET  /monitoring/prometheus/metrics` -> Prometheus text exposition
   (the model server's monitoring endpoint; TF-Serving metric names).
-- `GET  /monitoring` -> the metrics snapshot as JSON (rolling-window QPS
-  + windowed percentiles next to lifetime values, per-model blocks,
-  batcher gauges, phase means).
+- `GET  /monitoring[?section=NAME]` -> the metrics snapshot as JSON
+  (rolling-window QPS + windowed percentiles next to lifetime values,
+  per-model blocks, batcher gauges, phase means, one block per armed
+  plane; ?section serves a single block without building the rest).
+- `GET  /qualityz`, `POST /qualityz/snapshot`, `POST /labelz` -> the
+  model-quality plane (serving/quality.py): score sketches + drift,
+  reference pinning, label-feedback ingest.
 - `GET  /tracez[?format=chrome][&limit=N]` -> the trace plane
   (utils/tracing.py): recent + slowest retained span trees as JSON, or a
   Perfetto-loadable Chrome-trace-event export.
@@ -171,6 +175,13 @@ class RestGateway:
             web.get("/utilz", self.utilz),
             web.get("/profilez", self.profilez_status),
             web.post("/profilez/start", self.profilez_start),
+            # Model-quality plane (ISSUE 7): per-(model, version) score
+            # sketches + PSI/JS drift (vs the pinned reference and between
+            # live versions) + label-join AUC/calibration, the reference-
+            # pinning control, and the label-feedback ingest.
+            web.get("/qualityz", self.qualityz),
+            web.post("/qualityz/snapshot", self.qualityz_snapshot),
+            web.post("/labelz", self.labelz),
         ])
 
     # ------------------------------------------------------------- helpers
@@ -512,42 +523,73 @@ class RestGateway:
                 stats, cache=self.impl.cache_stats(),
                 overload=self.impl.overload_stats(),
                 utilization=self.impl.utilization_stats(),
+                quality=self.impl.quality_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
             },
         )
 
-    async def monitoring(self, request: web.Request) -> web.Response:
-        """GET /monitoring: the metrics snapshot as JSON — rolling-window
-        qps + windowed percentiles next to the lifetime values, per-model
-        blocks, batcher gauges, and the aggregate phase means."""
-        stats = getattr(self.impl.batcher, "stats", None)
-        snap = self.metrics.snapshot(stats)
-        snap["phases"] = request_trace.snapshot()
-        snap["tracing"] = {
-            "enabled": tracing.enabled(),
-            "recorded": tracing.recorder().recorded,
+    def _monitoring_builders(self) -> dict:
+        """One builder per /monitoring block, so ?section=NAME serves a
+        single block WITHOUT serializing — or even computing — the other
+        planes' snapshots (the JSON now aggregates 8+ blocks; scrapers
+        that want one should not pay for all)."""
+
+        def request_log():
+            logger = getattr(self.impl, "request_logger", None)
+            return logger.stats() if logger is not None else None
+
+        return {
+            "metrics": lambda: self.metrics.snapshot(
+                getattr(self.impl.batcher, "stats", None)
+            ),
+            "phases": request_trace.snapshot,
+            "tracing": lambda: {
+                "enabled": tracing.enabled(),
+                "recorded": tracing.recorder().recorded,
+            },
+            "cache": self.impl.cache_stats,
+            "overload": self.impl.overload_stats,
+            "utilization": self.impl.utilization_stats,
+            "quality": self.impl.quality_stats,
+            "request_log": request_log,
+            "draining": lambda: bool(getattr(self.impl, "draining", False)),
         }
-        cache = self.impl.cache_stats()
-        if cache is not None:
-            snap["cache"] = cache
-        overload = self.impl.overload_stats()
-        if overload is not None:
-            # Overload plane (ISSUE 5): adaptive limit, pressure state,
-            # queue-wait p99 vs target, shed/doomed/brownout counters.
-            snap["overload"] = overload
-        utilization = self.impl.utilization_stats()
-        if utilization is not None:
-            # Utilization plane (ISSUE 6): occupancy ledger + gap
-            # waterfall + live achieved_fraction_of_device_limit.
-            snap["utilization"] = utilization
-        snap["draining"] = bool(getattr(self.impl, "draining", False))
-        logger = getattr(self.impl, "request_logger", None)
-        if logger is not None:
+
+    async def monitoring(self, request: web.Request) -> web.Response:
+        """GET /monitoring[?section=NAME]: the metrics snapshot as JSON —
+        rolling-window qps + windowed percentiles next to the lifetime
+        values, per-model blocks, batcher gauges, the aggregate phase
+        means, and one block per armed plane (cache / overload /
+        utilization / quality / request_log). ?section=NAME returns just
+        that block (and skips building the rest server-side); a disabled
+        plane's section answers null, an unknown name is a 400."""
+        builders = self._monitoring_builders()
+        section = request.query.get("section")
+        if section is not None:
+            builder = builders.get(section)
+            if builder is None:
+                return _json_error(
+                    "INVALID_ARGUMENT",
+                    f"unknown section {section!r}; have {sorted(builders)}",
+                )
+            return web.json_response({section: builder()})
+        snap = builders["metrics"]()
+        snap["phases"] = builders["phases"]()
+        snap["tracing"] = builders["tracing"]()
+        # Armed-plane blocks only: a disabled plane is absent, so
+        # dashboards can distinguish "off" from "cold".
+        for name in ("cache", "overload", "utilization", "quality"):
+            block = builders[name]()
+            if block is not None:
+                snap[name] = block
+        snap["draining"] = builders["draining"]()
+        log_block = builders["request_log"]()
+        if log_block is not None:
             # Written/dropped accounting for the sampled PredictionLog
             # writer — a silently-shedding log queue must be visible here.
-            snap["request_log"] = logger.stats()
+            snap["request_log"] = log_block
         return web.json_response(snap)
 
     async def tracez(self, request: web.Request) -> web.Response:
@@ -609,6 +651,68 @@ class RestGateway:
         except CaptureInProgressError as e:
             return web.json_response({"error": str(e)}, status=409)
         return web.json_response({"started": True, **info})
+
+    async def qualityz(self, request: web.Request) -> web.Response:
+        """GET /qualityz[?model=NAME][&version=V]: the model-quality
+        surface — per-(model, version) score sketches (lifetime + rolling
+        window, per-lane counts), PSI/JS drift vs the pinned reference
+        and between live versions, label-join AUC/calibration, and the
+        exemplar counters. `{"enabled": false}` when no monitor is armed
+        ([quality] enabled=false), so probes need no config knowledge."""
+        version = request.query.get("version")
+        if version is not None:
+            try:
+                version = int(version)
+            except ValueError:
+                return _json_error(
+                    "INVALID_ARGUMENT", "version must be an integer"
+                )
+        stats = self.impl.quality_stats(
+            model=request.query.get("model") or None, version=version
+        )
+        return web.json_response(
+            stats if stats is not None else {"enabled": False}
+        )
+
+    async def qualityz_snapshot(self, request: web.Request) -> web.Response:
+        """POST /qualityz/snapshot: pin the current windowed score
+        distributions as the drift reference (and persist the artifact —
+        [quality] reference_file, default artifacts/quality_reference
+        .json). Future windows drift AGAINST this pin until the next."""
+        try:
+            pinned = self.impl.quality_pin_reference()
+        except ServiceError as e:
+            return _json_error(e.code, str(e))
+        return web.json_response({"pinned": True, **pinned})
+
+    async def labelz(self, request: web.Request) -> web.Response:
+        """POST /labelz: the label-feedback ingest. Body: one label
+        object `{"id": ..., "label": 0|1, "ts": ...?}` or
+        `{"labels": [...]}`; `id` is a request trace id (optionally
+        `#<row>`) or a per-row feature digest (client.label_keys /
+        quality.row_label_keys). Answers joined/orphaned counts for this
+        call — an orphaned label (unknown or evicted key) is reported,
+        never silently dropped."""
+        try:
+            body = await request.json()
+        except Exception as e:  # noqa: BLE001 — malformed JSON is a 400
+            return _json_error("INVALID_ARGUMENT", f"invalid JSON body: {e}")
+        if isinstance(body, dict) and "labels" in body:
+            items = body["labels"]
+        elif isinstance(body, dict):
+            items = [body]
+        else:
+            items = body
+        if not isinstance(items, list) or not items:
+            return _json_error(
+                "INVALID_ARGUMENT",
+                'body must be a label object, a list, or {"labels": [...]}',
+            )
+        try:
+            result = self.impl.quality_ingest_labels(items)
+        except ServiceError as e:
+            return _json_error(e.code, str(e))
+        return web.json_response(result)
 
     async def cachez(self, request: web.Request) -> web.Response:
         """GET /cachez: the score-cache introspection surface — aggregate +

@@ -645,6 +645,29 @@ def _replay_warmup(warmup_file, servable, batcher) -> int:
     return replay_warmup_file(warmup_file, servable, batcher)
 
 
+def _servable_change_hook(score_cache, quality):
+    """ONE on_servable_change callable for the version watchers, fanning
+    out to every armed plane that cares about registry mutations: the
+    cache plane's generation invalidation (by model name) and the quality
+    plane's version-change accounting. None when nothing is armed, so the
+    watcher keeps its no-hook fast path."""
+    hooks = []
+    if score_cache is not None:
+        hooks.append(score_cache.invalidate_model)
+    if quality is not None:
+        hooks.append(quality.note_servable_change)
+    if not hooks:
+        return None
+    if len(hooks) == 1:
+        return hooks[0]
+
+    def hook(model_name: str) -> None:
+        for h in hooks:
+            h(model_name)
+
+    return hook
+
+
 class ModelLifecycle:
     """The model LIST as a runtime-reconcilable object (--model-config-file
     deployments): one version watcher per served model, plus `apply()` —
@@ -700,6 +723,7 @@ class ModelLifecycle:
 
         cfg, batcher = self._cfg, self._batcher
         score_cache = getattr(batcher, "score_cache", None)
+        quality = getattr(batcher, "quality", None)
         kind = mc.model_platform or cfg.model_kind
         if kind == "tensorflow":  # upstream's only platform string
             kind = cfg.model_kind
@@ -724,10 +748,10 @@ class ModelLifecycle:
             mesh=self._mesh,
             tensor_parallel=cfg.tensor_parallel,
             # Version swaps drop the swapped model's cached scores the
-            # moment the registry flips (cache-plane generation hook).
-            on_servable_change=(
-                score_cache.invalidate_model if score_cache is not None else None
-            ),
+            # moment the registry flips (cache-plane generation hook) and
+            # tick the quality plane's version-change counter (ISSUE 7 —
+            # version-pair drift reads the per-version sketches directly).
+            on_servable_change=_servable_change_hook(score_cache, quality),
         ).start()
 
     @staticmethod
@@ -944,6 +968,7 @@ def build_stack(
     cache_config=None,
     overload_config=None,
     utilization_config=None,
+    quality_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -964,7 +989,14 @@ def build_stack(
     UtilizationConfig) arms the device-utilization attribution plane:
     an occupancy ledger + gap waterfall behind GET /utilz, the
     `utilization` block in /monitoring, dts_tpu_utilization_* Prometheus
-    series, and a per-device counter track in the Chrome export."""
+    series, and a per-device counter track in the Chrome export.
+    quality_config (the TOML [quality] section, a utils.config.
+    QualityConfig) arms the model-quality plane: per-(model, version)
+    score-distribution sketches fed from the batcher completer, PSI/JS
+    drift vs a pinned reference and between live versions, the /labelz
+    label-feedback join (windowed AUC + calibration), drift-linked trace
+    exemplars, GET /qualityz, a `quality` block in /monitoring, and
+    dts_tpu_quality_* Prometheus series."""
     # Validate the multi-model config (and its exclusivity) BEFORE any
     # threads exist — a typo'd file must leave nothing to tear down.
     model_configs = None
@@ -1019,6 +1051,18 @@ def build_stack(
             utilization_config.ring, utilization_config.window_seconds,
             bool(utilization_config.calibration_file),
         )
+    quality_monitor = (
+        quality_config.build() if quality_config is not None else None
+    )
+    if quality_monitor is not None:
+        log.info(
+            "model-quality observability on: bins=%d window_s=%.1f "
+            "drift_threshold_psi=%.2f reference_file=%s — GET /qualityz "
+            "and POST /labelz on the REST surface",
+            quality_config.bins, quality_config.window_seconds,
+            quality_config.drift_threshold_psi,
+            quality_config.reference_file or "<none>",
+        )
     overload_ctrl = (
         overload_config.build() if overload_config is not None else None
     )
@@ -1054,6 +1098,7 @@ def build_stack(
         ),
         overload=overload_ctrl,
         utilization=utilization_ledger,
+        quality=quality_monitor,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
     # Health gating: the grpc.health.v1 servicer reports the overall server
@@ -1115,8 +1160,8 @@ def build_stack(
             or ModelConfig(name=cfg.model_name, num_fields=cfg.num_fields),
             mesh=mesh,
             tensor_parallel=cfg.tensor_parallel,
-            on_servable_change=(
-                score_cache.invalidate_model if score_cache is not None else None
+            on_servable_change=_servable_change_hook(
+                score_cache, quality_monitor
             ),
         ).start()
         # Label-only reloads may re-state this source verbatim (deploy
@@ -1248,6 +1293,18 @@ def serve(argv=None) -> None:
         "section carries the ring/window/calibration knobs",
     )
     parser.add_argument(
+        "--quality", action="store_true", default=None,
+        help="model-quality observability (serving/quality.py): "
+        "per-(model, version) score-distribution sketches fed from the "
+        "batcher completer, PSI/JS drift vs a pinned reference "
+        "(POST /qualityz/snapshot) and between live versions, label "
+        "feedback via POST /labelz (windowed AUC + calibration), and "
+        "drift-linked /tracez exemplars (GET /qualityz, `quality` block "
+        "in /monitoring, dts_tpu_quality_* Prometheus series). "
+        "Equivalent to [quality] enabled=true; the [quality] section "
+        "carries the bins/window/drift/label knobs",
+    )
+    parser.add_argument(
         "--batching-parameters-file", dest="batching_parameters_file",
         help="tensorflow_model_server-format batching config (text-format "
         "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
@@ -1297,6 +1354,7 @@ def serve(argv=None) -> None:
         CacheConfig,
         ObservabilityConfig,
         OverloadConfig,
+        QualityConfig,
         UtilizationConfig,
     )
 
@@ -1316,6 +1374,9 @@ def serve(argv=None) -> None:
         utilization_config = dataclasses.replace(
             utilization_config, enabled=True
         )
+    quality_config = cfgs.get("quality") or QualityConfig()
+    if args.quality:
+        quality_config = dataclasses.replace(quality_config, enabled=True)
     model_config = cfgs.get("model")
     if model_config is not None:
         # Explicit CLI architecture flags win over the TOML [model] section
@@ -1371,6 +1432,7 @@ def serve(argv=None) -> None:
         cache_config=cache_config,
         overload_config=overload_config,
         utilization_config=utilization_config,
+        quality_config=quality_config,
     )
     # ONE teardown path for every exit: SIGTERM, REST-startup failure, and
     # normal termination all drain through this (admissions refused, queued

@@ -139,6 +139,47 @@ class PredictionServiceImpl:
         ledger = getattr(self.batcher, "utilization", None)
         return ledger.snapshot(window_s) if ledger is not None else None
 
+    def quality_stats(
+        self, model: str | None = None, version: int | None = None
+    ) -> dict | None:
+        """Quality-plane snapshot (per-(model, version) score sketches,
+        PSI/JS drift vs reference and between live versions, label-join
+        AUC/calibration, exemplar counters) — the body of GET /qualityz,
+        the `quality` block in /monitoring, and the dts_tpu_quality_*
+        Prometheus series. None when no monitor is armed ([quality]
+        enabled=false)."""
+        monitor = getattr(self.batcher, "quality", None)
+        if monitor is None:
+            return None
+        return monitor.snapshot(model=model, version=version)
+
+    def quality_ingest_labels(self, items) -> dict:
+        """Label-feedback ingest (POST /labelz): join (id, label, ts)
+        records onto the score reservoir. Raises FAILED_PRECONDITION when
+        the plane is off, INVALID_ARGUMENT on malformed items."""
+        monitor = getattr(self.batcher, "quality", None)
+        if monitor is None:
+            raise ServiceError(
+                "FAILED_PRECONDITION",
+                "no quality monitor is configured ([quality] enabled=false)",
+            )
+        try:
+            return monitor.ingest_labels(items)
+        except (TypeError, ValueError) as e:
+            raise ServiceError("INVALID_ARGUMENT", str(e)) from e
+
+    def quality_pin_reference(self) -> dict:
+        """Pin the current windowed score distributions as the drift
+        reference (POST /qualityz/snapshot) and persist the artifact when
+        a reference_file is configured."""
+        monitor = getattr(self.batcher, "quality", None)
+        if monitor is None:
+            raise ServiceError(
+                "FAILED_PRECONDITION",
+                "no quality monitor is configured ([quality] enabled=false)",
+            )
+        return monitor.pin_reference()
+
     def _refuse_if_draining(self) -> None:
         """Drain-aware admission gate: once shutdown started, new
         inference work is refused (UNAVAILABLE, so fan-out clients reroute

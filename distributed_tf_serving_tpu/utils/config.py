@@ -348,6 +348,74 @@ class UtilizationConfig:
         return ledger
 
 
+@dataclasses.dataclass(frozen=True)
+class QualityConfig:
+    """Model-quality observability knobs (serving/quality.py): the
+    per-(model, version) score-distribution sketches, PSI/JS drift vs a
+    pinned reference and between live versions, the /labelz label-
+    feedback join (windowed AUC + calibration), and drift-linked trace
+    exemplars. Off by default; when off the batcher completer pays one
+    attribute read per batch (the tracing/cache/overload/utilization
+    precedent)."""
+
+    # Master switch: build a QualityMonitor and hand it to the batcher.
+    enabled: bool = False
+    # Fixed-bin score histogram geometry. CTR scores are sigmoid
+    # probabilities, so [0, 1]; out-of-range scores clamp to edge bins.
+    bins: int = 50
+    range_lo: float = 0.0
+    range_hi: float = 1.0
+    # Rolling window the drift math and windowed AUC read over, and how
+    # many ring slices it is built from (granularity = window/slices).
+    window_seconds: float = 300.0
+    slices: int = 6
+    # Drift alerting: current-window PSI vs the pinned reference (or
+    # between live versions) at/above this threshold arms exemplar
+    # capture. 0.2 = the standard "moderate shift" PSI band.
+    drift_threshold_psi: float = 0.2
+    # How often the drift math runs (opportunistically from the observe
+    # path — no background thread), and how many of the next traced
+    # requests get the force-keep `quality.drift` annotation per check
+    # interval while drift stays above threshold.
+    drift_check_interval_s: float = 5.0
+    exemplar_traces: int = 8
+    # Minimum window samples (each side) before a drift number is
+    # computed — PSI on a handful of scores is noise, not signal.
+    min_drift_count: int = 50
+    # Label-feedback join bounds: score-reservoir keys retained (LRU; a
+    # label for an evicted key counts as orphaned, never silently
+    # dropped), joined (score, label) pairs retained, and the largest
+    # request (candidates) that gets per-row digest keys computed.
+    reservoir_keys: int = 8192
+    label_window: int = 8192
+    digest_rows_limit: int = 256
+    # Pinned-reference artifact: loaded at build when present, written by
+    # POST /qualityz/snapshot. "" disables persistence (pin-only).
+    reference_file: str = "artifacts/quality_reference.json"
+
+    def build(self):
+        """QualityMonitor per this config, or None when disabled."""
+        if not self.enabled:
+            return None
+        from ..serving.quality import QualityMonitor
+
+        return QualityMonitor(
+            bins=self.bins,
+            lo=self.range_lo,
+            hi=self.range_hi,
+            window_s=self.window_seconds,
+            slices=self.slices,
+            drift_threshold_psi=self.drift_threshold_psi,
+            drift_check_interval_s=self.drift_check_interval_s,
+            exemplar_traces=self.exemplar_traces,
+            min_drift_count=self.min_drift_count,
+            reservoir_keys=self.reservoir_keys,
+            label_window=self.label_window,
+            digest_rows_limit=self.digest_rows_limit,
+            reference_file=self.reference_file,
+        )
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -361,6 +429,7 @@ _SECTIONS = {
     "cache": CacheConfig,
     "overload": OverloadConfig,
     "utilization": UtilizationConfig,
+    "quality": QualityConfig,
 }
 
 

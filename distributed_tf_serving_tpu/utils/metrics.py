@@ -255,6 +255,47 @@ def escape_label_value(value) -> str:
     )
 
 
+# Curated HELP text for the families whose meaning is not readable off the
+# name; everything else derives a serviceable line from the name itself.
+# Every family in the aggregated exposition goes through _family_lines, so
+# the lint invariant (tools/check_prom.py: HELP + TYPE present per family,
+# no family declared twice) holds by construction.
+_HELP = {
+    ":tensorflow:serving:request_count":
+        "Requests per entrypoint and status (TF-Serving-compatible name)",
+    ":tensorflow:serving:request_latency":
+        "Request latency in microseconds (TF-Serving-compatible name)",
+    "dts_tpu_qps_window": "Rolling-window overall request rate",
+    "dts_tpu_quality_score":
+        "Predicted-score distribution per model and version",
+    "dts_tpu_quality_drift_psi":
+        "Population Stability Index of the windowed score distribution "
+        "vs the pinned reference (kind=reference) or the concurrently "
+        "serving previous version (kind=version_pair)",
+    "dts_tpu_quality_drift_js":
+        "Jensen-Shannon divergence (base 2) companion to the PSI series",
+    "dts_tpu_quality_auc":
+        "Windowed AUC over label-feedback (score, label) joins",
+    "dts_tpu_quality_calibration_error":
+        "Count-weighted |mean predicted - observed rate| over predicted-"
+        "probability deciles (expected calibration error)",
+}
+
+
+def _family_lines(lines: list, name: str, kind: str) -> None:
+    """Append the # HELP + # TYPE pair declaring a metric family. The ONE
+    way families enter the exposition: the Prometheus lint requires a
+    HELP and TYPE line for every family and forbids re-declaration, and
+    text-format HELP must escape backslash and line feed."""
+    text = (
+        _HELP.get(name, name.replace("_", " ").strip())
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+    )
+    lines.append(f"# HELP {name} {text}")
+    lines.append(f"# TYPE {name} {kind}")
+
+
 class ServerMetrics:
     """Per-RPC latency/outcome metrics + rolling windows, exported as one
     dict (the /monitoring analog; the reference had only a final stdout
@@ -404,7 +445,8 @@ class ServerMetrics:
         return out
 
     def prometheus_text(
-        self, batcher_stats=None, cache=None, overload=None, utilization=None
+        self, batcher_stats=None, cache=None, overload=None,
+        utilization=None, quality=None,
     ) -> str:
         """Prometheus exposition (text format 0.0.4) of the same data
         snapshot() serves as JSON. Metric names mirror tensorflow_model_
@@ -415,7 +457,8 @@ class ServerMetrics:
         framework-native and ride the dts_tpu_ prefix."""
         rc, rl = ":tensorflow:serving:request_count", ":tensorflow:serving:request_latency"
         esc = escape_label_value
-        lines = [f"# TYPE {rc} counter"]
+        lines: list[str] = []
+        _family_lines(lines, rc, "counter")
         with self._lock:
             items = sorted(self._rpcs.items())
             model_items = sorted(self._models.items())
@@ -425,7 +468,7 @@ class ServerMetrics:
                 lines.append(
                     f'{rc}{{entrypoint="{esc(name)}",status="ERROR"}} {m.errors}'
                 )
-        lines.append(f"# TYPE {rl} histogram")
+        _family_lines(lines, rl, "histogram")
         for name, m in items:
             buckets, sum_us, total = m.latency.prometheus_buckets()
             for le_us, cum in buckets:
@@ -440,15 +483,15 @@ class ServerMetrics:
         # reports (ISSUE 3).
         win_qps = "dts_tpu_request_window_qps"
         win_lat = "dts_tpu_request_window_latency_ms"
-        lines.append(f"# TYPE {win_qps} gauge")
+        _family_lines(lines, win_qps, "gauge")
         overall = 0.0
         win_snaps = [(name, m.window.snapshot()) for name, m in items]
         for name, win in win_snaps:
             overall += win["qps"]
             lines.append(f'{win_qps}{{entrypoint="{esc(name)}"}} {win["qps"]}')
-        lines.append("# TYPE dts_tpu_qps_window gauge")
+        _family_lines(lines, "dts_tpu_qps_window", "gauge")
         lines.append(f"dts_tpu_qps_window {round(overall, 2)}")
-        lines.append(f"# TYPE {win_lat} gauge")
+        _family_lines(lines, win_lat, "gauge")
         for name, win in win_snaps:
             for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
                 lines.append(
@@ -459,22 +502,28 @@ class ServerMetrics:
             mrc = "dts_tpu_model_request_count"
             mqps = "dts_tpu_model_window_qps"
             mlat = "dts_tpu_model_window_latency_ms"
-            lines.append(f"# TYPE {mrc} counter")
+            _family_lines(lines, mrc, "counter")
             for (name, model), m in model_items:
                 base = f'entrypoint="{esc(name)}",model_name="{esc(model)}"'
                 lines.append(f'{mrc}{{{base},status="OK"}} {m.ok}')
                 if m.errors:
                     lines.append(f'{mrc}{{{base},status="ERROR"}} {m.errors}')
-            lines.append(f"# TYPE {mqps} gauge")
-            lines.append(f"# TYPE {mlat} gauge")
+            # Families stay GROUPED (declaration followed by all of its
+            # samples): the exposition lint enforces the text-format rule
+            # that a family's lines form one contiguous block.
+            qps_lines, lat_lines = [], []
             for (name, model), m in model_items:
                 base = f'entrypoint="{esc(name)}",model_name="{esc(model)}"'
                 win = m.window.snapshot()
-                lines.append(f'{mqps}{{{base}}} {win["qps"]}')
+                qps_lines.append(f'{mqps}{{{base}}} {win["qps"]}')
                 for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
-                    lines.append(
+                    lat_lines.append(
                         f'{mlat}{{{base},quantile="{q}"}} {win[key]}'
                     )
+            _family_lines(lines, mqps, "gauge")
+            lines.extend(qps_lines)
+            _family_lines(lines, mlat, "gauge")
+            lines.extend(lat_lines)
         if batcher_stats is not None:
             for metric, kind, value in (
                 ("dts_tpu_batcher_batches_total", "counter", batcher_stats.batches),
@@ -500,7 +549,7 @@ class ServerMetrics:
                 ("dts_tpu_batcher_dedup_rows_collapsed_total", "counter",
                  getattr(batcher_stats, "dedup_rows_collapsed", 0)),
             ):
-                lines.append(f"# TYPE {metric} {kind}")
+                _family_lines(lines, metric, kind)
                 lines.append(f"{metric} {value}")
         if cache is not None:
             # Cache plane (ISSUE 4): the ScoreCache snapshot dict as
@@ -526,12 +575,12 @@ class ServerMetrics:
                 ("dts_tpu_cache_value_bytes", "gauge",
                  cache.get("value_bytes", 0)),
             ):
-                lines.append(f"# TYPE {metric} {kind}")
+                _family_lines(lines, metric, kind)
                 lines.append(f"{metric} {value}")
             models = cache.get("models") or {}
             if models:
                 mc = "dts_tpu_cache_model_events_total"
-                lines.append(f"# TYPE {mc} counter")
+                _family_lines(lines, mc, "counter")
                 for model, counters in sorted(models.items()):
                     base = f'model_name="{esc(model)}"'
                     for event in ("hits", "misses", "coalesced", "evictions"):
@@ -568,16 +617,16 @@ class ServerMetrics:
                 ("dts_tpu_overload_state_changes_total", "counter",
                  overload.get("state_changes", 0)),
             ):
-                lines.append(f"# TYPE {metric} {kind}")
+                _family_lines(lines, metric, kind)
                 lines.append(f"{metric} {value}")
             by_lane = overload.get("sheds_by_lane") or {}
             if by_lane:
                 ls = "dts_tpu_overload_lane_sheds_total"
-                lines.append(f"# TYPE {ls} counter")
+                _family_lines(lines, ls, "counter")
                 for lane, n in sorted(by_lane.items()):
                     lines.append(f'{ls}{{lane="{esc(lane)}"}} {n}')
             st = "dts_tpu_overload_pressure_state"
-            lines.append(f"# TYPE {st} gauge")
+            _family_lines(lines, st, "gauge")
             current = overload.get("state", "nominal")
             for state in ("nominal", "brownout", "shed"):
                 lines.append(
@@ -611,25 +660,149 @@ class ServerMetrics:
                 ("dts_tpu_utilization_sheds_total", "counter",
                  utilization.get("sheds", 0)),
             ):
-                lines.append(f"# TYPE {metric} {kind}")
+                _family_lines(lines, metric, kind)
                 lines.append(f"{metric} {value}")
             comps = wf.get("components_s") or {}
             if comps:
                 cm = "dts_tpu_utilization_component_seconds"
-                lines.append(f"# TYPE {cm} gauge")
+                _family_lines(lines, cm, "gauge")
                 for comp, secs in sorted(comps.items()):
                     lines.append(f'{cm}{{component="{esc(comp)}"}} {secs}')
             gaps = utilization.get("idle_gaps") or {}
             if gaps:
                 gc = "dts_tpu_utilization_idle_gaps_total"
                 gs = "dts_tpu_utilization_idle_gap_seconds_total"
-                lines.append(f"# TYPE {gc} counter")
-                lines.append(f"# TYPE {gs} counter")
+                # Grouped, not interleaved: a family's samples must form
+                # one contiguous block (the exposition lint's rule).
+                _family_lines(lines, gc, "counter")
                 for cause, blk in sorted(gaps.items()):
-                    base = f'cause="{esc(cause)}"'
-                    lines.append(f'{gc}{{{base}}} {blk.get("count", 0)}')
-                    lines.append(f'{gs}{{{base}}} {blk.get("total_s", 0.0)}')
+                    lines.append(
+                        f'{gc}{{cause="{esc(cause)}"}} {blk.get("count", 0)}'
+                    )
+                _family_lines(lines, gs, "counter")
+                for cause, blk in sorted(gaps.items()):
+                    lines.append(
+                        f'{gs}{{cause="{esc(cause)}"}} {blk.get("total_s", 0.0)}'
+                    )
+        if quality is not None:
+            lines.extend(_quality_prometheus_lines(quality))
         return "\n".join(lines) + "\n"
+
+
+def _quality_prometheus_lines(quality: dict) -> list[str]:
+    """dts_tpu_quality_* exposition from a QualityMonitor snapshot dict
+    (ISSUE 7): plane counters, label-join counters + windowed AUC /
+    calibration error, per-(model, version) score counts / means / the
+    score histogram family, and per-model drift gauges (PSI + JS, labeled
+    by kind: vs the pinned reference or between live versions). Families
+    are grouped and declared exactly once — the exposition lint's
+    invariants."""
+    esc = escape_label_value
+    lines: list[str] = []
+    exemplars = quality.get("exemplars") or {}
+    for metric, kind, value in (
+        ("dts_tpu_quality_observed_requests_total", "counter",
+         quality.get("observed_requests", 0)),
+        ("dts_tpu_quality_version_changes_total", "counter",
+         quality.get("version_changes", 0)),
+        ("dts_tpu_quality_exemplars_marked_total", "counter",
+         exemplars.get("marked", 0)),
+        ("dts_tpu_quality_drift_events_total", "counter",
+         exemplars.get("drift_events", 0)),
+    ):
+        _family_lines(lines, metric, kind)
+        lines.append(f"{metric} {value}")
+    labels_blk = quality.get("labels") or {}
+    for metric, kind, value in (
+        ("dts_tpu_quality_labels_joined_total", "counter",
+         labels_blk.get("joined", 0)),
+        ("dts_tpu_quality_labels_orphaned_total", "counter",
+         labels_blk.get("orphaned", 0)),
+        ("dts_tpu_quality_labels_late_total", "counter",
+         labels_blk.get("late", 0)),
+        ("dts_tpu_quality_label_window_pairs", "gauge",
+         labels_blk.get("window_pairs", 0)),
+    ):
+        _family_lines(lines, metric, kind)
+        lines.append(f"{metric} {value}")
+    if labels_blk.get("auc") is not None:
+        _family_lines(lines, "dts_tpu_quality_auc", "gauge")
+        lines.append(f'dts_tpu_quality_auc {labels_blk["auc"]}')
+    cal_err = (labels_blk.get("calibration") or {}).get("error")
+    if cal_err is not None:
+        _family_lines(lines, "dts_tpu_quality_calibration_error", "gauge")
+        lines.append(f"dts_tpu_quality_calibration_error {cal_err}")
+    models = quality.get("models") or {}
+    if not models:
+        return lines
+    count_lines, mean_lines, wmean_lines, hist_lines = [], [], [], []
+    for model, blk in sorted(models.items()):
+        for ver, vs in sorted(
+            (blk.get("versions") or {}).items(), key=lambda kv: int(kv[0])
+        ):
+            base = f'model_name="{esc(model)}",version="{esc(ver)}"'
+            total = vs.get("count", 0)
+            count_lines.append(f"dts_tpu_quality_scores_total{{{base}}} {total}")
+            mean_lines.append(
+                f'dts_tpu_quality_score_mean{{{base}}} {vs.get("mean", 0.0)}'
+            )
+            wmean_lines.append(
+                f"dts_tpu_quality_score_window_mean{{{base}}} "
+                f'{(vs.get("window") or {}).get("mean", 0.0)}'
+            )
+            hg = vs.get("histogram") or {}
+            counts = hg.get("counts") or []
+            lo, hi = hg.get("lo", 0.0), hg.get("hi", 1.0)
+            width = (hi - lo) / len(counts) if counts else 0.0
+            last = max((i for i, c in enumerate(counts) if c), default=-1)
+            acc = 0
+            for i in range(last + 1):
+                acc += counts[i]
+                le = lo + width * (i + 1)
+                hist_lines.append(
+                    f'dts_tpu_quality_score_bucket{{{base},le="{le:.6g}"}} {acc}'
+                )
+            hist_lines.append(
+                f'dts_tpu_quality_score_bucket{{{base},le="+Inf"}} {total}'
+            )
+            hist_lines.append(
+                f"dts_tpu_quality_score_sum{{{base}}} "
+                f'{round(vs.get("mean", 0.0) * total, 6)}'
+            )
+            hist_lines.append(f"dts_tpu_quality_score_count{{{base}}} {total}")
+    _family_lines(lines, "dts_tpu_quality_scores_total", "counter")
+    lines.extend(count_lines)
+    _family_lines(lines, "dts_tpu_quality_score_mean", "gauge")
+    lines.extend(mean_lines)
+    _family_lines(lines, "dts_tpu_quality_score_window_mean", "gauge")
+    lines.extend(wmean_lines)
+    _family_lines(lines, "dts_tpu_quality_score", "histogram")
+    lines.extend(hist_lines)
+    psi_lines, js_lines, exceeded_lines = [], [], []
+    for model, blk in sorted(models.items()):
+        drift = blk.get("drift") or {}
+        for kind_name in ("reference", "version_pair"):
+            entry = drift.get(kind_name)
+            if entry:
+                lbl = f'model_name="{esc(model)}",kind="{kind_name}"'
+                psi_lines.append(
+                    f'dts_tpu_quality_drift_psi{{{lbl}}} {entry["psi"]}'
+                )
+                js_lines.append(
+                    f'dts_tpu_quality_drift_js{{{lbl}}} {entry["js"]}'
+                )
+        exceeded_lines.append(
+            f'dts_tpu_quality_drift_exceeded{{model_name="{esc(model)}"}} '
+            f'{1 if drift.get("exceeded") else 0}'
+        )
+    if psi_lines:
+        _family_lines(lines, "dts_tpu_quality_drift_psi", "gauge")
+        lines.extend(psi_lines)
+        _family_lines(lines, "dts_tpu_quality_drift_js", "gauge")
+        lines.extend(js_lines)
+    _family_lines(lines, "dts_tpu_quality_drift_exceeded", "gauge")
+    lines.extend(exceeded_lines)
+    return lines
 
 
 def resilience_prometheus_text(resilience: dict) -> str:

@@ -1,0 +1,151 @@
+"""Prometheus exposition lint (tools/check_prom.py, ISSUE 7 satellite):
+the aggregated /monitoring/prometheus/metrics text is assembled from six
+planes and the lint is what guards the assembly — run it against a FULLY
+ARMED server snapshot (every plane emitting, adversarial label values),
+and prove it actually catches each failure mode it claims to."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"),
+)
+from check_prom import lint_text  # noqa: E402
+
+from distributed_tf_serving_tpu.utils.metrics import (  # noqa: E402
+    ServerMetrics,
+    _family_lines,
+)
+
+
+def _fully_armed_text() -> str:
+    """Every plane emitting at once — the worst-case assembly the lint
+    exists to guard: batcher gauges, cache, overload, utilization, and
+    quality series next to the TF-Serving-named families, with
+    adversarial model names exercising the escaping path."""
+    from distributed_tf_serving_tpu.cache import ScoreCache
+    from distributed_tf_serving_tpu.serving.batcher import BatcherStats
+    from distributed_tf_serving_tpu.serving.quality import QualityMonitor
+    from distributed_tf_serving_tpu.serving.utilization import OccupancyLedger
+    from distributed_tf_serving_tpu.utils.config import OverloadConfig
+
+    m = ServerMetrics()
+    m.observe("Predict", 0.01, ok=True, model='we"ird\\mo\ndel')
+    m.observe("Predict", 0.02, ok=False, model="DCN")
+    m.observe("REST.Predict", 0.03, ok=True, model="DCN")
+    stats = BatcherStats()
+    stats.batches, stats.requests = 5, 9
+    cache = ScoreCache()
+    ctrl = OverloadConfig(enabled=True).build()
+    ctrl.bind(4096, 65536)
+    ctrl.admit(5, 0, lane="sheddable")
+    ledger = OccupancyLedger()
+    quality = QualityMonitor(drift_check_interval_s=0.0, min_drift_count=10)
+    rng = np.random.RandomState(0)
+    quality.observe("DCN", 1, rng.uniform(0.2, 0.5, 200))
+    quality.pin_reference(save=False)
+    quality.observe("DCN", 2, rng.uniform(0.6, 0.9, 200))
+    quality.observe('we"ird\\mo\ndel', 1, rng.rand(20))
+    return m.prometheus_text(
+        stats,
+        cache=cache.snapshot(),
+        overload=ctrl.snapshot(),
+        utilization=ledger.snapshot(),
+        quality=quality.snapshot(),
+    )
+
+
+def test_fully_armed_snapshot_passes_lint():
+    text = _fully_armed_text()
+    assert lint_text(text) == []
+    # The assembly really did include every plane.
+    for marker in (
+        ":tensorflow:serving:request_count", "dts_tpu_batcher_",
+        "dts_tpu_cache_", "dts_tpu_overload_", "dts_tpu_utilization_",
+        "dts_tpu_quality_",
+    ):
+        assert marker in text
+
+
+def test_every_family_has_help_and_type():
+    text = _fully_armed_text()
+    helps = {
+        ln.split(" ", 3)[2] for ln in text.splitlines()
+        if ln.startswith("# HELP")
+    }
+    types = {
+        ln.split(" ", 3)[2] for ln in text.splitlines()
+        if ln.startswith("# TYPE")
+    }
+    assert helps == types and len(types) > 20
+
+
+def test_lint_catches_duplicate_family():
+    lines: list = []
+    _family_lines(lines, "dup_metric", "counter")
+    lines.append("dup_metric 1")
+    _family_lines(lines, "dup_metric", "counter")
+    errs = lint_text("\n".join(lines) + "\n")
+    assert any("declared twice" in e for e in errs)
+
+
+def test_lint_catches_missing_type_and_help():
+    errs = lint_text("orphan_metric 1\n")
+    assert any("no preceding # TYPE" in e for e in errs)
+    errs = lint_text("# TYPE helpless counter\nhelpless 1\n")
+    assert any("no # HELP" in e for e in errs)
+
+
+def test_lint_catches_duplicate_series():
+    lines: list = []
+    _family_lines(lines, "m", "gauge")
+    lines.append('m{a="x"} 1')
+    lines.append('m{a="x"} 2')
+    errs = lint_text("\n".join(lines) + "\n")
+    assert any("duplicate series" in e for e in errs)
+    # Same name, different label set: legal.
+    lines = []
+    _family_lines(lines, "m", "gauge")
+    lines.append('m{a="x"} 1')
+    lines.append('m{a="y"} 2')
+    assert lint_text("\n".join(lines) + "\n") == []
+
+
+def test_lint_catches_interleaved_families():
+    lines: list = []
+    _family_lines(lines, "a", "gauge")
+    _family_lines(lines, "b", "gauge")
+    lines += ["a 1", "b 2", "a 3"]
+    errs = lint_text("\n".join(lines) + "\n")
+    assert any("not contiguous" in e for e in errs)
+
+
+def test_lint_catches_unescaped_label_and_bad_value():
+    lines: list = []
+    _family_lines(lines, "m", "gauge")
+    lines.append('m{a="un"escaped"} 1')
+    errs = lint_text("\n".join(lines) + "\n")
+    assert errs, "unescaped quote must fail the line grammar"
+    lines = []
+    _family_lines(lines, "m", "gauge")
+    lines.append('m{a="x"} not-a-number')
+    errs = lint_text("\n".join(lines) + "\n")
+    assert any("not a number" in e for e in errs)
+
+
+def test_lint_accepts_histogram_suffixes_and_inf():
+    lines: list = []
+    _family_lines(lines, "h", "histogram")
+    lines += [
+        'h_bucket{le="1"} 1', 'h_bucket{le="+Inf"} 2', "h_sum 1.5", "h_count 2",
+    ]
+    assert lint_text("\n".join(lines) + "\n") == []
+    # The same suffixes WITHOUT a declared histogram family fail.
+    errs = lint_text('x_bucket{le="+Inf"} 2\n')
+    assert any("no preceding # TYPE" in e for e in errs)

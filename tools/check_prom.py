@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Prometheus exposition lint for the aggregated monitoring endpoint
+(ISSUE 7 satellite).
+
+`GET /monitoring/prometheus/metrics` is now assembled from five planes
+(request metrics, batcher, cache, overload, utilization) plus the quality
+plane — and nothing guarded against one plane re-declaring another's
+family name, emitting a duplicate series, or skipping the HELP/TYPE
+header. This lint holds the text-format 0.0.4 contract:
+
+- every non-comment line parses as `name{labels} value [timestamp]` with
+  a valid metric name and cleanly escaped label values (an unescaped
+  quote or raw newline breaks the line grammar and fails here);
+- every sample's FAMILY carries a `# HELP` and a `# TYPE` line declared
+  BEFORE its first sample (`_bucket`/`_sum`/`_count` suffixes resolve to
+  their declared histogram/summary family);
+- no family is declared twice — the duplicate-family-name failure mode
+  of multi-plane assembly;
+- a family's samples form ONE contiguous block (the format's grouping
+  rule; interleaved families silently break some parsers);
+- no two samples share (name, label set) — a duplicate series would be
+  last-write-wins at the scraper, hiding one plane's value;
+- every value parses as a float (+Inf/-Inf/NaN allowed).
+
+Usage: `python tools/check_prom.py FILE` (or `-` for stdin). Importable:
+`lint_text(text) -> list[str]` returns every violation. Exit 0 = clean.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_NAME})(?: (.*))?$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_RE = re.compile(rf"^({_NAME})(?:\{{(.*)\}})?\s+(\S+)(?:\s+(-?\d+))?$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
+
+# Suffixes that address a declared histogram/summary family.
+_SUFFIXES = {
+    "_bucket": ("histogram",),
+    "_sum": ("histogram", "summary"),
+    "_count": ("histogram", "summary"),
+}
+
+
+def _parse_labels(raw: str, line_no: int, errors: list[str]) -> tuple | None:
+    """Canonical (sorted) label tuple, or None on malformed labels."""
+    pos = 0
+    out = []
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        if m is None:
+            errors.append(
+                f"line {line_no}: malformed label pair at {raw[pos:pos + 40]!r} "
+                "(unescaped quote/backslash, or bad label name?)"
+            )
+            return None
+        out.append((m.group(1), m.group(2)))
+        pos = m.end()
+        if pos < len(raw):
+            if raw[pos] != ",":
+                errors.append(
+                    f"line {line_no}: expected ',' between labels, got "
+                    f"{raw[pos:pos + 10]!r}"
+                )
+                return None
+            pos += 1
+    return tuple(sorted(out))
+
+
+def _family_of(name: str, types: dict[str, str]) -> str | None:
+    """The declared family a sample name belongs to, else None."""
+    if name in types:
+        return name
+    for suffix, kinds in _SUFFIXES.items():
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if types.get(base) in kinds:
+                return base
+    return None
+
+
+def lint_text(text: str) -> list[str]:
+    errors: list[str] = []
+    helps: dict[str, int] = {}
+    types: dict[str, str] = {}
+    sampled: set[str] = set()   # families that have emitted samples
+    closed: set[str] = set()    # families whose sample block has ended
+    last_family: str | None = None
+    series: set[tuple] = set()
+    for line_no, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = _HELP_RE.match(line)
+            if m is not None:
+                name = m.group(1)
+                if name in helps:
+                    errors.append(
+                        f"line {line_no}: duplicate # HELP for family {name!r} "
+                        f"(first at line {helps[name]})"
+                    )
+                helps[name] = line_no
+                continue
+            m = _TYPE_RE.match(line)
+            if m is not None:
+                name = m.group(1)
+                if name in types:
+                    errors.append(
+                        f"line {line_no}: family {name!r} declared twice "
+                        "(duplicate # TYPE — two planes claiming one name?)"
+                    )
+                if name in sampled:
+                    errors.append(
+                        f"line {line_no}: # TYPE for {name!r} appears AFTER "
+                        "its samples"
+                    )
+                types[name] = m.group(2)
+                continue
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                errors.append(f"line {line_no}: malformed metadata line: {line!r}")
+            continue  # other comments are legal and ignored
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {line_no}: unparseable sample line: {line!r}")
+            continue
+        name, raw_labels, value = m.group(1), m.group(2), m.group(3)
+        try:
+            float(value)  # +Inf/-Inf/NaN parse fine
+        except ValueError:
+            errors.append(
+                f"line {line_no}: value {value!r} of {name!r} is not a number "
+                "(label text leaking into the value position?)"
+            )
+        labels = _parse_labels(raw_labels, line_no, errors) if raw_labels else ()
+        if labels is None:
+            continue
+        family = _family_of(name, types)
+        if family is None:
+            errors.append(
+                f"line {line_no}: sample {name!r} has no preceding # TYPE "
+                "for its family"
+            )
+            family = name  # keep grouping/duplicate checks meaningful
+        if family not in helps:
+            errors.append(
+                f"line {line_no}: family {family!r} has no # HELP line"
+            )
+            helps[family] = line_no  # report once per family
+        if family != last_family:
+            if last_family is not None:
+                closed.add(last_family)
+            if family in closed:
+                errors.append(
+                    f"line {line_no}: family {family!r} samples are not "
+                    "contiguous (block already closed earlier)"
+                )
+            last_family = family
+        sampled.add(family)
+        key = (name, labels)
+        if key in series:
+            errors.append(
+                f"line {line_no}: duplicate series {name}{{{raw_labels or ''}}} "
+                "(same name + label set emitted twice)"
+            )
+        series.add(key)
+    return errors
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        print("usage: check_prom.py FILE|-", file=sys.stderr)
+        sys.exit(2)
+    path = sys.argv[1]
+    try:
+        text = sys.stdin.read() if path == "-" else open(path).read()
+    except OSError as e:
+        print(f"check_prom: FAIL: cannot read {path}: {e}", file=sys.stderr)
+        sys.exit(1)
+    errors = lint_text(text)
+    if errors:
+        for err in errors:
+            print(f"check_prom: FAIL: {err}", file=sys.stderr)
+        sys.exit(1)
+    families = sum(1 for ln in text.splitlines() if ln.startswith("# TYPE"))
+    samples = sum(
+        1 for ln in text.splitlines() if ln.strip() and not ln.startswith("#")
+    )
+    print(f"check_prom: OK: {families} families, {samples} samples")
+
+
+if __name__ == "__main__":
+    main()

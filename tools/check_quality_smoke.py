@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""CI gate for the model-quality observability smoke (ISSUE 7).
+
+Usage: python tools/check_quality_smoke.py SOAK_LINE_JSON
+
+Reads the JSON line a SOAK_QUALITY=1 soak printed (tools/ci_tier1.sh tees
+it to a file) and asserts what the plane promises:
+
+- nonzero scores were sketched, with the warmup ladder EXCLUDED
+  (observed_after_warmup == 0 — the completer hook skipped every warmup
+  item before worker traffic began);
+- labels were joined through the LIVE /labelz route, and the windowed
+  AUC the monitor serves is (a) meaningfully above coin-flip (the soak
+  trains the model on the teacher first) and (b) within 0.05 of the
+  exact AUC the soak computed OFFLINE from its own (score, label) log
+  over the same window — train/data.py::auc both times;
+- the deliberately shifted traffic segment drove windowed PSI vs the
+  pinned reference to/above the configured threshold;
+- at least one `quality.drift` exemplar trace is visible in the LIVE
+  /tracez body (annotated spans are force-kept by the tail sampler);
+- the /monitoring?section=quality filter answered exactly one block;
+- dts_tpu_quality_* Prometheus series were served, and the captured
+  exposition text passes the lint (tools/check_prom.py) — unique
+  families, HELP/TYPE per family, escaped labels, grouped samples.
+
+Exits 0 on success; prints every failure and exits 1.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from check_prom import lint_text  # noqa: E402
+
+AUC_TOLERANCE = 0.05
+AUC_FLOOR = 0.55
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        print("usage: check_quality_smoke.py SOAK_LINE_JSON", file=sys.stderr)
+        sys.exit(2)
+    path = sys.argv[1]
+    line = None
+    try:
+        with open(path) as f:
+            for raw in reversed(f.read().strip().splitlines()):
+                try:
+                    parsed = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(parsed, dict) and "quality" in parsed:
+                    line = parsed
+                    break
+    except OSError as e:
+        print(
+            f"check_quality_smoke: FAIL: cannot read {path}: {e}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    if line is None or not isinstance(line.get("quality"), dict):
+        print(
+            f"check_quality_smoke: FAIL: no JSON line with a `quality` "
+            f"block in {path}", file=sys.stderr,
+        )
+        sys.exit(1)
+
+    q = line["quality"]
+    failures = []
+    if q.get("error"):
+        failures.append(f"probe error: {q['error']}")
+    if q.get("observed_requests", 0) <= 0:
+        failures.append(
+            f"no scores sketched (observed_requests="
+            f"{q.get('observed_requests')})"
+        )
+    if q.get("observed_after_warmup", -1) != 0:
+        failures.append(
+            "warmup traffic leaked into the sketch "
+            f"(observed_after_warmup={q.get('observed_after_warmup')})"
+        )
+    if q.get("labels_joined", 0) <= 0:
+        failures.append(f"no labels joined (joined={q.get('labels_joined')})")
+    win_auc, off_auc = q.get("windowed_auc"), q.get("offline_auc_window")
+    if win_auc is None:
+        failures.append("windowed AUC missing (no joined pairs in window?)")
+    elif win_auc <= AUC_FLOOR:
+        failures.append(
+            f"windowed AUC {win_auc} not meaningfully above coin-flip "
+            f"(floor {AUC_FLOOR}; did the pre-soak training run?)"
+        )
+    if win_auc is not None and off_auc is not None:
+        if abs(win_auc - off_auc) > AUC_TOLERANCE:
+            failures.append(
+                f"windowed AUC {win_auc} vs offline exact AUC {off_auc}: "
+                f"|delta| > {AUC_TOLERANCE} — join/reservoir bug"
+            )
+    elif off_auc is None:
+        failures.append("offline window AUC missing from the soak log")
+    drift = q.get("drift") or {}
+    ref = drift.get("reference") or {}
+    threshold = drift.get("threshold_psi", 0.2)
+    if ref.get("psi") is None:
+        failures.append(
+            "no reference drift computed (was the reference pinned? "
+            f"pin={q.get('pin')})"
+        )
+    elif ref["psi"] < threshold:
+        failures.append(
+            f"shifted segment did not drive PSI over threshold "
+            f"({ref['psi']} < {threshold})"
+        )
+    if q.get("exemplar_traces", 0) < 1:
+        failures.append(
+            "no quality.drift exemplar trace visible in the live /tracez "
+            f"body (exemplar_traces={q.get('exemplar_traces')})"
+        )
+    if not q.get("section_filter_ok"):
+        failures.append(
+            "GET /monitoring?section=quality did not answer exactly the "
+            "quality block"
+        )
+    if q.get("prom_quality_series", 0) <= 0:
+        failures.append("no dts_tpu_quality_* Prometheus series served")
+    prom_path = q.get("prom_path")
+    if not prom_path:
+        failures.append("no captured Prometheus text to lint (prom_path missing)")
+    else:
+        try:
+            with open(prom_path) as f:
+                lint_errors = lint_text(f.read())
+        except OSError as e:
+            lint_errors = [f"cannot read {prom_path}: {e}"]
+        for err in lint_errors:
+            failures.append(f"prometheus lint: {err}")
+
+    if failures:
+        for f_ in failures:
+            print(f"check_quality_smoke: FAIL: {f_}", file=sys.stderr)
+        sys.exit(1)
+    print(
+        "check_quality_smoke: OK: "
+        f"observed={q['observed_requests']} joined={q['labels_joined']} "
+        f"windowed_auc={win_auc} offline_auc={off_auc} "
+        f"psi={ref.get('psi')} exemplars={q['exemplar_traces']} "
+        f"prom_series={q['prom_quality_series']}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -85,4 +85,25 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_UTIL_SMOKE:-0}" = "1" ]; then
         python tools/soak.py | tee "$UTIL_LINE" || rc=1
     python tools/check_util_smoke.py "$UTIL_LINE" || rc=1
 fi
+
+# Quality smoke (TIER1_QUALITY_SMOKE=1): a SOAK_QUALITY=1 soak — model
+# trained on the synthetic teacher, labels reported to the live /labelz,
+# reference pinned mid-run, shifted segment after it — must sketch scores
+# with warmup excluded, join labels with the live windowed AUC within
+# 0.05 of the soak's own offline exact AUC (and above coin-flip), drive
+# PSI over threshold with >=1 quality.drift exemplar visible in /tracez,
+# and serve dts_tpu_quality_* series whose captured exposition text
+# passes tools/check_prom.py (tools/check_quality_smoke.py runs both).
+# Slightly longer than the other smokes: the run needs a steady phase, a
+# pin, and a drifted window inside one soak.
+if [ "$rc" -eq 0 ] && [ "${TIER1_QUALITY_SMOKE:-0}" = "1" ]; then
+    QUALITY_LINE="${TIER1_QUALITY_LINE:-/tmp/tier1_quality_soak.json}"
+    QUALITY_PROM="${TIER1_QUALITY_PROM:-/tmp/tier1_quality_prom.txt}"
+    echo "tier1: quality smoke (SOAK_QUALITY=1, line $QUALITY_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_QUALITY_SECONDS:-12}" SOAK_QUALITY=1 \
+        SOAK_QUALITY_PROM_OUT="$QUALITY_PROM" \
+        python tools/soak.py | tee "$QUALITY_LINE" || rc=1
+    python tools/check_quality_smoke.py "$QUALITY_LINE" || rc=1
+fi
 exit $rc

@@ -78,6 +78,28 @@ wall within 2%, Prometheus series present). When SOAK_TRACE_OUT is also
 set, the exported Chrome trace carries the per-device occupancy counter
 track (tools/check_trace.py --require-counter-track).
 
+Quality mode (SOAK_QUALITY=1): the model-quality observability plane
+(ISSUE 7, serving/quality.py) rides a purpose-built workload. The soak
+model is first TRAINED briefly on the synthetic CTR stream
+(SOAK_QUALITY_TRAIN_STEPS, default 200) so its scores carry real signal
+against the stream's known teacher logits; gRPC workers then serve
+payload pools generated from that same stream, generate each row's label
+from the teacher (Bernoulli of the teacher logit — the data-gen's own
+labeling), and report labels to the LIVE `POST /labelz` route keyed by
+per-row digests (client.label_keys). Mid-run the reference distribution
+is pinned via `POST /qualityz/snapshot` (~40%), and a deliberately
+SHIFTED traffic segment (feature weights scaled, labels regenerated from
+the teacher on the shifted rows) starts at ~55% — driving windowed PSI
+vs the pinned reference above threshold, which must force-keep
+`quality.drift` exemplar traces into /tracez. The JSON line gains a
+`quality` block — windowed AUC from the live /qualityz route next to the
+exact AUC the soak computes offline from its own (score, label) log,
+joined/orphaned counts, the drift block, the exemplar-trace count found
+in the live /tracez body, and the Prometheus text written to
+SOAK_QUALITY_PROM_OUT for the exposition lint — gated in CI by
+tools/check_quality_smoke.py (which also runs tools/check_prom.py on
+the captured text).
+
 Tracing (SOAK_TRACE_OUT=/path/trace.json): per-request span tracing runs
 for the whole soak (utils/tracing.py; SOAK_TRACE_SAMPLE sets the tail-
 sampling rate, default 0.05 — errors/fault-annotated/slowest-N traces are
@@ -165,13 +187,30 @@ def main() -> None:
     cache_mode = os.environ.get("SOAK_CACHE", "0") == "1"
     cache_skew = float(os.environ.get("SOAK_CACHE_SKEW", "1.1"))
     util_mode = os.environ.get("SOAK_UTIL", "0") == "1"
+    # Quality mode (SOAK_QUALITY=1): trained model, teacher-labeled
+    # payload pools, live /labelz feedback, a pinned reference and a
+    # shifted segment; see module docstring. Small requests (row digests
+    # are the join keys) and no REST mixer (unshifted REST traffic would
+    # dilute the drift the gate must observe) unless overridden.
+    quality_mode = os.environ.get("SOAK_QUALITY", "0") == "1"
+    if quality_mode:
+        candidates = int(os.environ.get("SOAK_CANDIDATES", "16"))
+        grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "4"))
+        rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "0"))
     trace_out = os.environ.get("SOAK_TRACE_OUT", "")
-    if trace_out:
+    if trace_out or quality_mode:
         from distributed_tf_serving_tpu.utils import tracing
 
+        # Quality mode needs the span plane live either way: drift
+        # exemplars are span annotations, and annotated spans are what
+        # the tail sampler force-keeps into /tracez.
         tracing.enable(
             buffer_size=int(os.environ.get("SOAK_TRACE_BUFFER", "256")),
-            sample_rate=float(os.environ.get("SOAK_TRACE_SAMPLE", "0.05")),
+            sample_rate=float(
+                os.environ.get(
+                    "SOAK_TRACE_SAMPLE", "0.2" if quality_mode else "0.05"
+                )
+            ),
             slowest_n=int(os.environ.get("SOAK_TRACE_SLOWEST", "32")),
         )
     if chaos:
@@ -208,7 +247,50 @@ def main() -> None:
         cross_full_matrix=True,
     )
     model = build_model("dcn_v2", config)
-    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    quality_monitor = None
+    q_window_s = max(seconds * 0.35, 3.0)
+    if quality_mode:
+        # Train briefly on the synthetic stream so the served scores
+        # carry REAL signal against the stream's teacher labels — a
+        # random-init model would pin the label-feedback AUC at ~0.5 and
+        # the gate would measure nothing.
+        from distributed_tf_serving_tpu.serving.quality import QualityMonitor
+        from distributed_tf_serving_tpu.train import Trainer
+        from distributed_tf_serving_tpu.train.data import SyntheticCTRConfig
+
+        # Dense id catalog (the bench's CPU train_id_space): each id gets
+        # enough noisy Bernoulli views inside a short fit that the model
+        # actually generalizes — at the full vocab the same steps leave
+        # AUC at coin-flip (bench.py train_on_chip's finding).
+        stream_cfg = SyntheticCTRConfig(
+            num_fields=NUM_FIELDS,
+            id_space=min(1 << 12, config.vocab_size),
+            seed=7,
+        )
+        trainer = Trainer(model, stream_config=stream_cfg, learning_rate=3e-3)
+        fit = trainer.fit(
+            steps=int(os.environ.get("SOAK_QUALITY_TRAIN_STEPS", "400")),
+            batch_size=256,
+        )
+        print(
+            f"# quality soak: trained {fit['steps']} steps, "
+            f"loss={fit['loss']:.4f}", file=sys.stderr,
+        )
+        params = trainer.snapshot_params()
+        quality_monitor = QualityMonitor(
+            # Short window so the post-shift window is dominated by
+            # shifted traffic well before the soak ends; fast drift
+            # cadence so short CI smokes (~12 s) get several ticks.
+            window_s=q_window_s,
+            slices=4,
+            drift_check_interval_s=max(seconds / 24, 0.25),
+            drift_threshold_psi=float(
+                os.environ.get("SOAK_QUALITY_PSI_THRESHOLD", "0.2")
+            ),
+            exemplar_traces=8,
+        )
+    else:
+        params = jax.jit(model.init)(jax.random.PRNGKey(0))
     registry = ServableRegistry()
     servable = Servable(
         name="DCN", version=1, model=model, params=params,
@@ -279,7 +361,7 @@ def main() -> None:
     batcher = DynamicBatcher(
         buckets=buckets, max_wait_us=2000, completion_workers=12,
         score_cache=score_cache, dedup=cache_mode, overload=overload_ctrl,
-        utilization=ledger,
+        utilization=ledger, quality=quality_monitor,
     ).start()
     batcher.max_batch_candidates = buckets[-1]
     for b in buckets:
@@ -290,6 +372,47 @@ def main() -> None:
             _warmup=True,
         ).result(timeout=600)
     impl = PredictionServiceImpl(registry, batcher)
+
+    quality_block: dict = {}
+    q_pools: dict = {}
+    if quality_mode:
+        # Warmup exclusion is an acceptance criterion: the bucket-ladder
+        # warmups above went through the full completer path, and the
+        # sketch must have seen NONE of them.
+        quality_block["observed_after_warmup"] = quality_monitor.observed_requests
+        # Payload pools from the synthetic stream, with each row's label
+        # generated from the KNOWN teacher (the data-gen's own Bernoulli)
+        # and each row's join key digested client-side over the exact
+        # arrays sent. The shifted pool scales feature weights (the
+        # teacher is linear in weights, so ranking — and therefore AUC —
+        # survives while the score DISTRIBUTION saturates outward), with
+        # labels regenerated from the teacher on the shifted rows.
+        from distributed_tf_serving_tpu.client import label_keys
+        from distributed_tf_serving_tpu.train.data import (
+            SyntheticCTRStream,
+            _sigmoid,
+        )
+
+        q_stream = SyntheticCTRStream(stream_cfg)
+        pool_n = int(os.environ.get("SOAK_QUALITY_POOL", "32"))
+        shift_scale = float(os.environ.get("SOAK_QUALITY_SHIFT_SCALE", "3.0"))
+        for phase, (offset, scale) in enumerate(
+            ((0, 1.0), (100_000, shift_scale))
+        ):
+            payloads, labels, keys = [], [], []
+            for i in range(pool_n):
+                b = q_stream.batch(candidates, offset + i)
+                wts = (b["feat_wts"] * scale).astype(np.float32)
+                score = q_stream._teacher_score(b["feat_ids"], wts)
+                rng = np.random.RandomState(7_000_003 + offset + i)
+                row_labels = (
+                    rng.rand(candidates) < _sigmoid(score)
+                ).astype(np.float32)
+                payload = {"feat_ids": b["feat_ids"], "feat_wts": wts}
+                payloads.append(payload)
+                labels.append(row_labels)
+                keys.append(label_keys(payload))
+            q_pools[phase] = (payloads, labels, keys)
 
     wide = make_payload(candidates=candidates, num_fields=NUM_FIELDS)
     compact = compact_payload(wide, config.vocab_size)
@@ -465,6 +588,105 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
                 note_error("rest", f"{type(e).__name__}: {e}")
 
+    # Quality mode: (score, label, t) log the gate's OFFLINE exact-AUC
+    # baseline is computed from, and once-per-round labeling bookkeeping.
+    quality_log: list[tuple[float, float, float]] = []
+    q_labeled: set = set()
+    q_shift_t = deadline - seconds * (
+        1.0 - float(os.environ.get("SOAK_QUALITY_SHIFT_AT", "0.55"))
+    )
+    q_round_s = max(q_window_s / 3.0, 1.0)
+
+    async def quality_worker(client, session, wid: int):
+        i = 0
+        while time.perf_counter() < deadline:
+            i += 1
+            now = time.perf_counter()
+            phase = 0 if now < q_shift_t else 1
+            payloads, labels_pool, keys_pool = q_pools[phase]
+            idx = (wid * 131 + i) % len(payloads)
+            try:
+                scores = await client.predict(payloads[idx], sort_scores=False)
+                counts["grpc_ok"] += 1
+            except PredictClientError as e:
+                note_error("grpc", f"{getattr(e.code, 'name', e.code)}: {e}")
+                continue
+            except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
+                note_error("grpc", f"{type(e).__name__}: {e}")
+                continue
+            # Label each payload once per labeling round (and afresh per
+            # phase): the reservoir keeps refreshing, the windowed AUC
+            # always has recent pairs, and the same label is never
+            # spammed every request.
+            round_id = int((now - (deadline - seconds)) / q_round_s)
+            mark = (phase, idx, round_id)
+            if mark in q_labeled:
+                continue
+            q_labeled.add(mark)
+            row_labels = labels_pool[idx]
+            try:
+                async with session.post("/labelz", json={"labels": [
+                    {"id": key, "label": float(lb)}
+                    for key, lb in zip(keys_pool[idx], row_labels)
+                ]}) as r:
+                    body = await r.json()
+                    if r.status != 200:
+                        note_error("rest", f"labelz http {r.status}: {body}")
+                        continue
+                t = time.monotonic()
+                quality_log.extend(
+                    (float(s), float(lb), t)
+                    for s, lb in zip(np.asarray(scores).ravel(), row_labels)
+                )
+            except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
+                note_error("rest", f"labelz {type(e).__name__}: {e}")
+
+    async def quality_pin(session):
+        """Pin the drift reference over LIVE HTTP at ~40% — steady
+        traffic only, so the shifted segment drifts AGAINST it."""
+        pin_at = float(os.environ.get("SOAK_QUALITY_PIN_AT", "0.40"))
+        await asyncio.sleep(seconds * pin_at)
+        try:
+            async with session.post("/qualityz/snapshot") as r:
+                quality_block["pin"] = await r.json()
+        except Exception as e:  # noqa: BLE001 — report, keep line
+            quality_block["pin"] = {"error": f"{type(e).__name__}: {e}"}
+
+    async def probe_quality(session) -> None:
+        """End-of-run probes against the LIVE surfaces (the bytes an
+        operator's curl would get): /qualityz, the ?section= monitoring
+        filter, /tracez exemplar annotations, and the Prometheus text
+        (written to disk for the exposition lint)."""
+        async with session.get("/qualityz") as r:
+            qz = await r.json()
+        quality_block["qualityz"] = qz
+        async with session.get("/monitoring?section=quality") as r:
+            sec = await r.json()
+            quality_block["section_filter_ok"] = (
+                r.status == 200
+                and set(sec) == {"quality"}
+                and bool(sec["quality"].get("enabled"))
+            )
+        async with session.get("/tracez?limit=200") as r:
+            tz_raw = await r.read()
+        quality_block["exemplar_traces"] = tz_raw.count(b'"quality.drift"')
+        async with session.get("/monitoring/prometheus/metrics") as r:
+            prom_text = await r.text()
+        prom_out = os.environ.get(
+            "SOAK_QUALITY_PROM_OUT",
+            os.path.join(
+                __import__("tempfile").gettempdir(),
+                f"soak_quality_prom_{os.getpid()}.txt",
+            ),
+        )
+        with open(prom_out, "w") as f:
+            f.write(prom_text)
+        quality_block["prom_path"] = prom_out
+        quality_block["prom_quality_series"] = sum(
+            1 for ln in prom_text.splitlines()
+            if ln.startswith("dts_tpu_quality_")
+        )
+
     async def control_worker(gport: int):
         import grpc as grpc_mod
 
@@ -576,13 +798,28 @@ def main() -> None:
                     aiohttp.ClientSession(f"http://127.0.0.1:{rport}")
                 )
                 try:
+                    # Quality mode swaps the standard gRPC mixers for the
+                    # teacher-labeled workload (unshifted mixer traffic
+                    # would dilute the drift segment the gate measures)
+                    # plus the mid-run reference pin.
+                    data_workers = (
+                        [
+                            quality_worker(client, session, w)
+                            for w in range(grpc_workers)
+                        ] + [quality_pin(session)]
+                        if quality_mode
+                        else [
+                            grpc_worker(
+                                shed_client
+                                if (shed_client is not None and w % 3 == 2)
+                                else client,
+                                w,
+                            )
+                            for w in range(grpc_workers)
+                        ]
+                    )
                     await asyncio.gather(
-                        *(grpc_worker(
-                            shed_client
-                            if (shed_client is not None and w % 3 == 2)
-                            else client,
-                            w,
-                        ) for w in range(grpc_workers)),
+                        *data_workers,
                         *(burst_worker(client, w) for w in range(burst_workers)),
                         *(rest_worker(session, w) for w in range(rest_workers)),
                         control_worker(gport),
@@ -604,6 +841,11 @@ def main() -> None:
                             await probe_utilz(session)
                         except Exception as e:  # noqa: BLE001 — report, keep line
                             util_block["error"] = f"{type(e).__name__}: {e}"
+                    if quality_mode:
+                        try:
+                            await probe_quality(session)
+                        except Exception as e:  # noqa: BLE001 — report, keep line
+                            quality_block["error"] = f"{type(e).__name__}: {e}"
                     if trace_out:
                         try:
                             await export_trace(session)
@@ -647,6 +889,44 @@ def main() -> None:
             os.remove(log_path)
         else:
             request_log_block["kept_file"] = log_path  # evidence for triage
+    if quality_mode:
+        # The acceptance comparison: the LIVE windowed AUC (served by
+        # /qualityz from the monitor's joined pairs) vs the EXACT AUC the
+        # soak computes offline from its own (score, label) log over the
+        # same window — train/data.py::auc both times, so a disagreement
+        # is a join/reservoir bug, not a metric-definition mismatch.
+        from distributed_tf_serving_tpu.train.data import auc as exact_auc
+
+        qz = quality_block.get("qualityz") or {}
+        labels_blk = qz.get("labels") or {}
+        cutoff = time.monotonic() - q_window_s
+        offline_all = offline_window = None
+        try:
+            if quality_log:
+                arr = np.asarray([(s, lb) for s, lb, _t in quality_log])
+                offline_all = round(float(exact_auc(arr[:, 1], arr[:, 0])), 6)
+            recent = [(s, lb) for s, lb, t in quality_log if t >= cutoff]
+            if recent:
+                arr = np.asarray(recent)
+                offline_window = round(float(exact_auc(arr[:, 1], arr[:, 0])), 6)
+        except ValueError:
+            pass  # single-class log: AUC undefined, reported as null
+        drift_blk = (
+            ((qz.get("models") or {}).get("DCN") or {}).get("drift") or {}
+        )
+        quality_block.update({
+            "window_s": q_window_s,
+            "windowed_auc": labels_blk.get("auc"),
+            "offline_auc_window": offline_window,
+            "offline_auc_all": offline_all,
+            "offline_pairs": len(quality_log),
+            "labels_joined": labels_blk.get("joined", 0),
+            "labels_orphaned": labels_blk.get("orphaned", 0),
+            "drift": drift_blk,
+            "observed_requests": qz.get("observed_requests", 0),
+        })
+        # The full /qualityz body served its numbers; keep the line lean.
+        quality_block.pop("qualityz", None)
     line = {
         "soak_seconds": round(wall, 1),
         "platform": str(jax.devices()[0]),
@@ -723,6 +1003,10 @@ def main() -> None:
             {**ledger.snapshot(window_s=wall), **util_block}
             if util_mode else None
         ),
+        # Quality plane (SOAK_QUALITY=1): live-route probes + the
+        # windowed-vs-offline AUC comparison — the CI gate
+        # (tools/check_quality_smoke.py) reads this.
+        "quality": quality_block if quality_mode else None,
         "chaos": None,
         "input_cache": (
             {
