@@ -1766,6 +1766,165 @@ def child_main() -> None:
             finally:
                 await server.stop(0)
 
+        async def serve_lifecycle():
+            nonlocal stage
+            stage = "lifecycle_hot_swap"
+            # Hot-swap cost (ISSUE 8, opt-in via DTS_BENCH_LIFECYCLE=1):
+            # in-window p99 + error count while a version publish ->
+            # watcher hot-load (queue warmup) -> canary -> promote runs
+            # MID-WINDOW, vs an adjacent steady-state window of the same
+            # closed loop. The controller runs in mechanics mode
+            # (quality=None: promote on dwell alone) — this block prices
+            # the swap machinery, not the rollout judgment; off by
+            # default so headline numbers stay comparable.
+            import dataclasses as dc_
+            import tempfile
+
+            from distributed_tf_serving_tpu.interop.export import (
+                publish_version,
+            )
+            from distributed_tf_serving_tpu.serving.lifecycle import (
+                LifecycleController,
+            )
+            from distributed_tf_serving_tpu.serving.version_watcher import (
+                VersionWatcher,
+                VersionWatcherConfig,
+            )
+            from distributed_tf_serving_tpu.train.checkpoint import (
+                save_servable,
+            )
+            from distributed_tf_serving_tpu.utils.config import LifecycleConfig
+
+            server, port = create_server_async(impl, "127.0.0.1:0")
+            await server.start()
+            base = tempfile.mkdtemp(prefix="bench_lifecycle_")
+            watcher = VersionWatcher(
+                base, registry,
+                VersionWatcherConfig(
+                    poll_interval_s=0.5, model_name="DCN",
+                    model_kind="dcn_v2",
+                ),
+                # Queue warmup: the hot-loaded version compiles on the
+                # batching thread BEFORE its registry flip — the compile
+                # stall IS part of the swap cost this block measures.
+                warmup=batcher.warmup_via_queue,
+                model_config=config,
+            ).start()
+            ctrl = LifecycleController(
+                LifecycleConfig(
+                    enabled=True, tick_interval_s=0.2,
+                    canary_probe_only_s=0.3, canary_initial_fraction=0.5,
+                    canary_ramp_step=0.5, canary_step_dwell_s=0.5,
+                    canary_max_fraction=1.0, promote_after_s=1.0,
+                ),
+                registry=registry, model_name="DCN", watcher=watcher,
+                quality=None,
+            ).start()
+            impl.lifecycle = ctrl
+            try:
+                batcher.max_batch_candidates = min(2048, batcher.buckets[-1])
+                lat_payload = make_payload(
+                    candidates=CANDIDATES, num_fields=NUM_FIELDS, seed=77
+                )
+                conc = 4
+                steady_s = float(
+                    os.environ.get("DTS_BENCH_LIFECYCLE_WINDOW_S", "6")
+                )
+
+                async def timed_loop(client, run_s):
+                    lat: list = []
+                    errs = [0]
+
+                    async def w():
+                        end = time.perf_counter() + run_s
+                        while time.perf_counter() < end:
+                            t0 = time.perf_counter()
+                            try:
+                                await client.predict(
+                                    lat_payload, sort_scores=True
+                                )
+                                lat.append((time.perf_counter() - t0) * 1e3)
+                            except Exception:  # noqa: BLE001 — the error
+                                errs[0] += 1    # COUNT is the measurement
+
+                    await asyncio.gather(*(w() for _ in range(conc)))
+                    return np.asarray(lat), errs[0]
+
+                async with ShardedPredictClient(
+                    [f"127.0.0.1:{port}"], "DCN",
+                    channels_per_host=scale.channels_per_host,
+                ) as client:
+                    for _ in range(5):
+                        await client.predict(lat_payload, sort_scores=True)
+                    log(stage, f"steady window {steady_s}s x {conc} workers")
+                    lat_a, err_a = await timed_loop(client, steady_s)
+
+                    async def publish_mid():
+                        await asyncio.sleep(steady_s * 0.25)
+                        sv = registry.resolve("DCN")
+                        loop_ = asyncio.get_running_loop()
+
+                        def pub():
+                            def write(tmp):
+                                save_servable(
+                                    tmp,
+                                    dc_.replace(sv, version=sv.version + 1),
+                                    kind="dcn_v2",
+                                )
+                            return publish_version(
+                                base, write, at_least=sv.version + 1
+                            )
+
+                        return await loop_.run_in_executor(None, pub)
+
+                    log(stage, f"swap window {steady_s}s (publish at 25%)")
+                    (lat_b, err_b), published = await asyncio.gather(
+                        timed_loop(client, steady_s), publish_mid()
+                    )
+                # Let the ramp settle briefly past the window so the
+                # reported block shows the promote completing (the p99
+                # numbers above are already frozen; this only bounds the
+                # `promoted` field's truthfulness, it gates nothing).
+                settle_end = time.perf_counter() + 8.0
+                while (
+                    ctrl.snapshot()["counters"]["promotes"] < 1
+                    and time.perf_counter() < settle_end
+                ):
+                    await asyncio.sleep(0.25)
+                snap = ctrl.snapshot()
+
+                def pct(a, q):
+                    return round(float(np.percentile(a, q)), 3) if a.size else None
+
+                res["lifecycle"] = {
+                    "window_s_each": steady_s,
+                    "steady": {
+                        "requests": int(lat_a.size),
+                        "qps": round(lat_a.size / steady_s, 1),
+                        "p50_ms": pct(lat_a, 50), "p99_ms": pct(lat_a, 99),
+                        "errors": err_a,
+                    },
+                    "swap": {
+                        "requests": int(lat_b.size),
+                        "qps": round(lat_b.size / steady_s, 1),
+                        "p50_ms": pct(lat_b, 50), "p99_ms": pct(lat_b, 99),
+                        "errors": err_b,
+                        "published_version": published[0],
+                        "promoted": snap["counters"]["promotes"] >= 1,
+                        "stable_version": snap["stable_version"],
+                    },
+                    "p99_delta_ms": (
+                        round(pct(lat_b, 99) - pct(lat_a, 99), 3)
+                        if lat_a.size and lat_b.size else None
+                    ),
+                }
+                log(stage, json.dumps(res["lifecycle"]))
+            finally:
+                impl.lifecycle = None
+                ctrl.stop()  # also drops the module criticality-scan gate
+                watcher.stop()
+                await server.stop(0)
+
         asyncio.run(serve_windows())
         report = res["report"]
         s = report.summary()
@@ -1823,6 +1982,8 @@ def child_main() -> None:
             asyncio.run(serve_cache_ab(skew))
         if _overload_flag():
             asyncio.run(serve_overload_ab())
+        if os.environ.get("DTS_BENCH_LIFECYCLE", "0") == "1":
+            asyncio.run(serve_lifecycle())
         batcher.stop()
 
         asyncio.run(measure_host_ceiling())
@@ -1873,6 +2034,11 @@ def child_main() -> None:
             # (model, version) count/mean/percentiles; absent when the
             # plane is off (the default, keeping headlines comparable).
             "quality": res.get("quality"),
+            # Lifecycle hot-swap cost (ISSUE 8, DTS_BENCH_LIFECYCLE=1):
+            # in-window p99 + errors during a mid-run publish -> hot-load
+            # -> canary -> promote vs an adjacent steady window; absent
+            # when the block is off (the default).
+            "lifecycle": res.get("lifecycle"),
             # Output-transfer pipeline attribution (ISSUE 1): wire bytes
             # fetched vs. the full-fp32 all-outputs baseline, and the
             # fraction of the in-flight D2H window the completers never

@@ -34,6 +34,98 @@ import json
 import sys
 
 
+def publish_version(
+    base_dir: str,
+    write_fn,
+    at_least: int = 1,
+    max_attempts: int = 10,
+) -> tuple[int, str]:
+    """Land one artifact in a TF-Serving versioned base dir ATOMICALLY,
+    allocating the next monotonic version number: `<base>/<N>` where N =
+    max(existing numeric dirs, at_least - 1) + 1.
+
+    `write_fn(tmp_dir)` writes the complete artifact into a sibling temp
+    directory (dot-prefixed and non-numeric, so the version watcher's
+    scan never lists it); the commit is a single os.rename into the
+    numbered slot. The watcher's `_version_ready` probe therefore can
+    never observe a half-written version dir — the probe only fires on
+    directories that exist, and a published directory exists only fully
+    written. Concurrent publishers can race the SAME number: the loser's
+    rename fails (the winner's landed dir is non-empty, so rename raises
+    ENOTEMPTY/EEXIST rather than silently merging), the allocator
+    re-scans and retries the rename under the next number — the written
+    artifact is reused, never re-generated, and the directory number is
+    authoritative over anything the artifact recorded (the watcher's own
+    loader contract). Returns (version, path).
+
+    TF-free; the lifecycle plane's publisher, soaks, and tests call this
+    with whatever writer fits (train/checkpoint.py save_servable,
+    export_servable, a test fixture). The number allocation reuses the
+    watcher's OWN scanner (lazy import), so publisher and watcher can
+    never disagree about what counts as a version directory."""
+    import os
+    import shutil
+
+    from ..serving.version_watcher import scan_versions
+
+    base = os.path.abspath(str(base_dir))
+    os.makedirs(base, exist_ok=True)
+
+    def _numeric_versions() -> list[int]:
+        return list(scan_versions(base))
+
+    tmp = os.path.join(base, f".tmp-publish-{os.getpid()}-{id(write_fn):x}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    try:
+        write_fn(tmp)
+        if not os.path.isdir(tmp):
+            raise RuntimeError(
+                f"publish writer did not create the artifact dir {tmp}"
+            )
+        last_exc: OSError | None = None
+        for _ in range(max_attempts):
+            version = max(_numeric_versions() + [int(at_least) - 1]) + 1
+            dst = os.path.join(base, str(version))
+            try:
+                os.rename(tmp, dst)
+            except OSError as exc:
+                # A racing publisher landed this number first: the rename
+                # onto its non-empty dir raises (ENOTEMPTY/EEXIST) instead
+                # of silently merging. Only a now-existing destination is
+                # a collision; anything else — EXDEV, EACCES — is a real
+                # failure and must surface, not spin.
+                if not os.path.isdir(dst):
+                    raise
+                last_exc = exc
+                continue
+            return version, dst
+        raise RuntimeError(
+            f"could not allocate a version under {base} after "
+            f"{max_attempts} collisions"
+        ) from last_exc
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def publish_export(
+    base_dir: str, checkpoint_dir: str, validate: bool = True,
+    at_least: int = 1,
+) -> dict:
+    """export_servable -> the next numeric version slot under `base_dir`
+    (the SavedModel flavor of the lifecycle publish path; requires
+    TensorFlow in-process like export_servable itself). The export's own
+    validate-then-commit runs inside the publish temp dir, so the rename
+    into the numbered slot stays the single commit point."""
+    summary: dict = {}
+
+    def write(tmp_dir: str) -> None:
+        summary.update(export_servable(checkpoint_dir, tmp_dir, validate=validate))
+
+    version, path = publish_version(base_dir, write, at_least=at_least)
+    summary.update({"version": version, "path": path})
+    return summary
+
+
 def export_servable(checkpoint_dir: str, out_dir: str, validate: bool = True) -> dict:
     """Convert the checkpointed servable to a SavedModel at `out_dir`.
 

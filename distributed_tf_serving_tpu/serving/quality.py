@@ -102,6 +102,20 @@ def js_divergence(p_counts, q_counts, eps: float = 1e-12) -> float:
     return 0.5 * _kl(p, m) + 0.5 * _kl(q, m)
 
 
+def coarsen_counts(counts, target_bins: int) -> np.ndarray:
+    """Merge adjacent histogram bins down to ~target_bins (bin-wise sums,
+    so the result is still a valid distribution of the same data). PSI
+    over many thin bins is dominated by sampling noise when one side's
+    window is small — E[PSI] grows with occupied-bin count over sample
+    size, and the empty-bin smoothing terms blow it up further — so
+    DECISION consumers (the lifecycle rollback gate) compare coarsened
+    views while the exposition keeps the fine bins."""
+    counts = np.asarray(counts)
+    k = max(2, min(int(target_bins), len(counts)))
+    edges = np.linspace(0, len(counts), k + 1).astype(int)[:-1]
+    return np.add.reduceat(counts, edges)
+
+
 def histogram_percentile(
     counts, lo: float, hi: float, q: float
 ) -> float:
@@ -353,10 +367,20 @@ class _LabelJoin:
             self._pairs.append((score, float(label), now, model, version))
             return True
 
-    def window_pairs(self) -> tuple[np.ndarray, np.ndarray]:
+    def window_pairs(
+        self, model: str | None = None, version: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """In-window (score, label) pairs; model/version restrict to one
+        series — the per-version windowed AUC the lifecycle plane's
+        rollback gate compares between a stable and its canary."""
         with self._lock:
             cutoff = self._clock() - self.window_s
-            live = [(s, l) for s, l, t, _m, _v in self._pairs if t >= cutoff]
+            live = [
+                (s, l) for s, l, t, m, v in self._pairs
+                if t >= cutoff
+                and (model is None or m == model)
+                and (version is None or int(v) == int(version))
+            ]
         if not live:
             return np.empty(0), np.empty(0)
         arr = np.asarray(live, dtype=np.float64)
@@ -639,6 +663,72 @@ class QualityMonitor:
             "js": round(js_divergence(c_old, c_new), 6),
             "counts": [int(c_old.sum()), int(c_new.sum())],
         }
+
+    # ------------------------------------------------ lifecycle read API
+
+    def version_window_count(self, model: str, version: int) -> int:
+        """Scores observed for one (model, version) inside the rolling
+        window — the lifecycle controller's evidence floor before a
+        canary may be judged (promote OR rollback)."""
+        with self._lock:
+            sk = self._sketches.get((model, int(version)))
+        return int(sk.window_counts().sum()) if sk is not None else 0
+
+    def pair_drift(
+        self, model: str, v_old: int, v_new: int,
+        min_count: int | None = None, decision_bins: int | None = None,
+    ) -> dict | None:
+        """PSI/JS between TWO NAMED versions' windowed distributions —
+        the explicit (stable, canary) comparison the lifecycle rollback
+        gate reads, as opposed to _version_pair_drift's 'two most active'
+        heuristic the passive surfaces show. None until both sides hold
+        at least `min_count` (default: this monitor's min_drift_count)
+        windowed scores — drift over a handful of scores is noise.
+
+        decision_bins coarsens both sides before the divergence math: a
+        fresh canary's window is SMALL, and same-distribution PSI over
+        50 thin bins at a few hundred samples measures 0.2-0.3 of pure
+        sampling noise (measured; the empty-bin smoothing terms dominate)
+        — within reach of a rollback threshold — while ~10 merged bins
+        put the noise floor at ~0.03 with a genuine shift still reading
+        >1. Gates should pass ~10; the passive surfaces keep the fine
+        bins."""
+        floor = self.min_drift_count if min_count is None else int(min_count)
+        with self._lock:
+            sk_old = self._sketches.get((model, int(v_old)))
+            sk_new = self._sketches.get((model, int(v_new)))
+        if sk_old is None or sk_new is None:
+            return None
+        c_old, c_new = sk_old.window_counts(), sk_new.window_counts()
+        if c_old.sum() < floor or c_new.sum() < floor:
+            return None
+        if decision_bins:
+            c_old = coarsen_counts(c_old, decision_bins)
+            c_new = coarsen_counts(c_new, decision_bins)
+        return {
+            "versions": [int(v_old), int(v_new)],
+            "psi": round(psi(c_old, c_new), 6),
+            "js": round(js_divergence(c_old, c_new), 6),
+            "counts": [int(c_old.sum()), int(c_new.sum())],
+            "bins": int(len(c_old)),
+        }
+
+    def version_auc(
+        self, model: str, version: int
+    ) -> tuple[float | None, int]:
+        """(windowed AUC, pair count) for ONE version's label-feedback
+        joins — the exact train/data.py::auc, None when the window is
+        empty or single-class. The lifecycle gate compares this between
+        stable and canary before trusting an AUC delta."""
+        scores, labels = self._labels.window_pairs(model, int(version))
+        if scores.size == 0:
+            return None, 0
+        try:
+            from ..train.data import auc as exact_auc  # jax-free module
+
+            return round(float(exact_auc(labels, scores)), 6), int(scores.size)
+        except ValueError:
+            return None, int(scores.size)  # single-class window
 
     # ------------------------------------------------------------ reference
 

@@ -56,6 +56,7 @@ from .. import codec
 from ..proto import serving_apis_pb2 as apis
 from ..utils import tracing
 from ..utils.tracing import request_trace
+from . import lifecycle as lifecycle_mod
 from . import overload as overload_mod
 from .service import PredictionServiceImpl, ServiceError
 
@@ -87,9 +88,10 @@ def _json_error(
 
 
 def _criticality_of(request: web.Request) -> str | None:
-    """The request's criticality lane from the x-dts-criticality header
-    (overload plane). Only scanned while a controller is armed."""
-    if not overload_mod.active():
+    """The request's criticality lane from the x-dts-criticality header.
+    Only scanned while a plane that consumes it is armed (overload lane
+    shedding, or lifecycle probe-lane canary routing)."""
+    if not (overload_mod.active() or lifecycle_mod.active()):
         return None
     value = request.headers.get(overload_mod.CRITICALITY_KEY)
     return overload_mod.normalize_criticality(value) if value else None
@@ -182,17 +184,40 @@ class RestGateway:
             web.get("/qualityz", self.qualityz),
             web.post("/qualityz/snapshot", self.qualityz_snapshot),
             web.post("/labelz", self.labelz),
+            # Lifecycle plane (ISSUE 8): the continuous-freshness state
+            # machine — canary routing fractions/counters, promote/
+            # rollback history, and the version watcher's blacklist/pin
+            # state.
+            web.get("/lifecyclez", self.lifecyclez),
         ])
 
     # ------------------------------------------------------------- helpers
 
-    def _resolve_specs(self, model: str, version, signature_name: str, label=None):
-        # ONE lookup-error taxonomy, shared with the gRPC path.
+    def _resolve_specs(
+        self, model: str, version, signature_name: str, label=None,
+        criticality=None,
+    ):
+        # ONE lookup-error taxonomy, shared with the gRPC path. The
+        # lifecycle plane's canary router overrides DEFAULT resolutions
+        # here too — the gateway pins the CONCRETE resolved version into
+        # the proto it hands the impl, so routing must happen at this
+        # resolve or REST traffic would never carry canary share.
         from .service import _wrap_lookup
 
-        servable = _wrap_lookup(
-            lambda: self.impl.registry.resolve(model, version, label)
-        )
+        routed = self.impl.lifecycle_route(model, version, label, criticality)
+        if routed is not None:
+            try:
+                servable = self.impl.registry.resolve(model, routed)
+            except KeyError:
+                # Routed version vanished mid-swap (rollback racing this
+                # request): serve the latest instead of failing traffic.
+                servable = _wrap_lookup(
+                    lambda: self.impl.registry.resolve(model)
+                )
+        else:
+            servable = _wrap_lookup(
+                lambda: self.impl.registry.resolve(model, version, label)
+            )
         sig = _wrap_lookup(lambda: servable.signature(signature_name))
         return servable, sig
 
@@ -321,7 +346,10 @@ class RestGateway:
                     "INVALID_ARGUMENT",
                     'body must carry exactly one of "instances" or "inputs"',
                 )
-            servable, sig = self._resolve_specs(model, version, signature_name, label)
+            servable, sig = self._resolve_specs(
+                model, version, signature_name, label,
+                criticality=_criticality_of(request),
+            )
             if row_format:
                 arrays = self._arrays_from_instances(body["instances"], sig)
             else:
@@ -524,6 +552,7 @@ class RestGateway:
                 overload=self.impl.overload_stats(),
                 utilization=self.impl.utilization_stats(),
                 quality=self.impl.quality_stats(),
+                lifecycle=self.impl.lifecycle_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -553,6 +582,8 @@ class RestGateway:
             "overload": self.impl.overload_stats,
             "utilization": self.impl.utilization_stats,
             "quality": self.impl.quality_stats,
+            "lifecycle": self.impl.lifecycle_stats,
+            "versions": self.impl.versions_stats,
             "request_log": request_log,
             "draining": lambda: bool(getattr(self.impl, "draining", False)),
         }
@@ -580,7 +611,8 @@ class RestGateway:
         snap["tracing"] = builders["tracing"]()
         # Armed-plane blocks only: a disabled plane is absent, so
         # dashboards can distinguish "off" from "cold".
-        for name in ("cache", "overload", "utilization", "quality"):
+        for name in ("cache", "overload", "utilization", "quality",
+                     "lifecycle", "versions"):
             block = builders[name]()
             if block is not None:
                 snap[name] = block
@@ -713,6 +745,20 @@ class RestGateway:
         except ServiceError as e:
             return _json_error(e.code, str(e))
         return web.json_response(result)
+
+    async def lifecyclez(self, request: web.Request) -> web.Response:
+        """GET /lifecyclez: the continuous-freshness surface — the
+        IDLE/CANARY/PROMOTING/ROLLED_BACK state machine, stable/canary
+        versions and the live routing fraction, publish/promote/rollback
+        counters + transition history, the last rollback's evidence
+        (pair PSI/JS, AUC deltas), and the version watcher's
+        loaded/on-disk/blacklisted/pinned sets. `{"enabled": false}` when
+        no controller is armed ([lifecycle] enabled=false), so probes
+        need no config knowledge."""
+        stats = self.impl.lifecycle_stats()
+        return web.json_response(
+            stats if stats is not None else {"enabled": False}
+        )
 
     async def cachez(self, request: web.Request) -> web.Response:
         """GET /cachez: the score-cache introspection surface — aggregate +

@@ -42,6 +42,7 @@ from ..utils.config import ServerConfig, load_config
 from ..utils.metrics import ServerMetrics
 from ..utils import tracing
 from ..utils.tracing import request_trace
+from . import lifecycle as lifecycle_mod
 from . import overload as overload_mod
 from .batcher import DynamicBatcher
 from .service import PredictionServiceImpl, ServiceError
@@ -73,10 +74,12 @@ def _traceparent_of(context) -> str | None:
 
 
 def _criticality_of(context) -> str | None:
-    """The request's criticality lane from invocation metadata (overload
-    plane; x-dts-criticality). Only scanned while a controller is armed —
-    one module-bool read otherwise."""
-    if not overload_mod.active():
+    """The request's criticality lane from invocation metadata
+    (x-dts-criticality). Only scanned while a plane that CONSUMES the
+    lane is armed — the overload controller (lane-ordered shedding) or
+    the lifecycle controller (probe-lane-first canary routing) — two
+    module-bool reads otherwise."""
+    if not (overload_mod.active() or lifecycle_mod.active()):
         return None
     try:
         for key, value in context.invocation_metadata() or ():
@@ -885,12 +888,17 @@ class GracefulShutdown:
         grace_s: float = 5.0,
         watcher=None,
         request_logger=None,
+        lifecycle=None,
     ):
         self.impl = impl
         self.batcher = batcher
         self.grace_s = max(float(grace_s), 0.0)
         self.watcher = watcher
         self.request_logger = request_logger
+        # Lifecycle controller (serving/lifecycle.py): stopped BEFORE the
+        # watcher so a mid-drain tick can't publish/promote/rollback into
+        # a stack that is tearing down.
+        self.lifecycle = lifecycle
         self.server = None  # attached once created (create_server[_async])
         self.drained: bool | None = None
         self._lock = threading.Lock()
@@ -929,7 +937,11 @@ class GracefulShutdown:
             t0 = time.perf_counter()
             # 1. Refuse new work; health goes NOT_SERVING.
             self.impl.draining = True
-            # 2. No new loads/warmups behind the drain.
+            # 2. No new loads/warmups behind the drain: the lifecycle
+            # controller first (its ticks drive the watcher), then the
+            # watcher itself.
+            if self.lifecycle is not None:
+                self.lifecycle.stop()
             if self.watcher is not None:
                 self.watcher.stop()
             # 3. Answer everything already accepted, bounded by grace.
@@ -969,6 +981,7 @@ def build_stack(
     overload_config=None,
     utilization_config=None,
     quality_config=None,
+    lifecycle_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -996,9 +1009,32 @@ def build_stack(
     drift vs a pinned reference and between live versions, the /labelz
     label-feedback join (windowed AUC + calibration), drift-linked trace
     exemplars, GET /qualityz, a `quality` block in /monitoring, and
-    dts_tpu_quality_* Prometheus series."""
-    # Validate the multi-model config (and its exclusivity) BEFORE any
-    # threads exist — a typo'd file must leave nothing to tear down.
+    dts_tpu_quality_* Prometheus series.
+    lifecycle_config (the TOML [lifecycle] section, a utils.config.
+    LifecycleConfig) arms the continuous-freshness plane: canary
+    admission over the version watcher's hot-swaps, drift/AUC
+    auto-rollback with retire+blacklist, the optional fine-tune
+    publisher, GET /lifecyclez, a `lifecycle` block in /monitoring, and
+    dts_tpu_lifecycle_* Prometheus series — requires model_base_path
+    (the watched dir IS the rollout mechanism) and an armed quality
+    plane (the rollback signal)."""
+    # Validate plane prerequisites BEFORE any threads exist — a typo'd
+    # config must leave nothing to tear down.
+    lifecycle_armed = lifecycle_config is not None and lifecycle_config.enabled
+    if lifecycle_armed:
+        if not model_base_path:
+            raise ValueError(
+                "[lifecycle] enabled requires --model-base-path: the "
+                "watched versioned dir is both the publish target and "
+                "the hot-swap mechanism the canary/rollback loop drives"
+            )
+        if quality_config is None or not quality_config.enabled:
+            raise ValueError(
+                "[lifecycle] enabled requires [quality] enabled (or "
+                "--quality): the rollback gate reads the quality plane's "
+                "version-pair drift and per-version label AUC — a "
+                "lifecycle with no signal could only ever promote blind"
+            )
     model_configs = None
     if cfg.model_config_file:
         if model_base_path or checkpoint or savedmodel:
@@ -1167,6 +1203,33 @@ def build_stack(
         # Label-only reloads may re-state this source verbatim (deploy
         # tools replay full configs); anything ELSE is a rejected move.
         impl.served_sources[cfg.model_name] = (str(model_base_path), cfg.model_kind)
+        impl.version_watcher = watcher
+        if lifecycle_armed:
+            from .lifecycle import LifecycleController
+
+            impl.lifecycle = LifecycleController(
+                lifecycle_config,
+                registry=registry,
+                model_name=cfg.model_name,
+                watcher=watcher,
+                quality=quality_monitor,
+            )
+            log.info(
+                "continuous-freshness lifecycle on: probe_only=%.1fs "
+                "ramp %.2f+%.2f/%.1fs to %.2f, promote_after=%.1fs, "
+                "rollback psi>=%.2f auc_drop>=%.3f, fine_tune every %s — "
+                "GET /lifecyclez on the REST surface",
+                lifecycle_config.canary_probe_only_s,
+                lifecycle_config.canary_initial_fraction,
+                lifecycle_config.canary_ramp_step,
+                lifecycle_config.canary_step_dwell_s,
+                lifecycle_config.canary_max_fraction,
+                lifecycle_config.promote_after_s,
+                lifecycle_config.rollback_psi,
+                lifecycle_config.rollback_auc_drop,
+                (f"{lifecycle_config.fine_tune_interval_s:.0f}s"
+                 if lifecycle_config.fine_tune_interval_s > 0 else "<off>"),
+            )
         versions = registry.models().get(cfg.model_name, [])
         if not versions:
             log.warning("no ready versions under %s yet; watching", model_base_path)
@@ -1305,6 +1368,19 @@ def serve(argv=None) -> None:
         "carries the bins/window/drift/label knobs",
     )
     parser.add_argument(
+        "--lifecycle", action="store_true", default=None,
+        help="continuous-freshness lifecycle (serving/lifecycle.py): "
+        "canary admission over the version watcher's hot-swaps (probe "
+        "lane first, then a configurable default-lane ramp), drift/AUC "
+        "auto-rollback with retire+blacklist, and the optional "
+        "fine-tune publisher ([lifecycle] fine_tune_interval_s). "
+        "Requires --model-base-path and --quality (the rollback "
+        "signal). Equivalent to [lifecycle] enabled=true; the "
+        "[lifecycle] section carries the ramp/threshold/publisher knobs "
+        "(GET /lifecyclez, `lifecycle` block in /monitoring, "
+        "dts_tpu_lifecycle_* Prometheus series)",
+    )
+    parser.add_argument(
         "--batching-parameters-file", dest="batching_parameters_file",
         help="tensorflow_model_server-format batching config (text-format "
         "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
@@ -1352,6 +1428,7 @@ def serve(argv=None) -> None:
 
     from ..utils.config import (
         CacheConfig,
+        LifecycleConfig,
         ObservabilityConfig,
         OverloadConfig,
         QualityConfig,
@@ -1376,6 +1453,14 @@ def serve(argv=None) -> None:
         )
     quality_config = cfgs.get("quality") or QualityConfig()
     if args.quality:
+        quality_config = dataclasses.replace(quality_config, enabled=True)
+    lifecycle_config = cfgs.get("lifecycle") or LifecycleConfig()
+    if args.lifecycle:
+        lifecycle_config = dataclasses.replace(lifecycle_config, enabled=True)
+    if lifecycle_config.enabled and not quality_config.enabled:
+        # --lifecycle implies the quality plane it reads: arming the
+        # actuator without its signal would fail build_stack's check, and
+        # the flag user's intent is unambiguous.
         quality_config = dataclasses.replace(quality_config, enabled=True)
     model_config = cfgs.get("model")
     if model_config is not None:
@@ -1433,7 +1518,13 @@ def serve(argv=None) -> None:
         overload_config=overload_config,
         utilization_config=utilization_config,
         quality_config=quality_config,
+        lifecycle_config=lifecycle_config,
     )
+    if impl.lifecycle is not None:
+        # The CLI server drives the controller with its background thread
+        # (ticks + the fine-tune publisher cadence); embedded callers and
+        # tests drive tick() themselves.
+        impl.lifecycle.start()
     # ONE teardown path for every exit: SIGTERM, REST-startup failure, and
     # normal termination all drain through this (admissions refused, queued
     # + in-flight work answered up to [overload] drain_grace_s, transport
@@ -1442,6 +1533,7 @@ def serve(argv=None) -> None:
         impl, batcher,
         grace_s=overload_config.drain_grace_s,
         watcher=watcher,
+        lifecycle=impl.lifecycle,
     )
     request_logger = None
     if cfg.request_log_file:

@@ -95,6 +95,17 @@ class PredictionServiceImpl:
         # with UNAVAILABLE "draining" while queued + in-flight work
         # completes, and the grpc.health.v1 servicer reports NOT_SERVING.
         self.draining = False
+        # Continuous-freshness lifecycle plane (serving/lifecycle.py):
+        # when a LifecycleController is set, DEFAULT version resolution
+        # of its model consults the canary router (requests pinning a
+        # version or label are never touched). None (default) costs one
+        # attribute read per resolution.
+        self.lifecycle = None
+        # The single-model version watcher, when one owns this impl's
+        # model (build_stack sets it): the /monitoring `versions` block
+        # reads loaded/on-disk/blacklist/pin state from it — present
+        # whether or not the lifecycle controller is armed.
+        self.version_watcher = None
 
     def _log_request(self, kind: str, request) -> None:
         if self.request_logger is not None:
@@ -180,6 +191,38 @@ class PredictionServiceImpl:
             )
         return monitor.pin_reference()
 
+    def lifecycle_stats(self) -> dict | None:
+        """Lifecycle-plane snapshot (state machine, canary routing
+        fractions/counters, publish/promote/rollback history, watcher
+        blacklist/pin state) — the body of GET /lifecyclez, the
+        `lifecycle` block in /monitoring, and the dts_tpu_lifecycle_*
+        Prometheus series. None when no controller is armed ([lifecycle]
+        enabled=false)."""
+        lc = self.lifecycle
+        return lc.snapshot() if lc is not None else None
+
+    def versions_stats(self) -> dict | None:
+        """Version-watcher snapshot (loaded versions, last reconcile
+        pass's on-disk-ready view, blacklist/pin sets, failed load
+        attempts) — the /monitoring `versions` block. Available whenever
+        a single-model watcher owns this impl's model, lifecycle armed
+        or not (the blacklist/pin API is operator-callable on its own)."""
+        watcher = self.version_watcher
+        return watcher.snapshot() if watcher is not None else None
+
+    def lifecycle_route(
+        self, name: str, version, label, criticality: str | None
+    ) -> int | None:
+        """Canary-admission version override for one request, or None.
+        Only DEFAULT resolutions of the lifecycle's own model are routed
+        — an explicit version or label pin is the client's choice and
+        the rollout must never second-guess it."""
+        lc = self.lifecycle
+        if lc is None or version is not None or label is not None \
+                or name != lc.model:
+            return None
+        return lc.route(criticality)
+
     def _refuse_if_draining(self) -> None:
         """Drain-aware admission gate: once shutdown started, new
         inference work is refused (UNAVAILABLE, so fan-out clients reroute
@@ -221,13 +264,32 @@ class PredictionServiceImpl:
             )
         return version, label
 
-    def _resolve(self, model_spec: apis.ModelSpec) -> tuple[Servable, Signature]:
+    def _resolve(
+        self, model_spec: apis.ModelSpec, criticality: str | None = None
+    ) -> tuple[Servable, Signature]:
         if not model_spec.name:
             raise ServiceError("INVALID_ARGUMENT", "model_spec.name is required")
         version, label = self._version_choice(model_spec)
-        servable = _wrap_lookup(
-            lambda: self.registry.resolve(model_spec.name, version, label)
+        routed = self.lifecycle_route(
+            model_spec.name, version, label, criticality
         )
+        if routed is not None:
+            try:
+                servable = self.registry.resolve(model_spec.name, routed)
+            except (ModelNotFoundError, VersionNotFoundError):
+                # The routed version vanished mid-swap (rollback racing
+                # this request): fall back to the latest-version default
+                # — a rollout action must never FAIL live traffic.
+                servable = _wrap_lookup(
+                    lambda: self.registry.resolve(model_spec.name)
+                )
+            span = tracing.current_span()
+            if span is not None:
+                span.attrs["lifecycle_version"] = servable.version
+        else:
+            servable = _wrap_lookup(
+                lambda: self.registry.resolve(model_spec.name, version, label)
+            )
         signature = _wrap_lookup(lambda: servable.signature(model_spec.signature_name))
         return servable, signature
 
@@ -473,10 +535,14 @@ class PredictionServiceImpl:
         except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
             raise self._translate_batcher_error(e, fut) from e
 
-    def _predict_prepare(self, request: apis.PredictRequest):
+    def _predict_prepare(
+        self, request: apis.PredictRequest, criticality: str | None = None
+    ):
         """Shared front half of Predict: resolution, decode/validation,
-        output_filter handling. Returns (servable, arrays, out_names)."""
-        servable, signature = self._resolve(request.model_spec)
+        output_filter handling. Returns (servable, arrays, out_names).
+        `criticality` reaches resolution so the lifecycle plane can route
+        probe-lane (then a ramp of default-lane) traffic to a canary."""
+        servable, signature = self._resolve(request.model_spec, criticality)
         if signature.method_name != "tensorflow/serving/predict":
             raise ServiceError(
                 "INVALID_ARGUMENT",
@@ -522,7 +588,9 @@ class PredictionServiceImpl:
     ) -> apis.PredictResponse:
         self._refuse_if_draining()
         deadline_t = self._clock_deadline(deadline_s)
-        servable, arrays, out_names, fetch_keys = self._predict_prepare(request)
+        servable, arrays, out_names, fetch_keys = self._predict_prepare(
+            request, criticality
+        )
         with request_trace.span("predict.execute"):
             outputs = self._run(
                 servable, arrays, output_keys=fetch_keys,
@@ -544,7 +612,9 @@ class PredictionServiceImpl:
         batch instead of blocking a handler thread on it."""
         self._refuse_if_draining()
         deadline_t = self._clock_deadline(deadline_s)
-        servable, arrays, out_names, fetch_keys = self._predict_prepare(request)
+        servable, arrays, out_names, fetch_keys = self._predict_prepare(
+            request, criticality
+        )
         with request_trace.span("predict.execute"):
             outputs = await self._run_async(
                 servable, arrays, output_keys=fetch_keys,
@@ -620,10 +690,10 @@ class PredictionServiceImpl:
 
     # ----------------------------------------------------- Classify / Regress
 
-    def _examples_prepare(self, request):
+    def _examples_prepare(self, request, criticality: str | None = None):
         """Shared front half of Classify/Regress: resolution + Example
         decode. Returns (servable, arrays)."""
-        servable, _ = self._resolve(request.model_spec)
+        servable, _ = self._resolve(request.model_spec, criticality)
         try:
             arrays = decode_input(request.input, servable.model.config.num_fields)
         except ExampleDecodeError as e:
@@ -635,7 +705,7 @@ class PredictionServiceImpl:
         criticality: str | None = None,
     ):
         deadline_t = self._clock_deadline(deadline_s)
-        servable, arrays = self._examples_prepare(request)
+        servable, arrays = self._examples_prepare(request, criticality)
         outputs = self._run(
             servable, arrays, output_keys=("prediction_node",),
             deadline_s=self._budget_left(deadline_t),
@@ -650,7 +720,7 @@ class PredictionServiceImpl:
         """_run_examples for coroutine servers (the REST gateway's
         :classify/:regress routes ride the same event loop as :predict)."""
         deadline_t = self._clock_deadline(deadline_s)
-        servable, arrays = self._examples_prepare(request)
+        servable, arrays = self._examples_prepare(request, criticality)
         outputs = await self._run_async(
             servable, arrays, output_keys=("prediction_node",),
             deadline_s=self._budget_left(deadline_t),

@@ -182,6 +182,24 @@ class VersionWatcher:
         self._attempt_mtime: dict[int, int] = {}  # version -> mtime at last failure
         self._label_warned: set[str] = set()  # once-per-label pending warning
         self._labels_applied: set[str] = set()  # seed-once bookkeeping
+        # Programmatic lifecycle control (serving/lifecycle.py rollback):
+        # blacklisted versions are EXCLUDED from the reconcile candidate
+        # set — unlike the mtime-keyed load-failure backoff above, an
+        # explicit blacklist never self-clears when the directory changes
+        # (a rolled-back version must not reload because a writer touched
+        # it); pinned versions are exempt from retention (a live canary's
+        # rollback target must outlive newer rollouts). Mutations REBIND
+        # a fresh frozenset (never mutate in place): the controller
+        # thread writes while the poll thread and snapshot() iterate, and
+        # an in-place set.add during iteration raises "changed size
+        # during iteration" — atomic rebinds make every reader see a
+        # consistent immutable view, no lock needed.
+        self._blacklisted: frozenset[int] = frozenset()
+        self._pinned: frozenset[int] = frozenset()
+        # Last reconcile pass's on-disk-ready versions: snapshot()
+        # reports this CACHED view instead of re-scanning the base path —
+        # a monitoring scrape must never pay (or hang on) filesystem I/O.
+        self._last_ready: tuple[int, ...] = ()
 
     # ----------------------------------------------------------------- API
 
@@ -199,6 +217,78 @@ class VersionWatcher:
         self._stop.set()
         self._thread.join(timeout=10)
 
+    # ----------------------------------------------- lifecycle control API
+
+    def blacklist(self, version: int) -> None:
+        """Exclude `version` from the reconcile candidate set until
+        unblacklisted — the rollback half-fix for the standing hazard
+        where a retired bad version is simply reloaded on the next scan
+        (its directory is still on disk and still probes ready)."""
+        self._blacklisted = self._blacklisted | {int(version)}
+        log.info("blacklisted %s v%d (excluded from reconcile)",
+                 self.config.model_name, int(version))
+
+    def unblacklist(self, version: int) -> None:
+        self._blacklisted = self._blacklisted - {int(version)}
+
+    def is_blacklisted(self, version: int) -> bool:
+        return int(version) in self._blacklisted
+
+    def pin(self, version: int) -> None:
+        """Exempt `version` from retention (like a label pin, without a
+        label): a canary's rollback target must not be retired out from
+        under it by newer rollouts."""
+        self._pinned = self._pinned | {int(version)}
+
+    def unpin(self, version: int) -> None:
+        self._pinned = self._pinned - {int(version)}
+
+    def retire(self, version: int, blacklist: bool = True) -> bool:
+        """Unload `version` from the registry NOW (traffic snaps to the
+        remaining latest via resolve's default) and, by default,
+        blacklist it so the next reconcile pass cannot hot-load it back
+        from disk. True = a loaded version was actually unloaded."""
+        v = int(version)
+        if blacklist:
+            self.blacklist(v)
+        self.unpin(v)
+        name = self.config.model_name
+        try:
+            self.registry.unload(name, v)
+        except KeyError:  # Model/VersionNotFoundError: never loaded
+            return False
+        log.info("retired %s v%d (lifecycle)", name, v)
+        self._notify_change(name)
+        return True
+
+    def snapshot(self) -> dict:
+        """Watcher state for /monitoring and the lifecycle block: what is
+        loaded, what the LAST reconcile pass saw ready on disk (cached —
+        a monitoring scrape must not pay, or hang on, filesystem I/O),
+        and the blacklist/pin sets."""
+        name = self.config.model_name
+        # _attempts is mutated in place by the poll thread; copying a
+        # resizing dict can raise "changed size during iteration" on
+        # this (scrape) thread. Bounded retries; an empty fallback beats
+        # failing the surface at exactly the failing-load moment an
+        # operator is looking for.
+        attempts: dict[int, int] = {}
+        for _ in range(3):
+            try:
+                attempts = dict(self._attempts)
+                break
+            except RuntimeError:
+                continue
+        return {
+            "base_path": str(self.base_path),
+            "model": name,
+            "loaded": sorted(self.registry.models().get(name, ())),
+            "on_disk_ready": list(self._last_ready),
+            "blacklisted": sorted(self._blacklisted),
+            "pinned": sorted(self._pinned),
+            "failed_attempts": dict(sorted(attempts.items())),
+        }
+
     def poll_once(self) -> None:
         """One reconcile pass: load new ready versions, retire old ones.
 
@@ -212,7 +302,20 @@ class VersionWatcher:
         on_disk = scan_versions(self.base_path)
         loaded = set(self.registry.models().get(name, ()))
 
-        ready = {v: p for v, p in on_disk.items() if _version_ready(p)}
+        ready_on_disk = {v: p for v, p in on_disk.items() if _version_ready(p)}
+        # Cached for snapshot(): the monitoring surfaces report what THIS
+        # pass saw instead of re-scanning the base path per scrape. The
+        # cache deliberately includes blacklisted versions — "the bad dir
+        # still sits ready on disk" is exactly the state worth seeing.
+        self._last_ready = tuple(sorted(ready_on_disk))
+        # Blacklisted versions (lifecycle rollback) never re-enter the
+        # candidate set, however ready their directories look — without
+        # this, a rolled-back version would be hot-loaded straight back
+        # on the next scan.
+        ready = {
+            v: p for v, p in ready_on_disk.items()
+            if v not in self._blacklisted
+        }
         candidates = sorted(ready, reverse=True)[: self.config.keep_versions]
         for version in sorted(v for v in candidates if v not in loaded):
             if self._stop.is_set():
@@ -270,7 +373,24 @@ class VersionWatcher:
         # Pins follow the registry's LIVE label state (runtime retargets
         # release old pins) plus not-yet-seeded startup labels.
         loaded = set(self.registry.models().get(name, ()))
-        pinned = set(self.registry.labels(name).values()) | {
+        # Defensive sweep: a blacklisted version that is somehow still
+        # loaded (blacklisted externally, or loaded by another control
+        # path) is retired now — the blacklist means "do not serve".
+        for version in sorted(loaded & self._blacklisted):
+            try:
+                self.registry.unload(name, version)
+            except KeyError:
+                # The lifecycle thread's retire() unloaded it between
+                # this pass's registry read and now — already gone is
+                # the goal state, not a failed pass.
+                pass
+            else:
+                log.info("retired %s v%d (blacklisted)", name, version)
+                self._notify_change(name)
+            loaded.discard(version)
+        pinned = set(self.registry.labels(name).values()) | set(
+            self._pinned
+        ) | {
             v for l, v in self.config.desired_labels
             if l not in self._labels_applied
         }

@@ -2,6 +2,7 @@
 
 from .checkpoint import load_servable, save_servable
 from .data import SyntheticCTRConfig, SyntheticCTRStream, auc
+from .publisher import fine_tune, publish_finetuned
 from .trainer import Trainer, TrainState, bce_with_logits, make_train_step
 
 __all__ = [
@@ -14,4 +15,6 @@ __all__ = [
     "auc",
     "save_servable",
     "load_servable",
+    "fine_tune",
+    "publish_finetuned",
 ]

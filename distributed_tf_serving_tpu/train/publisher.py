@@ -1,0 +1,134 @@
+"""Online fine-tune publisher — the train-side leg of the lifecycle plane
+(serving/lifecycle.py, ISSUE 8).
+
+`fine_tune` continues training FROM a serving servable's current params
+(never from a fresh init: freshness means carrying yesterday's knowledge
+forward) on fresh labeled rows, reusing the exact jitted step
+train/trainer.py serves the from-scratch path with. `publish_finetuned`
+wraps it with the atomic versioned-dir commit (interop/export.py
+publish_version + train/checkpoint.py save_servable), so the serving
+process's version watcher hot-loads the result as the next numeric
+version with no coordination beyond the filesystem contract.
+
+The default data source is the synthetic CTR stream (the in-tree label
+oracle); embedded callers pass `data_fn(step) -> batch` to train on real
+feedback — the /labelz plane joins labels to SCORES, not features, so a
+production fine-tune loop needs a feature log alongside it (README
+"Continuous freshness" notes the gap)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+def fine_tune(
+    servable,
+    steps: int = 200,
+    batch_size: int = 256,
+    learning_rate: float = 1e-4,
+    seed: int = 0,
+    stream_config=None,
+    data_fn=None,
+):
+    """Continue training `servable`'s params for `steps`; returns
+    (new_params, metrics). The servable's own params are deep-copied
+    before the first donating step — the serving registry keeps handing
+    out the originals mid-flight, and donation would delete them under
+    live traffic."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from .. import native
+    from .data import SyntheticCTRConfig, SyntheticCTRStream
+    from .trainer import TrainState, make_train_step
+
+    model = servable.model
+    optimizer = optax.adamw(learning_rate)
+    params = jax.tree_util.tree_map(jnp.copy, servable.params)
+    state = TrainState(
+        params=params,
+        opt_state=jax.jit(optimizer.init)(params),
+        step=jnp.asarray(0),
+    )
+    step_fn = make_train_step(model, optimizer)
+    if data_fn is None:
+        stream = SyntheticCTRStream(
+            stream_config
+            or SyntheticCTRConfig(
+                num_fields=model.config.num_fields,
+                id_space=min(1 << 18, model.config.vocab_size),
+                seed=seed,
+            )
+        )
+        # Offset the stream per seed so successive publish rounds train
+        # on FRESH rows, not a replay of the last round's batches.
+        base = (seed + 1) * 1_000_000
+
+        def data_fn(i, _stream=stream, _base=base):  # noqa: A001
+            return _stream.batch(batch_size, _base + i)
+
+    metrics: dict = {}
+    t0 = time.perf_counter()
+    for i in range(steps):
+        raw = data_fn(i)
+        batch = {
+            "feat_ids": native.fold_ids(
+                raw["feat_ids"], model.config.vocab_size
+            ),
+            "feat_wts": raw["feat_wts"],
+            "labels": raw["labels"],
+        }
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(state.params)
+    return state.params, {
+        "steps": steps,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        **{k: float(v) for k, v in metrics.items()},
+    }
+
+
+def publish_finetuned(
+    base_dir,
+    servable,
+    kind: str,
+    steps: int = 200,
+    batch_size: int = 256,
+    learning_rate: float = 1e-4,
+    seed: int = 0,
+    stream_config=None,
+    data_fn=None,
+) -> dict:
+    """fine_tune + atomic publish into the watched base dir as the next
+    numeric version. The checkpoint manifest records a best-guess version
+    number; the DIRECTORY number allocated at commit is authoritative
+    (the version watcher's loader contract), so a publish race that
+    renumbers the landing slot stays correct. Returns a summary dict
+    {version, path, steps, loss, ...}."""
+    from ..interop.export import publish_version
+    from .checkpoint import save_servable
+
+    new_params, metrics = fine_tune(
+        servable,
+        steps=steps,
+        batch_size=batch_size,
+        learning_rate=learning_rate,
+        seed=seed,
+        stream_config=stream_config,
+        data_fn=data_fn,
+    )
+
+    def write(tmp_dir: str) -> None:
+        save_servable(
+            tmp_dir,
+            dataclasses.replace(
+                servable, params=new_params, version=servable.version + 1
+            ),
+            kind=kind,
+        )
+
+    version, path = publish_version(
+        base_dir, write, at_least=servable.version + 1
+    )
+    return {"version": version, "path": path, **metrics}

@@ -416,6 +416,66 @@ class QualityConfig:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class LifecycleConfig:
+    """Continuous-freshness lifecycle knobs (serving/lifecycle.py): the
+    online fine-tune publisher, canary admission ramp, and the drift/AUC
+    auto-rollback controller. Off by default; when off the service pays
+    one attribute read per version resolution (the tracing/cache/overload
+    precedent). Arming it requires --model-base-path (the watched
+    versioned dir is both the publish target and the hot-swap mechanism)
+    and [quality] enabled (the rollback gate reads the quality plane's
+    version-pair drift and per-version label AUC) — build_stack refuses
+    a lifecycle with no signal or no actuator rather than arming a
+    controller that can only ever promote blind."""
+
+    # Master switch: build a LifecycleController and hand it to the impl.
+    enabled: bool = False
+    # Control-loop cadence: the background thread's tick interval, also
+    # the opportunistic-tick spacing on the routing path.
+    tick_interval_s: float = 1.0
+    # Canary admission ramp: probe-lane-only warm phase, then a
+    # deterministic fraction of default-lane traffic stepping up per
+    # dwell until max_fraction.
+    canary_probe_only_s: float = 10.0
+    canary_initial_fraction: float = 0.05
+    canary_ramp_step: float = 0.10
+    canary_step_dwell_s: float = 10.0
+    canary_max_fraction: float = 0.5
+    # Promotion: total healthy CANARY time (past the probe phase) at max
+    # fraction, with at least min_canary_scores windowed canary scores,
+    # before the routing override drops away and latest serves everyone.
+    promote_after_s: float = 60.0
+    min_canary_scores: int = 200
+    # Rollback: version-pair PSI at/above this (0.5 = well past the
+    # "major shift" band — rollback wants stronger evidence than the
+    # quality plane's 0.2 alert), or a windowed label-feedback AUC drop
+    # of at least rollback_auc_drop with min_auc_pairs joined on each
+    # side. The rolled-back state holds rollback_hold_s before the
+    # controller re-arms for the next rollout.
+    rollback_psi: float = 0.5
+    # The rollback PSI is computed over this many MERGED bins, not the
+    # quality plane's fine histogram: a fresh canary's window is small,
+    # and same-distribution PSI over 50 thin bins at a few hundred
+    # samples reads 0.2-0.3 of pure sampling noise (measured) — within
+    # reach of the threshold — while ~10 merged bins put the noise floor
+    # at ~0.03 with a genuine shift still reading >1.
+    rollback_compare_bins: int = 10
+    rollback_auc_drop: float = 0.05
+    min_auc_pairs: int = 100
+    rollback_hold_s: float = 30.0
+    # Fine-tune publisher cadence: every interval (while IDLE), continue
+    # training the stable servable on fresh rows and publish the result
+    # as the next version. 0 = publisher off (canary/rollback still
+    # manage externally published versions).
+    fine_tune_interval_s: float = 0.0
+    fine_tune_steps: int = 200
+    fine_tune_batch_size: int = 256
+    fine_tune_learning_rate: float = 1e-4
+    # Retained transition-event history (/lifecyclez `events`).
+    history_events: int = 64
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -430,6 +490,7 @@ _SECTIONS = {
     "overload": OverloadConfig,
     "utilization": UtilizationConfig,
     "quality": QualityConfig,
+    "lifecycle": LifecycleConfig,
 }
 
 

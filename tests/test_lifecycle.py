@@ -1,0 +1,1023 @@
+"""Continuous-freshness lifecycle plane (serving/lifecycle.py, ISSUE 8):
+publish_version allocation + the collision case, watcher blacklist/pin
+semantics and their persistence across reconcile passes, fake-clock state
+machine transitions (adopt/canary/ramp/promote/rollback/dwell), ramp
+math determinism, rollback through a REAL VersionWatcher swap with a
+shifted canary, canary routing through the real PredictionServiceImpl,
+[lifecycle] parsing + the build_stack master switch, disabled-mode
+inertness, and the /lifecyclez + /monitoring?section=lifecycle surfaces."""
+
+import asyncio
+import dataclasses
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_tf_serving_tpu import codec
+from distributed_tf_serving_tpu.interop.export import publish_version
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+from distributed_tf_serving_tpu.serving import DynamicBatcher, PredictionServiceImpl
+from distributed_tf_serving_tpu.serving import lifecycle as lifecycle_mod
+from distributed_tf_serving_tpu.serving.lifecycle import (
+    CANARY,
+    IDLE,
+    PROMOTING,
+    ROLLED_BACK,
+    LifecycleController,
+)
+from distributed_tf_serving_tpu.serving.quality import QualityMonitor
+from distributed_tf_serving_tpu.serving.version_watcher import (
+    VersionWatcher,
+    VersionWatcherConfig,
+    scan_versions,
+)
+from distributed_tf_serving_tpu.utils.config import LifecycleConfig, QualityConfig
+
+F = 6
+VOCAB = 1 << 10
+CFG = ModelConfig(
+    name="DCN", num_fields=F, vocab_size=VOCAB, embed_dim=4,
+    mlp_dims=(8,), num_cross_layers=1, compute_dtype="float32",
+)
+
+
+@pytest.fixture(autouse=True)
+def _drop_active_flag():
+    """Constructing a controller arms the module-level criticality-scan
+    gate; later tests (and later test FILES) must not inherit it."""
+    yield
+    lifecycle_mod.deactivate()
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def servable():
+    model = build_model("dcn", CFG)
+    return Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(F),
+    )
+
+
+def make_arrays(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, 1 << 40, size=(n, F)).astype(np.int64),
+        "feat_wts": rng.rand(n, F).astype(np.float32),
+    }
+
+
+def _dummy_servable(version: int) -> Servable:
+    return Servable(
+        name="DCN", version=version, model=None, params=None, signatures={}
+    )
+
+
+class StubWatcher:
+    """Records the lifecycle control calls; unloads through the registry
+    like the real retire() so the state machine sees versions vanish."""
+
+    base_path = "<stub>"
+
+    def __init__(self, registry):
+        self.registry = registry
+        self.blacklisted: set[int] = set()
+        self.pinned: set[int] = set()
+        self.retired: list[int] = []
+
+    def blacklist(self, v):
+        self.blacklisted.add(int(v))
+
+    def unblacklist(self, v):
+        self.blacklisted.discard(int(v))
+
+    def is_blacklisted(self, v):
+        return int(v) in self.blacklisted
+
+    def pin(self, v):
+        self.pinned.add(int(v))
+
+    def unpin(self, v):
+        self.pinned.discard(int(v))
+
+    def retire(self, v, blacklist=True):
+        if blacklist:
+            self.blacklist(v)
+        self.retired.append(int(v))
+        try:
+            self.registry.unload("DCN", int(v))
+        except KeyError:
+            return False
+        return True
+
+    def snapshot(self):
+        return {
+            "blacklisted": sorted(self.blacklisted),
+            "pinned": sorted(self.pinned),
+        }
+
+
+def make_controller(registry, clock, quality=None, watcher=None, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("tick_interval_s", 0.25)
+    kw.setdefault("canary_probe_only_s", 5.0)
+    kw.setdefault("canary_initial_fraction", 0.25)
+    kw.setdefault("canary_ramp_step", 0.25)
+    kw.setdefault("canary_step_dwell_s", 5.0)
+    kw.setdefault("canary_max_fraction", 0.5)
+    kw.setdefault("promote_after_s", 20.0)
+    kw.setdefault("min_canary_scores", 50)
+    kw.setdefault("rollback_psi", 0.5)
+    kw.setdefault("rollback_auc_drop", 0.05)
+    kw.setdefault("min_auc_pairs", 10)
+    kw.setdefault("rollback_hold_s", 30.0)
+    return LifecycleController(
+        LifecycleConfig(**kw),
+        registry=registry,
+        model_name="DCN",
+        watcher=watcher,
+        quality=quality,
+        clock=clock,
+    )
+
+
+def make_monitor(clock=None, **kw):
+    kw.setdefault("window_s", 600.0)
+    kw.setdefault("slices", 6)
+    kw.setdefault("drift_check_interval_s", 0.0)
+    kw.setdefault("min_drift_count", 10)
+    if clock is not None:
+        kw["clock"] = clock
+    return QualityMonitor(**kw)
+
+
+# --------------------------------------------------------- publish_version
+
+
+def test_publish_version_allocates_monotonic_numbers(tmp_path):
+    def writer(payload):
+        def write(tmp):
+            os.makedirs(tmp)
+            (pathlib.Path(tmp) / "artifact").write_text(payload)
+        return write
+
+    v1, p1 = publish_version(tmp_path, writer("a"))
+    v2, p2 = publish_version(tmp_path, writer("b"))
+    assert (v1, v2) == (1, 2)
+    assert (pathlib.Path(p2) / "artifact").read_text() == "b"
+    # at_least skips ahead (a publisher that knows about in-memory
+    # versions the dir has not seen yet).
+    v5, _ = publish_version(tmp_path, writer("c"), at_least=5)
+    assert v5 == 5
+    # No tmp residue, and the watcher's scan sees exactly the landed
+    # numbers (the tmp name is dot-prefixed and non-numeric).
+    assert sorted(scan_versions(tmp_path)) == [1, 2, 5]
+    assert not [d for d in os.listdir(tmp_path) if d.startswith(".tmp")]
+
+
+def test_publish_version_collision_reallocates(tmp_path, monkeypatch):
+    """Two publishers racing the same number: the loser's rename fails on
+    the winner's landed (non-empty) dir, and the allocator retries under
+    the next number with the SAME written artifact."""
+    real_rename = os.rename
+    state = {"raced": False}
+
+    def racing_rename(src, dst):
+        if not state["raced"] and os.sep + "1" == dst[-2:]:
+            state["raced"] = True
+            os.makedirs(dst)
+            (pathlib.Path(dst) / "winner").write_text("w")  # non-empty
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", racing_rename)
+
+    def write(tmp):
+        os.makedirs(tmp)
+        (pathlib.Path(tmp) / "artifact").write_text("loser")
+
+    version, path = publish_version(tmp_path, write)
+    assert state["raced"]
+    assert version == 2  # reallocated past the winner
+    assert (pathlib.Path(path) / "artifact").read_text() == "loser"
+    assert (tmp_path / "1" / "winner").read_text() == "w"  # winner intact
+
+
+def test_publish_version_surfaces_real_failures(tmp_path):
+    def write(tmp):
+        pass  # writer never creates the artifact dir
+
+    with pytest.raises(RuntimeError, match="did not create"):
+        publish_version(tmp_path, write)
+
+
+# ------------------------------------------------- watcher blacklist / pin
+
+
+def _fake_version(base: pathlib.Path, v: int) -> None:
+    d = base / str(v)
+    (d / "params").mkdir(parents=True)
+    (d / "servable.json").write_text("{}")
+
+
+def _fake_loader(version, path):
+    return _dummy_servable(version)
+
+
+def make_watcher(tmp_path, registry, keep_versions=2):
+    return VersionWatcher(
+        tmp_path, registry,
+        VersionWatcherConfig(
+            poll_interval_s=3600, model_name="DCN",
+            keep_versions=keep_versions,
+        ),
+        loader=_fake_loader,
+    )
+
+
+def test_blacklist_excluded_from_reconcile_until_cleared(tmp_path):
+    registry = ServableRegistry()
+    watcher = make_watcher(tmp_path, registry)
+    _fake_version(tmp_path, 1)
+    _fake_version(tmp_path, 2)
+    watcher.poll_once()
+    assert registry.models()["DCN"] == [1, 2]
+
+    assert watcher.retire(2) is True
+    assert registry.models()["DCN"] == [1]
+    # Persistence across reconcile passes: v2's directory is still on
+    # disk and still probes ready, but the blacklist keeps it out of the
+    # candidate set — the standing "rolled-back version reloads next
+    # scan" hazard this API exists to fix.
+    for _ in range(3):
+        watcher.poll_once()
+        assert registry.models()["DCN"] == [1]
+    snap = watcher.snapshot()
+    assert snap["blacklisted"] == [2]
+    assert 2 in snap["on_disk_ready"]
+
+    watcher.unblacklist(2)
+    watcher.poll_once()
+    assert registry.models()["DCN"] == [1, 2]
+
+
+def test_blacklisted_loaded_version_is_swept(tmp_path):
+    """A version blacklisted while still loaded (external control path)
+    is retired by the next reconcile — blacklist means 'do not serve'."""
+    registry = ServableRegistry()
+    watcher = make_watcher(tmp_path, registry)
+    _fake_version(tmp_path, 1)
+    _fake_version(tmp_path, 2)
+    watcher.poll_once()
+    watcher.blacklist(2)
+    watcher.poll_once()
+    assert registry.models()["DCN"] == [1]
+
+
+def test_pin_exempts_from_retention(tmp_path):
+    registry = ServableRegistry()
+    watcher = make_watcher(tmp_path, registry, keep_versions=2)
+    for v in (1, 2):
+        _fake_version(tmp_path, v)
+    watcher.poll_once()
+    watcher.pin(1)
+    _fake_version(tmp_path, 3)
+    watcher.poll_once()
+    # keep_versions=2 would retire v1; the pin holds it (the canary's
+    # rollback target must outlive newer rollouts).
+    assert registry.models()["DCN"] == [1, 2, 3]
+    watcher.unpin(1)
+    watcher.poll_once()
+    assert registry.models()["DCN"] == [2, 3]
+
+
+# ------------------------------------------------ state machine, fake clock
+
+
+def test_adopts_latest_as_stable_without_canary_phase():
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    registry.load(_dummy_servable(2))
+    ctrl = make_controller(registry, clock)
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    # Both versions predate the controller: the latest is ALREADY the
+    # serving version, so routing it down to v1 would be a regression,
+    # not a canary.
+    assert snap["state"] == IDLE and snap["stable_version"] == 2
+    assert ctrl.route(None) is None
+
+
+def test_canary_entry_probe_first_then_ramp_then_promote():
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    watcher = StubWatcher(registry)
+    quality = make_monitor()
+    ctrl = make_controller(registry, clock, quality=quality, watcher=watcher)
+    ctrl.tick()
+    assert ctrl.snapshot()["stable_version"] == 1
+
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == CANARY and snap["canary_version"] == 2
+    assert watcher.pinned == {1}  # rollback target pinned
+
+    # Probe phase: probe lane routes canary, default lane all-stable.
+    assert all(ctrl.route("probe") == 2 for _ in range(5))
+    assert all(ctrl.route(None) == 1 for _ in range(5))
+    assert ctrl.snapshot()["canary_fraction"] == 0.0
+
+    # Identical windowed distributions on both sides: healthy evidence.
+    rng = np.random.RandomState(0)
+    quality.observe("DCN", 1, rng.uniform(0.4, 0.6, 300))
+    quality.observe("DCN", 2, rng.uniform(0.4, 0.6, 300))
+
+    clock.advance(5.5)  # past probe_only_s
+    ctrl.tick()
+    assert ctrl.snapshot()["canary_fraction"] == pytest.approx(0.25)
+    clock.advance(5.0)  # one dwell -> one ramp step, capped at max 0.5
+    ctrl.tick()
+    assert ctrl.snapshot()["canary_fraction"] == pytest.approx(0.5)
+    clock.advance(5.0)
+    ctrl.tick()
+    assert ctrl.snapshot()["canary_fraction"] == pytest.approx(0.5)  # cap
+
+    # Healthy dwell at max fraction -> promote.
+    clock.advance(15.0)  # elapsed >= probe_only + promote_after
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == PROMOTING
+    assert snap["stable_version"] == 2 and snap["canary_version"] is None
+    assert snap["counters"]["promotes"] == 1
+    assert watcher.pinned == set()  # rollback pin released
+    assert ctrl.route(None) is None  # override gone: latest serves all
+    clock.advance(1.0)
+    ctrl.tick()
+    assert ctrl.snapshot()["state"] == IDLE
+
+
+def test_ramp_math_routes_exact_fraction():
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    ctrl = make_controller(
+        registry, clock, quality=make_monitor(),
+        canary_probe_only_s=0.0, canary_initial_fraction=0.25,
+        tick_interval_s=1e9,  # no opportunistic ticks mid-count
+    )
+    ctrl.tick()
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    clock.advance(0.1)
+    ctrl.tick()
+    assert ctrl.snapshot()["canary_fraction"] == pytest.approx(0.25)
+    routes = [ctrl.route(None) for _ in range(100)]
+    # Deterministic counter ramp: floor(k*f) advances exactly f of the
+    # time — no RNG, no burstiness beyond 1/f spacing.
+    assert routes.count(2) == 25 and routes.count(1) == 75
+    counters = ctrl.snapshot()["counters"]
+    assert counters["routed_canary"] == 25
+    assert counters["routed_stable"] == 75
+
+
+def test_quality_less_mechanics_mode_promotes_on_dwell():
+    """quality=None (the bench's hot-swap mechanics mode): the verdict is
+    'no_signal' and promotion rests on the dwell alone — it must not be
+    mistaken for 'insufficient evidence' and wedge in CANARY forever."""
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    ctrl = make_controller(registry, clock, quality=None)
+    ctrl.tick()
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    assert ctrl.snapshot()["state"] == CANARY
+    clock.advance(30.0)  # past probe_only + the full ramp
+    ctrl.tick()  # reaches max fraction: the AT-CEILING dwell starts here
+    assert ctrl.snapshot()["state"] == CANARY
+    clock.advance(20.5)  # promote_after_s measured at the ceiling
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == PROMOTING and snap["stable_version"] == 2
+    assert snap["last_judgment"]["verdict"] == "no_signal"
+
+
+def test_full_fraction_starved_stable_still_promotes():
+    """canary_max_fraction 1.0 routes EVERYTHING to the canary, so the
+    stable window drains and pair evidence becomes unobtainable — the
+    judge must read that as 'stable starved, promote on dwell + canary
+    volume', not wedge in CANARY forever waiting for a comparison that
+    can never arrive."""
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    quality = make_monitor()
+    ctrl = make_controller(
+        registry, clock, quality=quality,
+        canary_probe_only_s=0.0, canary_initial_fraction=1.0,
+        canary_max_fraction=1.0, promote_after_s=10.0,
+    )
+    ctrl.tick()
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    # Only the canary sees traffic; the stable side never accumulates.
+    quality.observe("DCN", 2, np.random.RandomState(0).uniform(0.4, 0.6, 300))
+    clock.advance(0.5)
+    ctrl.tick()  # at the ceiling: dwell starts
+    assert ctrl.snapshot()["canary_fraction"] == pytest.approx(1.0)
+    assert ctrl.snapshot()["state"] == CANARY
+    clock.advance(10.5)
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == PROMOTING and snap["stable_version"] == 2
+    assert snap["last_judgment"]["reason"] == "stable_starved"
+
+
+def test_starved_stable_below_full_ceiling_stays_insufficient():
+    """The stable-starved escape only applies at a ~1.0 ramp ceiling
+    (starvation by construction). At a partial ceiling a starved stable
+    just means low traffic — promoting there would skip the pair
+    comparison entirely."""
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    quality = make_monitor()
+    ctrl = make_controller(registry, clock, quality=quality)  # max 0.5
+    ctrl.tick()
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    rng = np.random.RandomState(0)
+    quality.observe("DCN", 2, rng.uniform(0.4, 0.6, 300))
+    quality.observe("DCN", 1, rng.uniform(0.4, 0.6, 10))  # starved
+    clock.advance(1000.0)
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == CANARY
+    assert snap["last_judgment"]["verdict"] == "insufficient"
+
+
+def test_keep_versions_one_refused_at_construction():
+    """keep_versions=1 would let the watcher retire the rollback target
+    in the same poll pass that loads the canary — refused up front."""
+    registry = ServableRegistry()
+
+    class W(StubWatcher):
+        class config:  # noqa: N801 — mimics VersionWatcherConfig
+            keep_versions = 1
+
+    with pytest.raises(ValueError, match="keep_versions"):
+        make_controller(registry, FakeClock(), watcher=W(registry))
+
+
+def test_restart_after_detached_stop_mints_fresh_loop():
+    """start() after stop() must not revive an orphaned loop: each start
+    mints a fresh stop event and the old generation's event stays set."""
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    ctrl = make_controller(registry, FakeClock(), quality=make_monitor())
+    ctrl.start()
+    first_evt = ctrl._stop
+    ctrl.stop()
+    assert first_evt.is_set()
+    ctrl.start()
+    try:
+        assert ctrl._stop is not first_evt and not ctrl._stop.is_set()
+        # The old generation's publish path answers to ITS OWN event.
+        assert ctrl.publish_once(first_evt) is None
+        assert ctrl.snapshot()["counters"]["publishes"] == 0
+    finally:
+        ctrl.stop()
+
+
+def test_insufficient_canary_evidence_never_promotes():
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    quality = make_monitor()
+    ctrl = make_controller(registry, clock, quality=quality)
+    ctrl.tick()
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    quality.observe("DCN", 1, np.random.RandomState(0).uniform(0.4, 0.6, 300))
+    # Canary never crosses min_canary_scores: dwell alone must not promote.
+    quality.observe("DCN", 2, np.random.RandomState(1).uniform(0.4, 0.6, 10))
+    clock.advance(1000.0)
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == CANARY
+    assert snap["last_judgment"]["verdict"] == "insufficient"
+
+
+def test_rollback_on_pair_psi():
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    watcher = StubWatcher(registry)
+    quality = make_monitor()
+    ctrl = make_controller(
+        registry, clock, quality=quality, watcher=watcher, rollback_hold_s=7.0
+    )
+    ctrl.tick()
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    rng = np.random.RandomState(0)
+    quality.observe("DCN", 1, rng.uniform(0.4, 0.6, 300))
+    quality.observe("DCN", 2, rng.uniform(0.9, 1.0, 300))  # shifted canary
+    clock.advance(0.5)
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == ROLLED_BACK
+    assert snap["counters"]["rollbacks"] == 1
+    assert snap["last_rollback"]["reason"] == "psi"
+    assert snap["last_rollback"]["pair"]["psi"] >= 0.5
+    assert watcher.blacklisted == {2} and watcher.retired == [2]
+    assert registry.models()["DCN"] == [1]  # traffic snapped back
+    assert ctrl.route(None) is None and ctrl.route("probe") is None
+    # Hold, then re-arm; the blacklisted version must never re-enter
+    # canary even if something loads it again.
+    clock.advance(7.5)
+    ctrl.tick()
+    assert ctrl.snapshot()["state"] == IDLE
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    assert ctrl.snapshot()["state"] == IDLE  # blacklist guard
+
+
+def test_small_canary_window_noise_does_not_roll_back():
+    """A fresh canary's window is SMALL; same-distribution PSI over the
+    quality plane's 50 fine bins at ~150 samples reads past a 0.4
+    rollback threshold on pure sampling noise. The gate compares
+    COARSENED bins (rollback_compare_bins), which must keep the healthy
+    canary alive while the fine-bin number demonstrates the hazard."""
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    quality = make_monitor()
+    ctrl = make_controller(
+        registry, clock, quality=quality, rollback_psi=0.4,
+        min_canary_scores=120,
+    )
+    ctrl.tick()
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    rng = np.random.RandomState(0)
+    same_dist = lambda n: np.clip(rng.normal(0.5, 0.08, n), 0.0, 1.0)  # noqa: E731
+    quality.observe("DCN", 1, same_dist(8000))
+    quality.observe("DCN", 2, same_dist(150))
+    # The hazard is real: the RAW fine-bin pair PSI crosses the
+    # threshold on sampling noise alone...
+    fine = quality.pair_drift("DCN", 1, 2, min_count=120)
+    assert fine["psi"] >= 0.4
+    # ...but the decision-grade coarsened comparison does not, and the
+    # controller keeps the healthy canary.
+    coarse = quality.pair_drift("DCN", 1, 2, min_count=120, decision_bins=10)
+    assert coarse["psi"] < 0.2 and coarse["bins"] == 10
+    clock.advance(0.5)
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == CANARY and snap["counters"]["rollbacks"] == 0
+    # A genuine shift still rolls back through the same coarsened gate.
+    quality.observe("DCN", 2, rng.uniform(0.9, 1.0, 150))
+    clock.advance(0.5)
+    ctrl.tick()
+    assert ctrl.snapshot()["state"] == ROLLED_BACK
+
+
+def test_rollback_on_auc_drop():
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    watcher = StubWatcher(registry)
+    quality = make_monitor()
+    ctrl = make_controller(
+        registry, clock, quality=quality, watcher=watcher,
+        rollback_psi=100.0,  # isolate the AUC gate
+        min_auc_pairs=10,
+    )
+    ctrl.tick()
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    rng = np.random.RandomState(0)
+    # Same score DISTRIBUTION both sides (pair PSI ~ 0)...
+    quality.observe("DCN", 1, rng.uniform(0.3, 0.7, 300))
+    quality.observe("DCN", 2, rng.uniform(0.3, 0.7, 300))
+    # ...but the stable ranks labels perfectly and the canary inverts
+    # them: scores carry the same shape with opposite meaning.
+    now = quality._clock()
+    for i in range(20):
+        score = 0.3 + 0.4 * i / 19
+        quality._labels.put(f"s{i}", "DCN", 1, score, now)
+        quality._labels.ingest(f"s{i}", 1.0 if score > 0.5 else 0.0)
+        quality._labels.put(f"c{i}", "DCN", 2, score, now)
+        quality._labels.ingest(f"c{i}", 0.0 if score > 0.5 else 1.0)
+    s_auc, s_n = quality.version_auc("DCN", 1)
+    c_auc, c_n = quality.version_auc("DCN", 2)
+    assert s_n == 20 and c_n == 20 and s_auc > 0.9 and c_auc < 0.1
+    clock.advance(0.5)
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == ROLLED_BACK
+    assert snap["last_rollback"]["reason"] == "auc"
+    assert watcher.blacklisted == {2}
+
+
+def test_canary_vanishing_externally_returns_to_idle():
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(_dummy_servable(1))
+    ctrl = make_controller(registry, clock, quality=make_monitor())
+    ctrl.tick()
+    registry.load(_dummy_servable(2))
+    ctrl.tick()
+    assert ctrl.snapshot()["state"] == CANARY
+    registry.unload("DCN", 2)  # operator/reload-config retired it
+    clock.advance(0.5)
+    ctrl.tick()
+    snap = ctrl.snapshot()
+    assert snap["state"] == IDLE and snap["counters"]["rollbacks"] == 0
+
+
+# ------------------------------------- real watcher swap, shifted canary
+
+
+def test_rollback_through_real_watcher_swap(tmp_path, servable):
+    """The end-to-end actuator path, mirroring test_quality's version-pair
+    fixture: a REAL VersionWatcher hot-loads v2 next to v1 from disk,
+    real traffic through a REAL batcher feeds both versions' sketches, a
+    shifted canary drives pair PSI past the rollback threshold, and the
+    controller retires + blacklists v2 — with the on-disk directory still
+    ready, subsequent reconcile passes must NOT reload it."""
+    from distributed_tf_serving_tpu.serving.server import _servable_change_hook
+    from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+    clock = FakeClock()
+    monitor = make_monitor()
+    registry = ServableRegistry()
+    save_servable(tmp_path / "1", servable, kind="dcn")
+    watcher = VersionWatcher(
+        tmp_path, registry,
+        VersionWatcherConfig(poll_interval_s=3600, model_name="DCN"),
+        on_servable_change=_servable_change_hook(None, monitor),
+    )
+    watcher.poll_once()
+    ctrl = make_controller(
+        registry, clock, quality=monitor, watcher=watcher,
+        canary_probe_only_s=0.0, min_canary_scores=20, rollback_psi=0.3,
+    )
+    ctrl.tick()
+    assert ctrl.snapshot()["stable_version"] == 1
+
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0, quality=monitor).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    impl.lifecycle = ctrl
+    try:
+        arrays = make_arrays(20, seed=3)
+        sv1 = registry.resolve("DCN")
+        for _ in range(3):
+            batcher.submit(sv1, arrays).result(timeout=30)
+
+        save_servable(
+            tmp_path / "2", dataclasses.replace(servable, version=2), kind="dcn"
+        )
+        watcher.poll_once()
+        ctrl.tick()
+        assert ctrl.snapshot()["state"] == CANARY
+        # Probe-lane traffic executes under the canary servable, feeding
+        # its sketch through the REAL completer path.
+        req = apis.PredictRequest()
+        req.model_spec.name = "DCN"
+        for k, arr in arrays.items():
+            codec.from_ndarray(arr, use_tensor_content=True, out=req.inputs[k])
+        resp = impl.predict(req, criticality="probe")
+        assert resp.model_spec.version.value == 2
+        resp = impl.predict(req)  # default lane, probe phase: stable
+        assert resp.model_spec.version.value == 1
+
+        # Identical params so far (pair PSI ~ 0): now the canary's scores
+        # SHIFT (the poisoned-rollout scenario the quality fixture pins).
+        monitor.observe("DCN", 2, np.random.RandomState(5).uniform(0.9, 1.0, 200))
+        clock.advance(0.5)
+        ctrl.tick()
+        snap = ctrl.snapshot()
+        assert snap["state"] == ROLLED_BACK
+        assert registry.models()["DCN"] == [1]
+        assert watcher.is_blacklisted(2)
+
+        # THE hazard this plane fixes: tmp_path/2 is still on disk and
+        # still probes ready — reconcile must not bring it back.
+        for _ in range(2):
+            watcher.poll_once()
+            assert registry.models()["DCN"] == [1]
+
+        # Zero failed requests attributable to the swap: traffic keeps
+        # serving v1 through the same impl.
+        resp = impl.predict(req, criticality="probe")
+        assert resp.model_spec.version.value == 1
+    finally:
+        batcher.stop()
+
+
+# --------------------------------------------- config, build_stack, REST
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "cfg.toml"
+    p.write_text(text)
+    return str(p)
+
+
+def test_lifecycle_config_parsing(tmp_path):
+    from distributed_tf_serving_tpu.utils.config import load_config
+
+    cfgs = load_config(_write(tmp_path, """
+[lifecycle]
+enabled = true
+canary_probe_only_s = 2.5
+canary_max_fraction = 0.4
+rollback_psi = 0.35
+fine_tune_interval_s = 900.0
+fine_tune_steps = 64
+"""))
+    lc = cfgs["lifecycle"]
+    assert lc.enabled is True
+    assert lc.canary_probe_only_s == 2.5
+    assert lc.canary_max_fraction == 0.4
+    assert lc.rollback_psi == 0.35
+    assert lc.fine_tune_interval_s == 900.0
+    assert lc.fine_tune_steps == 64
+    # Defaults present when the section is absent.
+    assert load_config(_write(tmp_path, ""))["lifecycle"].enabled is False
+    with pytest.raises(ValueError, match="unknown LifecycleConfig keys"):
+        load_config(_write(tmp_path, "[lifecycle]\nbogus = 1\n"))
+
+
+def test_build_stack_lifecycle_master_switch(tmp_path):
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import ServerConfig
+
+    cfg = ServerConfig(model_name="DCN", buckets=(32,), warmup=False)
+    base = tmp_path / "versions"
+    base.mkdir()
+    # Armed: watcher mode + quality -> a controller lands on the impl.
+    _registry, batcher, impl, _sv, _mesh, watcher = build_stack(
+        cfg,
+        model_base_path=str(base),
+        model_config=CFG,
+        quality_config=QualityConfig(enabled=True, reference_file=""),
+        lifecycle_config=LifecycleConfig(enabled=True),
+    )
+    try:
+        assert impl.lifecycle is not None
+        assert impl.lifecycle.model == "DCN"
+        assert impl.lifecycle.watcher is watcher
+        assert impl.lifecycle.quality is batcher.quality is not None
+        assert impl.version_watcher is watcher
+    finally:
+        watcher.stop()
+        batcher.stop()
+        lifecycle_mod.deactivate()
+
+    # Master switch off: nothing armed, one attribute read per resolve.
+    _r, batcher2, impl2, _s, _m, watcher2 = build_stack(
+        cfg,
+        model_base_path=str(base),
+        model_config=CFG,
+        lifecycle_config=LifecycleConfig(enabled=False),
+    )
+    try:
+        assert impl2.lifecycle is None
+    finally:
+        watcher2.stop()
+        batcher2.stop()
+
+    # Enabled without the watcher mode / without the signal: refused at
+    # build, before any thread exists.
+    with pytest.raises(ValueError, match="model-base-path"):
+        build_stack(
+            cfg,
+            quality_config=QualityConfig(enabled=True, reference_file=""),
+            lifecycle_config=LifecycleConfig(enabled=True),
+        )
+    with pytest.raises(ValueError, match="quality"):
+        build_stack(
+            cfg,
+            model_base_path=str(base),
+            lifecycle_config=LifecycleConfig(enabled=True),
+        )
+
+
+def test_disabled_mode_inert(servable):
+    """No controller: resolution pays one attribute read, the routing
+    helper answers None for everything, and the criticality-scan gate
+    stays down."""
+    lifecycle_mod.deactivate()
+    registry = ServableRegistry()
+    registry.load(servable)
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        impl = PredictionServiceImpl(registry, batcher)
+        assert impl.lifecycle is None
+        assert impl.lifecycle_stats() is None
+        assert impl.lifecycle_route("DCN", None, None, None) is None
+        assert not lifecycle_mod.active()
+        req = apis.PredictRequest()
+        req.model_spec.name = "DCN"
+        for k, arr in make_arrays(4).items():
+            codec.from_ndarray(arr, use_tensor_content=True, out=req.inputs[k])
+        resp = impl.predict(req)
+        assert resp.model_spec.version.value == 1
+    finally:
+        batcher.stop()
+
+
+def _run_rest(impl, handler):
+    """Run one aiohttp handler round against a live gateway."""
+    aiohttp = pytest.importorskip("aiohttp")  # noqa: F841
+
+    from distributed_tf_serving_tpu.serving.rest import start_rest_gateway
+
+    async def go():
+        import aiohttp as aio
+
+        runner, port = await start_rest_gateway(impl, port=0)
+        try:
+            async with aio.ClientSession(f"http://127.0.0.1:{port}") as s:
+                return await handler(s)
+        finally:
+            await runner.cleanup()
+
+    return asyncio.run(go())
+
+
+def test_lifecyclez_route_armed_and_disabled(servable):
+    registry = ServableRegistry()
+    registry.load(servable)
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        impl = PredictionServiceImpl(registry, batcher)
+
+        async def disabled(s):
+            async with s.get("/lifecyclez") as r:
+                return r.status, await r.json()
+
+        status, body = _run_rest(impl, disabled)
+        assert status == 200 and body == {"enabled": False}
+
+        clock = FakeClock()
+        ctrl = make_controller(registry, clock, quality=make_monitor())
+        ctrl.tick()
+        impl.lifecycle = ctrl
+        impl.version_watcher = StubWatcher(registry)
+
+        async def armed(s):
+            async with s.get("/lifecyclez") as r:
+                lz = await r.json()
+            async with s.get("/monitoring?section=lifecycle") as r:
+                sec = await r.json()
+            async with s.get("/monitoring") as r:
+                mon = await r.json()
+            async with s.get("/monitoring/prometheus/metrics") as r:
+                prom = await r.text()
+            return lz, sec, mon, prom
+
+        lz, sec, mon, prom = _run_rest(impl, armed)
+        assert lz["enabled"] is True and lz["state"] == IDLE
+        assert lz["stable_version"] == 1
+        assert set(sec) == {"lifecycle"} and sec["lifecycle"]["enabled"]
+        assert mon["lifecycle"]["state"] == IDLE
+        # The watcher's own surface rides /monitoring independently of
+        # the controller (blacklist/pin are operator-callable alone).
+        assert mon["versions"] == {"blacklisted": [], "pinned": []}
+        assert 'dts_tpu_lifecycle_state{state="idle"} 1' in prom
+        assert "dts_tpu_lifecycle_routed_total" in prom
+    finally:
+        batcher.stop()
+
+
+def test_route_through_impl_respects_explicit_pins(servable):
+    """Explicit version/label pins are the client's choice: the canary
+    router must only ever touch DEFAULT resolutions."""
+    registry = ServableRegistry()
+    registry.load(servable)
+    registry.load(dataclasses.replace(servable, version=2))
+    registry.set_label("DCN", "stable", 1)
+    clock = FakeClock()
+    ctrl = make_controller(
+        registry, clock, quality=make_monitor(), canary_probe_only_s=0.0,
+        canary_initial_fraction=1.0, canary_max_fraction=1.0,
+    )
+    # Adopt v1 as stable FIRST, then v2 arrives as a canary routed at
+    # fraction 1.0 — every default resolution goes canary.
+    registry.unload("DCN", 2)
+    ctrl.tick()
+    registry.load(dataclasses.replace(servable, version=2))
+    ctrl.tick()
+    clock.advance(0.5)
+    ctrl.tick()
+    assert ctrl.snapshot()["canary_fraction"] == pytest.approx(1.0)
+
+    batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+    try:
+        impl = PredictionServiceImpl(registry, batcher)
+        impl.lifecycle = ctrl
+        arrays = make_arrays(4)
+        req = apis.PredictRequest()
+        req.model_spec.name = "DCN"
+        for k, arr in arrays.items():
+            codec.from_ndarray(arr, use_tensor_content=True, out=req.inputs[k])
+        assert impl.predict(req).model_spec.version.value == 2  # routed
+        pinned = apis.PredictRequest()
+        pinned.CopyFrom(req)
+        pinned.model_spec.version.value = 1
+        assert impl.predict(pinned).model_spec.version.value == 1
+        labeled = apis.PredictRequest()
+        labeled.CopyFrom(req)
+        labeled.model_spec.version_label = "stable"
+        assert impl.predict(labeled).model_spec.version.value == 1
+    finally:
+        batcher.stop()
+
+
+def test_fine_tune_publisher_counts_and_events(tmp_path, servable):
+    """publish_once through the injected publisher: counters + events
+    move, failures count without raising."""
+    clock = FakeClock()
+    registry = ServableRegistry()
+    registry.load(servable)
+    calls = {"n": 0}
+
+    def fake_publisher():
+        calls["n"] += 1
+        return {"version": 2, "path": str(tmp_path / "2")}
+
+    ctrl = make_controller(registry, clock, quality=make_monitor())
+    ctrl.publisher = fake_publisher
+    assert ctrl.publish_once() == {"version": 2, "path": str(tmp_path / "2")}
+    assert ctrl.snapshot()["counters"]["publishes"] == 1
+
+    def failing_publisher():
+        raise RuntimeError("trainer exploded")
+
+    ctrl.publisher = failing_publisher
+    assert ctrl.publish_once() is None
+    counters = ctrl.snapshot()["counters"]
+    assert counters["publishes"] == 1 and counters["publish_failures"] == 1
+
+
+def test_publish_finetuned_lands_loadable_version(tmp_path, servable):
+    """The real train-side publisher: fine_tune continues from the
+    servable's params and the artifact lands as a watcher-loadable
+    numeric version."""
+    from distributed_tf_serving_tpu.train.publisher import publish_finetuned
+
+    summary = publish_finetuned(
+        tmp_path, servable, kind="dcn", steps=3, batch_size=16,
+        learning_rate=1e-4,
+    )
+    assert summary["version"] == 2 and summary["steps"] == 3
+    registry = ServableRegistry()
+    watcher = VersionWatcher(
+        tmp_path, registry,
+        VersionWatcherConfig(poll_interval_s=3600, model_name="DCN"),
+    )
+    watcher.poll_once()
+    assert registry.models()["DCN"] == [2]
+    loaded = registry.resolve("DCN")
+    assert loaded.version == 2
+    # Fine-tuned FROM the serving params, not a fresh init: 3 tiny steps
+    # keep the forward close to the original.
+    arrays = make_arrays(8, seed=9)
+    from distributed_tf_serving_tpu import native
+
+    batch = {
+        "feat_ids": native.fold_ids(arrays["feat_ids"], VOCAB),
+        "feat_wts": arrays["feat_wts"],
+    }
+    base = np.asarray(servable.model.apply(servable.params, batch)["prediction_node"])
+    tuned = np.asarray(loaded.model.apply(loaded.params, batch)["prediction_node"])
+    assert float(np.max(np.abs(base - tuned))) < 0.2

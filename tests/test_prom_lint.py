@@ -1,8 +1,8 @@
 """Prometheus exposition lint (tools/check_prom.py, ISSUE 7 satellite):
-the aggregated /monitoring/prometheus/metrics text is assembled from six
-planes and the lint is what guards the assembly — run it against a FULLY
-ARMED server snapshot (every plane emitting, adversarial label values),
-and prove it actually catches each failure mode it claims to."""
+the aggregated /monitoring/prometheus/metrics text is assembled from
+seven planes and the lint is what guards the assembly — run it against a
+FULLY ARMED server snapshot (every plane emitting, adversarial label
+values), and prove it actually catches each failure mode it claims to."""
 
 import os
 import sys
@@ -26,14 +26,20 @@ from distributed_tf_serving_tpu.utils.metrics import (  # noqa: E402
 
 def _fully_armed_text() -> str:
     """Every plane emitting at once — the worst-case assembly the lint
-    exists to guard: batcher gauges, cache, overload, utilization, and
-    quality series next to the TF-Serving-named families, with
-    adversarial model names exercising the escaping path."""
+    exists to guard: batcher gauges, cache, overload, utilization,
+    quality, and lifecycle series next to the TF-Serving-named families,
+    with adversarial model names exercising the escaping path."""
     from distributed_tf_serving_tpu.cache import ScoreCache
+    from distributed_tf_serving_tpu.models import ServableRegistry
+    from distributed_tf_serving_tpu.serving import lifecycle as lifecycle_mod
     from distributed_tf_serving_tpu.serving.batcher import BatcherStats
+    from distributed_tf_serving_tpu.serving.lifecycle import LifecycleController
     from distributed_tf_serving_tpu.serving.quality import QualityMonitor
     from distributed_tf_serving_tpu.serving.utilization import OccupancyLedger
-    from distributed_tf_serving_tpu.utils.config import OverloadConfig
+    from distributed_tf_serving_tpu.utils.config import (
+        LifecycleConfig,
+        OverloadConfig,
+    )
 
     m = ServerMetrics()
     m.observe("Predict", 0.01, ok=True, model='we"ird\\mo\ndel')
@@ -52,12 +58,20 @@ def _fully_armed_text() -> str:
     quality.pin_reference(save=False)
     quality.observe("DCN", 2, rng.uniform(0.6, 0.9, 200))
     quality.observe('we"ird\\mo\ndel', 1, rng.rand(20))
+    registry = ServableRegistry()
+    lifecycle = LifecycleController(
+        LifecycleConfig(enabled=True), registry=registry,
+        model_name="DCN", quality=quality,
+    )
+    lifecycle.tick()
+    lifecycle_mod.deactivate()  # drop the criticality-scan gate it armed
     return m.prometheus_text(
         stats,
         cache=cache.snapshot(),
         overload=ctrl.snapshot(),
         utilization=ledger.snapshot(),
         quality=quality.snapshot(),
+        lifecycle=lifecycle.snapshot(),
     )
 
 
@@ -68,7 +82,7 @@ def test_fully_armed_snapshot_passes_lint():
     for marker in (
         ":tensorflow:serving:request_count", "dts_tpu_batcher_",
         "dts_tpu_cache_", "dts_tpu_overload_", "dts_tpu_utilization_",
-        "dts_tpu_quality_",
+        "dts_tpu_quality_", "dts_tpu_lifecycle_",
     ):
         assert marker in text
 

@@ -106,4 +106,23 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_QUALITY_SMOKE:-0}" = "1" ]; then
         python tools/soak.py | tee "$QUALITY_LINE" || rc=1
     python tools/check_quality_smoke.py "$QUALITY_LINE" || rc=1
 fi
+
+# Lifecycle smoke (TIER1_LIFECYCLE_SMOKE=1): a SOAK_LIFECYCLE=1 soak —
+# trained model behind a real version watcher + lifecycle controller;
+# the driver publishes a fine-tuned GOOD canary (must auto-promote) and
+# then a POISONED one (must auto-rollback: watcher retires + blacklists
+# it, and the blacklist holds across reconcile passes while the bad dir
+# still sits ready on disk) — with zero failed requests attributable to
+# either swap and the live /lifecyclez + section filter + Prometheus
+# series answering (tools/check_lifecycle_smoke.py). Slightly longer
+# than the other smokes: one run holds a fine-tune, a promote ramp, a
+# rollback, and post-rollback reconcile passes.
+if [ "$rc" -eq 0 ] && [ "${TIER1_LIFECYCLE_SMOKE:-0}" = "1" ]; then
+    LIFECYCLE_LINE="${TIER1_LIFECYCLE_LINE:-/tmp/tier1_lifecycle_soak.json}"
+    echo "tier1: lifecycle smoke (SOAK_LIFECYCLE=1, line $LIFECYCLE_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_LIFECYCLE_SECONDS:-20}" SOAK_LIFECYCLE=1 \
+        python tools/soak.py | tee "$LIFECYCLE_LINE" || rc=1
+    python tools/check_lifecycle_smoke.py "$LIFECYCLE_LINE" || rc=1
+fi
 exit $rc

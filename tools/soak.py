@@ -100,6 +100,28 @@ SOAK_QUALITY_PROM_OUT for the exposition lint — gated in CI by
 tools/check_quality_smoke.py (which also runs tools/check_prom.py on
 the captured text).
 
+Lifecycle mode (SOAK_LIFECYCLE=1): the continuous-freshness plane
+(ISSUE 8, serving/lifecycle.py) end to end against live traffic. The
+soak model trains briefly, lands as version 1 of a WATCHED base dir (a
+real VersionWatcher with a fast poll), and a LifecycleController with
+fast ramp/dwell knobs runs armed on the impl while gRPC workers (one on
+the probe criticality lane) serve a steady payload pool. A driver task
+then (a) fine-tunes and publishes a GOOD canary through
+train/publisher.py::publish_finetuned — the watcher hot-loads it
+mid-traffic, probe-lane then ramped default-lane traffic feeds its
+quality sketches, and the controller auto-PROMOTES it; (b) publishes a
+POISONED canary (params scaled, scores saturate) — version-pair PSI
+crosses the rollback threshold and the controller auto-ROLLS-BACK:
+the watcher retires + blacklists the version, and the soak lets several
+reconcile passes run to prove the blacklist holds while the bad
+directory still sits ready on disk. End probes hit the LIVE /lifecyclez,
+/monitoring?section=lifecycle, and Prometheus surfaces. The JSON line
+gains a `lifecycle` block — promote/rollback counters and waits, final
+loaded versions, blacklist persistence, routed-traffic counters, live
+route/series probes — gated in CI by tools/check_lifecycle_smoke.py
+(promote AND rollback observed, blacklist survived reconcile, ZERO
+failed requests attributable to either swap).
+
 Tracing (SOAK_TRACE_OUT=/path/trace.json): per-request span tracing runs
 for the whole soak (utils/tracing.py; SOAK_TRACE_SAMPLE sets the tail-
 sampling rate, default 0.05 — errors/fault-annotated/slowest-N traces are
@@ -193,7 +215,13 @@ def main() -> None:
     # are the join keys) and no REST mixer (unshifted REST traffic would
     # dilute the drift the gate must observe) unless overridden.
     quality_mode = os.environ.get("SOAK_QUALITY", "0") == "1"
-    if quality_mode:
+    # Lifecycle mode (SOAK_LIFECYCLE=1): trained model behind a REAL
+    # version watcher + lifecycle controller; a driver publishes a good
+    # then a poisoned canary and the controller must promote then roll
+    # back, mid-traffic, with zero failed requests. Small requests and
+    # no REST mixer, like quality mode.
+    lifecycle_mode = os.environ.get("SOAK_LIFECYCLE", "0") == "1"
+    if quality_mode or lifecycle_mode:
         candidates = int(os.environ.get("SOAK_CANDIDATES", "16"))
         grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "4"))
         rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "0"))
@@ -249,7 +277,7 @@ def main() -> None:
     model = build_model("dcn_v2", config)
     quality_monitor = None
     q_window_s = max(seconds * 0.35, 3.0)
-    if quality_mode:
+    if quality_mode or lifecycle_mode:
         # Train briefly on the synthetic stream so the served scores
         # carry REAL signal against the stream's teacher labels — a
         # random-init model would pin the label-feedback AUC at ~0.5 and
@@ -273,22 +301,35 @@ def main() -> None:
             batch_size=256,
         )
         print(
-            f"# quality soak: trained {fit['steps']} steps, "
-            f"loss={fit['loss']:.4f}", file=sys.stderr,
+            f"# {'lifecycle' if lifecycle_mode else 'quality'} soak: "
+            f"trained {fit['steps']} steps, loss={fit['loss']:.4f}",
+            file=sys.stderr,
         )
         params = trainer.snapshot_params()
-        quality_monitor = QualityMonitor(
-            # Short window so the post-shift window is dominated by
-            # shifted traffic well before the soak ends; fast drift
-            # cadence so short CI smokes (~12 s) get several ticks.
-            window_s=q_window_s,
-            slices=4,
-            drift_check_interval_s=max(seconds / 24, 0.25),
-            drift_threshold_psi=float(
-                os.environ.get("SOAK_QUALITY_PSI_THRESHOLD", "0.2")
-            ),
-            exemplar_traces=8,
-        )
+        if lifecycle_mode:
+            # Long window (everything stays in-window for the soak's
+            # horizon): the lifecycle controller reads pair_drift /
+            # version_auc with ITS OWN evidence floor, so the monitor's
+            # drift cadence only feeds the passive surfaces here.
+            quality_monitor = QualityMonitor(
+                window_s=max(seconds, 10.0),
+                slices=4,
+                drift_check_interval_s=0.5,
+                min_drift_count=60,
+            )
+        else:
+            quality_monitor = QualityMonitor(
+                # Short window so the post-shift window is dominated by
+                # shifted traffic well before the soak ends; fast drift
+                # cadence so short CI smokes (~12 s) get several ticks.
+                window_s=q_window_s,
+                slices=4,
+                drift_check_interval_s=max(seconds / 24, 0.25),
+                drift_threshold_psi=float(
+                    os.environ.get("SOAK_QUALITY_PSI_THRESHOLD", "0.2")
+                ),
+                exemplar_traces=8,
+            )
     else:
         params = jax.jit(model.init)(jax.random.PRNGKey(0))
     registry = ServableRegistry()
@@ -296,7 +337,11 @@ def main() -> None:
         name="DCN", version=1, model=model, params=params,
         signatures=ctr_signatures(NUM_FIELDS),
     )
-    registry.load(servable)
+    if not lifecycle_mode:
+        # Lifecycle mode serves through the WATCHED base dir instead: the
+        # trained servable lands as version 1 on disk below, and the real
+        # VersionWatcher loads (and queue-warms) it like production.
+        registry.load(servable)
     score_cache = None
     if cache_mode:
         from distributed_tf_serving_tpu.cache import ScoreCache
@@ -357,21 +402,105 @@ def main() -> None:
         # Counter-track source: a SOAK_TRACE_OUT export then carries the
         # per-device occupancy track next to the request spans.
         tracing_mod.register_counter_source(ledger)
-    buckets = (1024, 2048, 4096, 8192, 16384) if tpu else (1024, 2048)
+    if lifecycle_mode:
+        # One small bucket: three versions each warm the ladder through
+        # the queue mid-soak, and the candidates are 16-row requests.
+        buckets = (64,)
+    else:
+        buckets = (1024, 2048, 4096, 8192, 16384) if tpu else (1024, 2048)
     batcher = DynamicBatcher(
         buckets=buckets, max_wait_us=2000, completion_workers=12,
         score_cache=score_cache, dedup=cache_mode, overload=overload_ctrl,
         utilization=ledger, quality=quality_monitor,
     ).start()
     batcher.max_batch_candidates = buckets[-1]
-    for b in buckets:
-        batcher.warmup(servable, buckets=(b,))
-        batcher.submit(
-            servable,
-            compact_payload(batcher.warmup_arrays(servable, b), config.vocab_size),
-            _warmup=True,
-        ).result(timeout=600)
+    if not lifecycle_mode:
+        for b in buckets:
+            batcher.warmup(servable, buckets=(b,))
+            batcher.submit(
+                servable,
+                compact_payload(batcher.warmup_arrays(servable, b), config.vocab_size),
+                _warmup=True,
+            ).result(timeout=600)
+
+    lifecycle_block: dict = {}
+    lifecycle_ctrl = None
+    lifecycle_watcher = None
+    lc_pool: list = []
+    if lifecycle_mode:
+        import tempfile
+
+        from distributed_tf_serving_tpu.serving.lifecycle import (
+            LifecycleController,
+        )
+        from distributed_tf_serving_tpu.serving.server import (
+            _servable_change_hook,
+        )
+        from distributed_tf_serving_tpu.serving.version_watcher import (
+            VersionWatcher,
+            VersionWatcherConfig,
+        )
+        from distributed_tf_serving_tpu.train.checkpoint import save_servable
+        from distributed_tf_serving_tpu.train.data import SyntheticCTRStream
+        from distributed_tf_serving_tpu.utils.config import LifecycleConfig
+
+        lc_base = tempfile.mkdtemp(prefix="soak_lifecycle_")
+        save_servable(os.path.join(lc_base, "1"), servable, kind="dcn_v2")
+        lifecycle_watcher = VersionWatcher(
+            lc_base, registry,
+            VersionWatcherConfig(
+                poll_interval_s=float(
+                    os.environ.get("SOAK_LIFECYCLE_POLL_S", "0.5")
+                ),
+                model_name="DCN", model_kind="dcn_v2",
+            ),
+            # Queue warmup: each hot-loaded version compiles on the
+            # batching thread BEFORE its registry flip, exactly like the
+            # production server — a canary's first live request must not
+            # pay the jit.
+            warmup=batcher.warmup_via_queue,
+            model_config=config,
+            on_servable_change=_servable_change_hook(None, quality_monitor),
+        ).start()
+        lifecycle_ctrl = LifecycleController(
+            LifecycleConfig(
+                enabled=True,
+                tick_interval_s=0.2,
+                canary_probe_only_s=0.6,
+                canary_initial_fraction=0.25,
+                canary_ramp_step=0.25,
+                canary_step_dwell_s=0.5,
+                canary_max_fraction=0.5,
+                promote_after_s=float(
+                    os.environ.get("SOAK_LIFECYCLE_PROMOTE_AFTER", "2.0")
+                ),
+                min_canary_scores=int(
+                    os.environ.get("SOAK_LIFECYCLE_MIN_SCORES", "120")
+                ),
+                rollback_psi=float(
+                    os.environ.get("SOAK_LIFECYCLE_ROLLBACK_PSI", "0.4")
+                ),
+                rollback_hold_s=0.5,
+            ),
+            registry=registry,
+            model_name="DCN",
+            watcher=lifecycle_watcher,
+            quality=quality_monitor,
+        ).start()
+        # Steady payload pool from the trained distribution: both
+        # versions' sketches fill with in-distribution scores, so a
+        # healthy canary reads as pair PSI ~ 0 and a poisoned one does
+        # not hide behind workload drift.
+        lc_stream = SyntheticCTRStream(stream_cfg)
+        for i in range(int(os.environ.get("SOAK_LIFECYCLE_POOL", "24"))):
+            b = lc_stream.batch(candidates, 5_000 + i)
+            lc_pool.append(
+                {"feat_ids": b["feat_ids"], "feat_wts": b["feat_wts"]}
+            )
     impl = PredictionServiceImpl(registry, batcher)
+    if lifecycle_mode:
+        impl.lifecycle = lifecycle_ctrl
+        impl.version_watcher = lifecycle_watcher
 
     quality_block: dict = {}
     q_pools: dict = {}
@@ -687,6 +816,133 @@ def main() -> None:
             if ln.startswith("dts_tpu_quality_")
         )
 
+    async def lifecycle_worker(client, wid: int):
+        """Steady in-distribution gRPC traffic for lifecycle mode; worker
+        0 rides the probe criticality lane, so a fresh canary gets its
+        first real traffic the moment CANARY is entered."""
+        i = 0
+        while time.perf_counter() < deadline:
+            i += 1
+            payload = lc_pool[(wid * 131 + i) % len(lc_pool)]
+            try:
+                await client.predict(payload, sort_scores=False)
+                counts["grpc_ok"] += 1
+            except PredictClientError as e:
+                note_error("grpc", f"{getattr(e.code, 'name', e.code)}: {e}")
+            except Exception as e:  # noqa: BLE001 — taxonomy, keep soaking
+                note_error("grpc", f"{type(e).__name__}: {e}")
+
+    async def lifecycle_driver():
+        """The scenario script: publish a GOOD fine-tuned canary (must
+        auto-promote), then a POISONED one (must auto-rollback +
+        blacklist), all against live traffic."""
+        import dataclasses as dc
+
+        from distributed_tf_serving_tpu.interop.export import publish_version
+        from distributed_tf_serving_tpu.train.checkpoint import (
+            save_servable as save_ckpt,
+        )
+        from distributed_tf_serving_tpu.train.publisher import (
+            publish_finetuned,
+        )
+
+        loop_ = asyncio.get_running_loop()
+        await asyncio.sleep(
+            seconds * float(os.environ.get("SOAK_LIFECYCLE_PUBLISH_AT", "0.10"))
+        )
+        # --- good canary: the REAL fine-tune publisher path -------------
+        stable_sv = registry.resolve("DCN")
+        good = await loop_.run_in_executor(None, lambda: publish_finetuned(
+            lc_base, stable_sv, kind="dcn_v2",
+            steps=int(os.environ.get("SOAK_LIFECYCLE_FT_STEPS", "25")),
+            batch_size=128, learning_rate=1e-4, seed=1,
+            stream_config=stream_cfg,
+        ))
+        good_v = good["version"]
+        lifecycle_block["published_good"] = {
+            "version": good_v, "steps": good["steps"],
+            "loss": round(good.get("loss", 0.0), 4),
+        }
+        t0 = time.perf_counter()
+        while time.perf_counter() < deadline - seconds * 0.25:
+            snap = lifecycle_ctrl.snapshot()
+            if snap["counters"]["promotes"] >= 1 and snap["state"] == "idle" \
+                    and snap["stable_version"] == good_v:
+                break
+            await asyncio.sleep(0.15)
+        lifecycle_block["promote_wait_s"] = round(time.perf_counter() - t0, 2)
+        lifecycle_block["promoted_version"] = (
+            lifecycle_ctrl.snapshot()["stable_version"]
+        )
+        # --- poisoned canary: params scaled -> saturated scores ---------
+        import jax as jax_mod
+
+        poisoned_sv = registry.resolve("DCN")
+        poisoned_params = jax_mod.tree_util.tree_map(
+            lambda a: a * 1.8, poisoned_sv.params
+        )
+
+        def publish_poisoned():
+            def write(tmp):
+                save_ckpt(
+                    tmp,
+                    dc.replace(
+                        poisoned_sv, params=poisoned_params,
+                        version=good_v + 1,
+                    ),
+                    kind="dcn_v2",
+                )
+            v, p = publish_version(lc_base, write, at_least=good_v + 1)
+            return {"version": v, "path": p}
+
+        bad = await loop_.run_in_executor(None, publish_poisoned)
+        lifecycle_block["published_poisoned"] = {"version": bad["version"]}
+        t0 = time.perf_counter()
+        while time.perf_counter() < deadline - 1.5:
+            if lifecycle_ctrl.snapshot()["counters"]["rollbacks"] >= 1:
+                break
+            await asyncio.sleep(0.15)
+        lifecycle_block["rollback_wait_s"] = round(time.perf_counter() - t0, 2)
+        # Blacklist persistence: the bad version's directory still sits
+        # READY on disk — let several watcher reconcile passes run and
+        # prove it stays retired.
+        await asyncio.sleep(
+            3 * float(os.environ.get("SOAK_LIFECYCLE_POLL_S", "0.5")) + 0.2
+        )
+        post = registry.models().get("DCN", [])
+        lifecycle_block["post_rollback_versions"] = post
+        lifecycle_block["blacklist_survived_reconcile"] = (
+            bad["version"] not in post
+        )
+
+    async def probe_lifecycle(session) -> None:
+        """End-of-run probes against the LIVE surfaces (the bytes an
+        operator's curl would get): /lifecyclez, the ?section= filter,
+        and the dts_tpu_lifecycle_* Prometheus series."""
+        async with session.get("/lifecyclez") as r:
+            lz = await r.json()
+        lifecycle_block["lifecyclez_enabled"] = bool(lz.get("enabled"))
+        lifecycle_block["state"] = lz.get("state")
+        lifecycle_block["stable_version"] = lz.get("stable_version")
+        lifecycle_block["counters"] = lz.get("counters")
+        lifecycle_block["last_rollback"] = lz.get("last_rollback")
+        lifecycle_block["blacklisted"] = (
+            (lz.get("watcher") or {}).get("blacklisted", [])
+        )
+        async with session.get("/monitoring?section=lifecycle") as r:
+            sec = await r.json()
+            lifecycle_block["section_filter_ok"] = (
+                r.status == 200
+                and set(sec) == {"lifecycle"}
+                and bool(sec["lifecycle"].get("enabled"))
+            )
+        async with session.get("/monitoring/prometheus/metrics") as r:
+            prom_text = await r.text()
+        lifecycle_block["prom_lifecycle_series"] = sum(
+            1 for ln in prom_text.splitlines()
+            if ln.startswith("dts_tpu_lifecycle_")
+        )
+
     async def control_worker(gport: int):
         import grpc as grpc_mod
 
@@ -794,6 +1050,17 @@ def main() -> None:
                     )
                     if overload_mode else None
                 )
+                # Lifecycle mode: one worker rides the probe lane — the
+                # canary's first traffic (probe-lane-first admission).
+                probe_client = (
+                    await stack.enter_async_context(
+                        ShardedPredictClient(
+                            [f"127.0.0.1:{gport}"], "DCN",
+                            criticality="probe", **client_kwargs,
+                        )
+                    )
+                    if lifecycle_mode else None
+                )
                 session = await stack.enter_async_context(
                     aiohttp.ClientSession(f"http://127.0.0.1:{rport}")
                 )
@@ -802,13 +1069,24 @@ def main() -> None:
                     # teacher-labeled workload (unshifted mixer traffic
                     # would dilute the drift segment the gate measures)
                     # plus the mid-run reference pin.
-                    data_workers = (
-                        [
+                    if quality_mode:
+                        data_workers = [
                             quality_worker(client, session, w)
                             for w in range(grpc_workers)
                         ] + [quality_pin(session)]
-                        if quality_mode
-                        else [
+                    elif lifecycle_mode:
+                        # The scenario driver rides next to the workers;
+                        # the control-plane label flipper is skipped (it
+                        # pins version 1, which retention legitimately
+                        # retires mid-scenario).
+                        data_workers = [
+                            lifecycle_worker(
+                                probe_client if w == 0 else client, w
+                            )
+                            for w in range(grpc_workers)
+                        ] + [lifecycle_driver()]
+                    else:
+                        data_workers = [
                             grpc_worker(
                                 shed_client
                                 if (shed_client is not None and w % 3 == 2)
@@ -817,12 +1095,11 @@ def main() -> None:
                             )
                             for w in range(grpc_workers)
                         ]
-                    )
                     await asyncio.gather(
                         *data_workers,
                         *(burst_worker(client, w) for w in range(burst_workers)),
                         *(rest_worker(session, w) for w in range(rest_workers)),
-                        control_worker(gport),
+                        *([] if lifecycle_mode else [control_worker(gport)]),
                     )
                 finally:
                     resilience.update(client.resilience_counters())
@@ -846,6 +1123,11 @@ def main() -> None:
                             await probe_quality(session)
                         except Exception as e:  # noqa: BLE001 — report, keep line
                             quality_block["error"] = f"{type(e).__name__}: {e}"
+                    if lifecycle_mode:
+                        try:
+                            await probe_lifecycle(session)
+                        except Exception as e:  # noqa: BLE001 — report, keep line
+                            lifecycle_block["error"] = f"{type(e).__name__}: {e}"
                     if trace_out:
                         try:
                             await export_trace(session)
@@ -1007,6 +1289,10 @@ def main() -> None:
         # windowed-vs-offline AUC comparison — the CI gate
         # (tools/check_quality_smoke.py) reads this.
         "quality": quality_block if quality_mode else None,
+        # Lifecycle plane (SOAK_LIFECYCLE=1): promote + rollback +
+        # blacklist-persistence evidence with live-route probes — the CI
+        # gate (tools/check_lifecycle_smoke.py) reads this.
+        "lifecycle": lifecycle_block if lifecycle_mode else None,
         "chaos": None,
         "input_cache": (
             {
@@ -1026,6 +1312,10 @@ def main() -> None:
         if chaos:
             line["chaos"] = faults.get().snapshot()
         faults.reset()
+    if lifecycle_ctrl is not None:
+        lifecycle_ctrl.stop()
+    if lifecycle_watcher is not None:
+        lifecycle_watcher.stop()
     batcher.stop()
     print(json.dumps(line))
 
