@@ -25,6 +25,7 @@ from .client import (
 )
 from .health import BackendScoreboard, ScoreboardConfig
 from .partition import (
+    StreamingMerger,
     merge_host_order,
     partition_bounds,
     partition_flat,
@@ -52,6 +53,7 @@ __all__ = [
     "partition_flat",
     "shard_candidates",
     "merge_host_order",
+    "StreamingMerger",
     "BenchReport",
     "make_payload",
     "make_zipfian_payloads",

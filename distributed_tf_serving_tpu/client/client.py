@@ -36,7 +36,12 @@ from ..proto.service_grpc import (  # noqa: F401
     PredictionServiceStub,
 )
 from .health import HALF_OPEN, BackendScoreboard
-from .partition import merge_host_order, partition_bounds, shard_candidates
+from .partition import (
+    StreamingMerger,
+    merge_host_order,
+    partition_bounds,
+    shard_candidates,
+)
 
 
 class PredictClientError(RuntimeError):
@@ -72,6 +77,10 @@ class ResilienceCounters:
     failovers: int = 0
     backoff_sleeps: int = 0
     partial_responses: int = 0
+    # Streamed Predict (ISSUE 9): shards served over PredictStream and
+    # the sub-batch chunks their incremental merges consumed.
+    streamed_shards: int = 0
+    stream_chunks: int = 0
     # Overload plane (serving/overload.py): RESOURCE_EXHAUSTED sheds seen
     # (the backend is busy, not dead), and backoffs that honored a
     # server-sent retry-after-ms pushback hint.
@@ -116,6 +125,23 @@ class PredictResult:
     scores: np.ndarray
     missing_ranges: tuple[tuple[int, int], ...] = ()
     degraded: bool = False
+
+
+class _StreamIncompleteError(Exception):
+    """A PredictStream ended cleanly without covering the request — a
+    server bug or a mid-stream connection teardown grpc surfaced as a
+    normal end. Duck-types the AioRpcError surface (code()/details()) so
+    the shard machinery treats it like any reroutable backend failure."""
+
+    def __init__(self, detail: str):
+        super().__init__(detail)
+        self._detail = detail
+
+    def code(self):
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self) -> str:
+        return self._detail
 
 
 class _ShardAttemptError(Exception):
@@ -249,6 +275,7 @@ class ShardedPredictClient:
         keepalive_timeout_ms: int = 5_000,
         score_cache=None,
         criticality: str = "",
+        stream_chunk_candidates: int = 0,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -324,6 +351,12 @@ class ShardedPredictClient:
         # first. "" (default) sends nothing; the server treats absent as
         # "default".
         self.criticality = str(criticality or "").strip().lower()
+        # Streamed Predict (ISSUE 9): default sub-batch size hint sent as
+        # x-dts-stream-chunk on predict_streamed() RPCs (0 = server
+        # default). First-scores latencies are tracked per streamed shard
+        # (bounded ring) — the number streaming exists to improve.
+        self.stream_chunk_candidates = max(int(stream_chunk_candidates or 0), 0)
+        self._first_score_ms: list[float] = []
         self.counters = ResilienceCounters()
         self._health_stubs: list[object | None] = [None] * len(self.hosts)
         # Long-lived plaintext channels per host, created once and shared
@@ -424,7 +457,11 @@ class ShardedPredictClient:
                     # would be skipped by steering forever.
                     self.scoreboard.release_probe(host_idx)
                 raise
-            except (grpc.aio.AioRpcError, faults.InjectedFaultError) as e:
+            except (
+                grpc.aio.AioRpcError,
+                faults.InjectedFaultError,
+                _StreamIncompleteError,
+            ) as e:
                 code = e.code()
                 code_name = getattr(code, "name", str(code))
                 if span is not None:
@@ -587,19 +624,27 @@ class ShardedPredictClient:
             return False
         return resp.status == health_proto.SERVING
 
-    async def _shard_call(self, i: int, rr: int, invoke) -> np.ndarray:
+    async def _shard_call(
+        self, i: int, rr: int, invoke, extract=None
+    ) -> np.ndarray:
         """One shard's RPC with failover: `invoke(stub, metadata)` issues
         the call on the chosen stub (message path uses stub.Predict,
-        prepared-bytes path stub.PredictRaw); host steering (scoreboard
-        when present, blind rotation otherwise), hedging, jittered backoff,
-        reroutable-status retry, and error wrapping are shared here so the
-        message and prepared-bytes paths cannot diverge. With tracing on,
-        the shard gets a span whose children are the individual attempts
-        (failover hops and hedges as siblings)."""
+        prepared-bytes path stub.PredictRaw, streamed path
+        stub.PredictStream with an incremental merge inside invoke); host
+        steering (scoreboard when present, blind rotation otherwise),
+        hedging, jittered backoff, reroutable-status retry, and error
+        wrapping are shared here so the paths cannot diverge. `extract`
+        maps invoke's return value to the shard's score array (default:
+        decode this client's output_key tensor from a PredictResponse —
+        streamed invokes already return the merged ndarray). With tracing
+        on, the shard gets a span whose children are the individual
+        attempts (failover hops and hedges as siblings)."""
         with tracing.start_span("client.shard", attrs={"shard": i}):
-            return await self._shard_call_impl(i, rr, invoke)
+            return await self._shard_call_impl(i, rr, invoke, extract)
 
-    async def _shard_call_impl(self, i: int, rr: int, invoke) -> np.ndarray:
+    async def _shard_call_impl(
+        self, i: int, rr: int, invoke, extract=None
+    ) -> np.ndarray:
         n = len(self.hosts)
         used: list[int] = []
         last: _ShardAttemptError | None = None
@@ -691,6 +736,8 @@ class ShardedPredictClient:
                 raise PredictClientError(
                     self.hosts[e.host_idx], e.code, e.details
                 ) from e
+            if extract is not None:
+                return extract(resp)
             return codec.to_ndarray(resp.outputs[self.output_key])
         assert last is not None, "exhaustion implies at least one failure"
         raise PredictClientError(
@@ -880,6 +927,118 @@ class ShardedPredictClient:
         ):
             return await self._fan_out(
                 [self._predict_shard(i, s, rr) for i, s in enumerate(shards)],
+                sort_scores,
+                bounds=bounds,
+            )
+
+    # ------------------------------------------------- streamed Predict
+
+    def _note_first_scores(self, ms: float) -> None:
+        self._first_score_ms.append(ms)
+        if len(self._first_score_ms) > 1024:  # bounded ring
+            del self._first_score_ms[:512]
+
+    def stream_stats(self) -> dict:
+        """Streamed-Predict telemetry: shards/chunks consumed and the
+        first-scores latency distribution — the number streaming exists
+        to improve (first scores land when the FIRST sub-batch's readback
+        finishes, decoupled from the slowest)."""
+        lat = np.asarray(self._first_score_ms, np.float64)
+        return {
+            "streamed_shards": self.counters.streamed_shards,
+            "stream_chunks": self.counters.stream_chunks,
+            "first_score_samples": int(lat.size),
+            "first_score_p50_ms": (
+                round(float(np.percentile(lat, 50)), 3) if lat.size else None
+            ),
+            "first_score_p99_ms": (
+                round(float(np.percentile(lat, 99)), 3) if lat.size else None
+            ),
+        }
+
+    async def _predict_shard_stream(
+        self, i: int, shard: dict[str, np.ndarray], rr: int,
+        chunk: int | None,
+    ) -> np.ndarray:
+        req = build_predict_request(
+            shard,
+            self.model_name,
+            self.signature_name,
+            output_filter=(self.output_key,),
+            version_label=self.version_label,
+            use_tensor_content=self.use_tensor_content,
+        )
+        n = next(iter(shard.values())).shape[0]
+        chunk_n = (
+            int(chunk) if chunk is not None else self.stream_chunk_candidates
+        )
+
+        async def invoke(stub, metadata=None):
+            md = tuple(metadata or ())
+            if chunk_n:
+                md += (("x-dts-stream-chunk", str(chunk_n)),)
+            merger = StreamingMerger(n)
+            t0 = time.perf_counter()
+            call = stub.PredictStream(
+                req, timeout=self.timeout_s, metadata=md or None
+            )
+            first_ms: float | None = None
+            async for ch in call:
+                merger.add(
+                    ch.offset, codec.to_ndarray(ch.outputs[self.output_key])
+                )
+                if first_ms is None:
+                    first_ms = (time.perf_counter() - t0) * 1e3
+            if not merger.complete:
+                # A clean end without full coverage: reroutable — the
+                # failover/hedge machinery treats it like a dead backend.
+                raise _StreamIncompleteError(
+                    f"stream covered {merger.filled}/{n} candidates "
+                    f"(missing {merger.missing_ranges()})"
+                )
+            # Telemetry commits only on a COMPLETE stream: a failed or
+            # hedged-and-cancelled attempt must not pollute the headline
+            # first-scores distribution or the chunk counters with work
+            # whose merger was discarded.
+            self.counters.streamed_shards += 1
+            self.counters.stream_chunks += merger.chunks
+            if first_ms is not None:
+                self._note_first_scores(first_ms)
+            return merger.result()
+
+        return await self._shard_call(i, rr, invoke, extract=lambda r: r)
+
+    async def predict_streamed(
+        self, arrays: dict[str, np.ndarray], sort_scores: bool = False,
+        chunk: int | None = None,
+    ) -> "np.ndarray | PredictResult":
+        """predict() over the server-streaming RPC: each shard rides
+        PredictStream, merging sub-batch chunks incrementally as their
+        readbacks complete server-side (chunks arrive out of order; the
+        merge scatters by offset). Identical result semantics to
+        predict() — same host-order merge, optional sort, and (in
+        partial-results mode) degraded merges with missing_ranges when a
+        shard's failover chain exhausts. `chunk` overrides the
+        per-sub-batch candidate count (None = this client's
+        stream_chunk_candidates, 0 = the server's configured default).
+        First-scores latency per shard lands in stream_stats()."""
+        shards = shard_candidates(arrays, len(self.hosts))
+        self._rr += 1
+        rr = self._rr
+        n = next(iter(arrays.values())).shape[0]
+        bounds = (
+            partition_bounds(n, len(shards)) if self.partial_results else None
+        )
+        with tracing.start_root(
+            "client.predict",
+            attrs={"model": self.model_name, "candidates": n,
+                   "shards": len(shards), "streamed": True},
+        ):
+            return await self._fan_out(
+                [
+                    self._predict_shard_stream(i, s, rr, chunk)
+                    for i, s in enumerate(shards)
+                ],
                 sort_scores,
                 bounds=bounds,
             )

@@ -69,6 +69,75 @@ def partition_flat(flat: Sequence, parts: int, num_fields: int) -> list[Sequence
     return shards
 
 
+class StreamingMerger:
+    """Incremental merge of out-of-order PredictStream chunks (ISSUE 9).
+
+    The server flushes each sub-batch as its readback completes, so chunk
+    arrival order is completion order, not offset order. The merger
+    scatters each chunk into a preallocated result vector by its
+    [offset, offset+count) range and tracks coverage, so the caller knows
+    the instant the FIRST scores land (first-scores latency decoupled
+    from the slowest sub-batch) and whether the stream fully covered the
+    request before trusting the merge."""
+
+    def __init__(self, total: int):
+        if total <= 0:
+            raise ValueError(f"total must be positive, got {total}")
+        self.total = int(total)
+        self.filled = 0
+        self.chunks = 0
+        self._out: np.ndarray | None = None
+        self._covered = np.zeros(self.total, bool)
+
+    def add(self, offset: int, values: np.ndarray) -> None:
+        values = np.asarray(values)
+        n = values.shape[0]
+        if offset < 0 or offset + n > self.total:
+            raise ValueError(
+                f"chunk [{offset}, {offset + n}) outside request [0, {self.total})"
+            )
+        if self._out is None:
+            # Geometry comes from the first chunk: dtype + per-candidate
+            # trailing shape (scores are 1-D in practice, but the merge
+            # works for any candidate-major output).
+            self._out = np.empty((self.total,) + values.shape[1:], values.dtype)
+        seg = self._covered[offset: offset + n]
+        if seg.any():
+            raise ValueError(
+                f"chunk [{offset}, {offset + n}) overlaps rows already merged"
+            )
+        seg[:] = True
+        self._out[offset: offset + n] = values
+        self.filled += n
+        self.chunks += 1
+
+    @property
+    def complete(self) -> bool:
+        return self.filled == self.total
+
+    def missing_ranges(self) -> tuple[tuple[int, int], ...]:
+        """Contiguous [start, end) ranges the stream never covered."""
+        out, start = [], None
+        for i, covered in enumerate(self._covered):
+            if not covered and start is None:
+                start = i
+            elif covered and start is not None:
+                out.append((start, i))
+                start = None
+        if start is not None:
+            out.append((start, self.total))
+        return tuple(out)
+
+    def result(self) -> np.ndarray:
+        if not self.complete:
+            raise ValueError(
+                f"stream covered {self.filled}/{self.total} candidates; "
+                f"missing {self.missing_ranges()}"
+            )
+        assert self._out is not None
+        return self._out
+
+
 def merge_host_order(parts: list[np.ndarray]) -> np.ndarray:
     """Concatenate per-shard results in shard (host) order — the merge
     semantics of DCNClient.java:161-164. A single WRITABLE shard passes

@@ -173,12 +173,71 @@ def to_ndarray(tp: fw.TensorProto) -> np.ndarray:
     raise CodecError(f"{field} holds {nvals} elements, shape {dims} needs {n}")
 
 
+class EncodeArena:
+    """Preallocated encode scratch (ISSUE 9 transport satellite).
+
+    The response-encode path allocates transient numpy buffers per call —
+    the contiguity copy for a strided tensor, the float32 widen for a
+    wire-dtype leak, the dense (n, num_fields) batches the Example decoder
+    builds — and at streamed-sub-batch rates those allocations churn the
+    allocator for bytes whose lifetime is one encode. An arena hands back
+    the SAME backing storage each time, grown geometrically and keyed by
+    dtype, so steady-state encode performs zero large allocations.
+
+    NOT thread-safe by design: hold one arena per thread (the service
+    keeps a threading.local). Scratch returned by ndarray()/contiguous()/
+    widen_f32() is valid only until the next call for the same dtype —
+    callers must finish consuming (protobuf copies on field assignment;
+    the batcher's prepare_inputs copies writable inputs) before reusing.
+    Off by default everywhere ([transport] response_arena = false keeps
+    the historical allocate-per-call behavior)."""
+
+    def __init__(self):
+        self._bufs: dict[str, bytearray] = {}
+        self.reuses = 0
+        self.grows = 0
+
+    def ndarray(self, shape: tuple, dtype) -> np.ndarray:
+        """A writable scratch array of the requested geometry over reused
+        backing storage (contents undefined — callers overwrite fully)."""
+        dt = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        buf = self._bufs.get(dt.str)
+        if buf is None or len(buf) < nbytes:
+            # Geometric growth: successive request sizes within 2x reuse
+            # one allocation instead of reallocating per high-water mark.
+            buf = bytearray(max(nbytes, 2 * len(buf) if buf else 0, 1024))
+            self._bufs[dt.str] = buf
+            self.grows += 1
+        else:
+            self.reuses += 1
+        return np.frombuffer(buf, dtype=dt, count=int(np.prod(shape))).reshape(shape)
+
+    def contiguous(self, arr: np.ndarray) -> np.ndarray:
+        """C-contiguous view of `arr`'s data: the array itself when already
+        contiguous, else a copy into arena scratch (what
+        np.ascontiguousarray would allocate fresh)."""
+        if arr.flags.c_contiguous:
+            return arr
+        out = self.ndarray(arr.shape, arr.dtype)
+        np.copyto(out, arr)
+        return out
+
+    def widen_f32(self, arr: np.ndarray) -> np.ndarray:
+        """`arr.astype(np.float32)` into arena scratch (the signature-dtype
+        widen for half-precision wire leaks)."""
+        out = self.ndarray(arr.shape, np.float32)
+        np.copyto(out, arr, casting="unsafe")
+        return out
+
+
 def from_ndarray(
     arr: np.ndarray,
     *,
     dtype_enum: int | None = None,
     use_tensor_content: bool = True,
     out: fw.TensorProto | None = None,
+    arena: EncodeArena | None = None,
 ) -> fw.TensorProto:
     """Encode a numpy array as a TensorProto.
 
@@ -188,13 +247,17 @@ def from_ndarray(
     which share numpy layouts with plain integers). `out` fills an existing
     (empty) message in place — e.g. a request's map entry — skipping the
     CopyFrom of the encoded bytes (one fewer half-MB copy per request on
-    the serving hot path).
+    the serving hot path). `arena` (EncodeArena) reuses scratch storage for
+    any transient copy this encode needs instead of allocating fresh.
     """
     arr = np.asarray(arr)
     if not arr.flags.c_contiguous:
         # Note: ascontiguousarray would also promote 0-d to 1-d, so only call
         # it when actually needed (0-d arrays are always contiguous).
-        arr = np.ascontiguousarray(arr)
+        arr = (
+            arena.contiguous(arr) if arena is not None
+            else np.ascontiguousarray(arr)
+        )
 
     if arr.dtype == object or arr.dtype.kind in ("S", "U"):
         tp = out if out is not None else fw.TensorProto()

@@ -81,6 +81,23 @@ class PredictionServiceStub:
             request_serializer=None,
             response_deserializer=_METHODS["Predict"][1].FromString,
         )
+        # Server-streaming Predict (framework extension, ISSUE 9): the
+        # request is the ordinary PredictRequest; the response is a stream
+        # of PredictStreamChunk sub-batch results, each flushed as its
+        # readback completes (possibly out of order — chunks carry
+        # offset/count for the client-side incremental merge).
+        self.PredictStream = channel.unary_stream(
+            f"/{SERVICE_NAME}/PredictStream",
+            request_serializer=apis.PredictRequest.SerializeToString,
+            response_deserializer=apis.PredictStreamChunk.FromString,
+        )
+        # Raw-bytes flavor for PreparedRequest callers (same contract as
+        # PredictRaw: identical wire bytes, no per-call serialize).
+        self.PredictStreamRaw = channel.unary_stream(
+            f"/{SERVICE_NAME}/PredictStream",
+            request_serializer=None,
+            response_deserializer=apis.PredictStreamChunk.FromString,
+        )
 
 
 class PredictionServiceServicer:
@@ -101,6 +118,9 @@ class PredictionServiceServicer:
     def GetModelMetadata(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetModelMetadata not implemented")
 
+    def PredictStream(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "PredictStream not implemented")
+
 
 def add_PredictionServiceServicer_to_server(servicer, server) -> None:
     handlers = {
@@ -111,6 +131,14 @@ def add_PredictionServiceServicer_to_server(servicer, server) -> None:
         )
         for name, (req_cls, resp_cls) in _METHODS.items()
     }
+    # The one non-unary method rides a unary_stream handler; both the
+    # threaded server (a plain generator servicer method) and grpc.aio
+    # (an async generator) accept this registration shape.
+    handlers["PredictStream"] = grpc.unary_stream_rpc_method_handler(
+        servicer.PredictStream,
+        request_deserializer=apis.PredictRequest.FromString,
+        response_serializer=apis.PredictStreamChunk.SerializeToString,
+    )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
     )

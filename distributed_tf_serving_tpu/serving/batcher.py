@@ -374,6 +374,60 @@ class DeviceInputCache:
             self._lru.clear()
 
 
+class _HostBufferRing:
+    """Reusable padded-batch host buffers (continuous-batching satellite).
+
+    Every dispatched batch allocates one `np.empty((bucket,) + row_shape)`
+    per input; at depth-k pipelining that is k live multi-MB allocations
+    per model churning through the allocator while the device works. The
+    ring hands back the SAME buffers once their batch fully completes —
+    donation-safe by construction: a buffer is released only from the
+    completer's finally (the batch's readback finished, so the H2D upload
+    that read it is long done) or from a pre-device failure path, never
+    while a transfer could still be reading it. The padding loops fully
+    overwrite every acquired buffer (rows + zero tail), so stale content
+    can never leak between batches.
+
+    Bounded: at most `per_key` free buffers are retained per (shape,
+    dtype) — an acquire beyond the ring is a plain allocation and its
+    release is dropped on the floor (GC'd), so a bucket-ladder sweep
+    cannot pin unbounded memory. Off by default (buffer_ring=False keeps
+    the historical allocate-per-batch behavior)."""
+
+    def __init__(self, per_key: int = 8):
+        self.per_key = per_key
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self.reuses = 0
+        self.allocs = 0
+
+    def acquire(self, shape: tuple, dtype) -> np.ndarray:
+        key = (shape, np.dtype(dtype).str)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                self.reuses += 1
+                return free.pop()
+            self.allocs += 1
+        return np.empty(shape, dtype)
+
+    def release(self, arrs) -> None:
+        with self._lock:
+            for a in arrs:
+                key = (a.shape, a.dtype.str)
+                free = self._free.setdefault(key, [])
+                if len(free) < self.per_key:
+                    free.append(a)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "reuses": self.reuses,
+                "allocs": self.allocs,
+                "free_buffers": sum(len(v) for v in self._free.values()),
+            }
+
+
 @dataclasses.dataclass
 class _WorkItem:
     servable: Servable
@@ -395,6 +449,12 @@ class _WorkItem:
     # Criticality lane (overload plane metadata), carried so the quality
     # plane can label its observations per lane. None = unset.
     criticality: str | None = None
+    # Streamed sub-batch (ISSUE 9): never coalesced with neighbors — the
+    # whole point of the split is that each sub-batch becomes its OWN
+    # device batch riding the k-deep pipeline, so its readback (and its
+    # chunk flush) completes independently. Coalescing would concatenate
+    # the stream right back into the one big batch it was split from.
+    solo: bool = False
 
 
 def _replay_group_phases(group: list["_WorkItem"], phases: list) -> None:
@@ -445,6 +505,12 @@ class BatcherStats:
     # window==blocked (overlap 0) on the synchronous fallback path.
     readback_window_s: float = 0.0
     readback_blocked_s: float = 0.0
+    # Continuous-batching pipeline (ISSUE 9): high-water mark of batches
+    # simultaneously in flight (executing or awaiting readback), and how
+    # often the dispatch thread waited for the k-deep in-flight window
+    # to open before issuing the next batch (inflight_window armed).
+    inflight_peak: int = 0
+    inflight_window_waits: int = 0
 
     @property
     def mean_occupancy(self) -> float:
@@ -490,6 +556,8 @@ class DynamicBatcher:
         queue_capacity_candidates: int | None = None,
         breaker_timeout_s: float | None = 90.0,
         pipeline_depth: int = 2,
+        inflight_window: int = 0,
+        buffer_ring: bool = False,
         output_wire_dtype: str = "float32",
         output_top_k: int = 0,
         async_readback: bool = True,
@@ -575,12 +643,27 @@ class DynamicBatcher:
         # steady-state batch but below the 120s RPC deadline; first compiles
         # belong in warmup(), not live traffic.
         self.breaker_timeout_s = breaker_timeout_s
-        # Coalescing keeps filling past max_wait while this many batches are
-        # in flight: one executing on device plus one queued behind it means
-        # an extra dispatch cannot start sooner anyway, so waiting is free.
-        # Depth 1 would serialize dispatch against readback (killing the
-        # pipeline at low load); below 2 is therefore clamped.
-        self.pipeline_depth = max(pipeline_depth, 2)
+        # The k-deep continuous-batching window (ISSUE 9). pipeline_depth
+        # bounds how many ASSEMBLED groups may be staged ahead of the
+        # device stage (the coalescer's free-ride gate reads it too);
+        # depth 1 serializes assembly against the device stage (readback
+        # still overlaps via the completers) and is allowed but rarely
+        # wanted — the historical floor of 2 remains the default.
+        self.pipeline_depth = max(pipeline_depth, 1)
+        # inflight_window > 0 additionally bounds how many batches may be
+        # simultaneously IN FLIGHT (executing or awaiting readback): the
+        # dispatch thread keeps issuing batch k+2 while k awaits readback
+        # until the window fills, then waits for a completion — deep
+        # enough to hide the D2H link, bounded so a slow device cannot
+        # accumulate unbounded in-flight HBM. 0 = unbounded (the
+        # historical behavior).
+        self.inflight_window = max(int(inflight_window or 0), 0)
+        # Donation-safe padded-batch buffer reuse; None = allocate fresh
+        # per batch (the historical behavior).
+        self.buffer_ring = (
+            _HostBufferRing(per_key=max(self.inflight_window, 4) + 4)
+            if buffer_ring else None
+        )
         self._items: "deque[_WorkItem]" = deque()
         self._cv = threading.Condition()
         self._queued_candidates = 0
@@ -590,6 +673,12 @@ class DynamicBatcher:
         self._dispatching_since: float | None = None
         self._inflight: dict[int, float] = {}
         self._inflight_seq = 0
+        # Per-bucket in-flight accounting (continuous batching, ISSUE 9):
+        # bucket -> batches currently executing-or-awaiting-readback, fed
+        # under _cv at the same register/pop sites as _inflight so the
+        # two can never disagree. Read by pipeline_stats() and the
+        # dts_tpu_pipeline_* Prometheus series.
+        self._inflight_buckets: dict[int, int] = {}
         # Pipelined dispatch: groups handed to the dispatch thread but not
         # yet registered in flight. Admission counts their candidates (the
         # queue bound must not weaken just because the pipeline popped
@@ -632,7 +721,11 @@ class DynamicBatcher:
         # async; only the fetch blocks). Several workers = several batches'
         # readbacks in flight.
         self._completers = ThreadPoolExecutor(
-            max_workers=completion_workers, thread_name_prefix="batch-complete"
+            # At least one completer per in-flight-window slot: a window
+            # deeper than the pool would leave issued readbacks queued
+            # behind completer capacity instead of actually overlapping.
+            max_workers=max(completion_workers, self.inflight_window),
+            thread_name_prefix="batch-complete",
         )
 
     # ------------------------------------------------------------------ API
@@ -722,6 +815,7 @@ class DynamicBatcher:
         span: "tracing.Span | None" = None,
         criticality: str | None = None,
         _warmup: bool = False,
+        _solo: bool = False,
     ) -> Future:
         """Enqueue one request's arrays; returns a Future of output arrays
         (sliced back to the request's own candidate count). output_keys limits
@@ -790,7 +884,7 @@ class DynamicBatcher:
         try:
             return self._submit_miss(
                 servable, arrays, n, output_keys, deadline_s, span, _warmup,
-                handle, cache, criticality,
+                handle, cache, criticality, _solo,
             )
         except BaseException as exc:
             if handle is not None and handle.leader:
@@ -802,7 +896,7 @@ class DynamicBatcher:
 
     def _submit_miss(
         self, servable, arrays, n, output_keys, deadline_s, span, _warmup,
-        handle, cache=None, criticality=None,
+        handle, cache=None, criticality=None, solo=False,
     ) -> Future:
         """The no-cache-hit tail of submit(): admission, prepare, enqueue
         (exactly the pre-cache-plane submit body). The cache handle, when
@@ -871,6 +965,7 @@ class DynamicBatcher:
                 warmup=_warmup,
                 span=span if tracing.enabled() else None,
                 criticality=criticality,
+                solo=solo,
             )
         except BaseException:
             with self._cv:
@@ -1036,6 +1131,39 @@ class DynamicBatcher:
         selecting the output-compaction variant — defaults reproduce the
         all-outputs entry (see _build_entry)."""
         return self._jit_for(servable)
+
+    def pipeline_stats(self) -> dict:
+        """Continuous-batching pipeline snapshot (ISSUE 9): configured
+        depth/window, live in-flight occupancy (total and per bucket),
+        high-water marks, and the readback-overlap fraction — the body of
+        the /monitoring `pipeline` block and the dts_tpu_pipeline_*
+        Prometheus series. Always available (core batcher state, not a
+        gated plane)."""
+        with self._cv:
+            in_flight = len(self._inflight)
+            dispatching = self._dispatching_since is not None
+            per_bucket = {
+                int(b): n for b, n in sorted(self._inflight_buckets.items())
+                if n
+            }
+            pending = self._dispatch_pending
+            peak = self.stats.inflight_peak
+            window_waits = self.stats.inflight_window_waits
+            overlap = self.stats.readback_overlap_fraction
+        out = {
+            "depth": self.pipeline_depth,
+            "inflight_window": self.inflight_window,
+            "in_flight": in_flight,
+            "dispatching": dispatching,
+            "dispatch_pending": pending,
+            "per_bucket_in_flight": per_bucket,
+            "inflight_peak": peak,
+            "inflight_window_waits": window_waits,
+            "readback_overlap_fraction": round(overlap, 4),
+        }
+        if self.buffer_ring is not None:
+            out["buffer_ring"] = self.buffer_ring.snapshot()
+        return out
 
     # ------------------------------------------------------------- internals
 
@@ -1527,6 +1655,7 @@ class DynamicBatcher:
                     continue
                 if (
                     nxt.servable is item.servable
+                    and not nxt.solo
                     and nxt.arrays.keys() == item.arrays.keys()
                     and total + nxt.n <= self.max_batch_candidates
                 ):
@@ -1544,7 +1673,10 @@ class DynamicBatcher:
             total = item.n
             deadline = item.enqueue_t + self.max_wait_s
             # Coalesce same-servable work until the deadline or size cap.
-            while total < self.max_batch_candidates:
+            # Solo items (streamed sub-batches) dispatch alone: merging
+            # them would undo the very split that lets their readbacks
+            # complete (and flush) independently.
+            while total < self.max_batch_candidates and not item.solo:
                 nxt = self._coalesce_next(item, total, deadline)
                 if nxt is None:
                     break
@@ -1566,6 +1698,20 @@ class DynamicBatcher:
             [] if tracing.enabled() and any(it.span is not None for it in group)
             else None
         )
+        # Donation-safe buffer ring: padded-batch buffers acquired here are
+        # released only after the batch fully completes (the completer's
+        # finally) or on a pre-device failure path — never while the async
+        # H2D upload could still be reading them.
+        ring = self.buffer_ring
+        ring_bufs: list = []
+
+        def pad_buffer(shape: tuple, dtype) -> np.ndarray:
+            if ring is None:
+                return np.empty(shape, dtype)
+            buf = ring.acquire(shape, dtype)
+            ring_bufs.append(buf)
+            return buf
+
         try:
             bucket = bucket_for(total, self.buckets)
             first = group[0]
@@ -1649,7 +1795,7 @@ class DynamicBatcher:
                             # contract as the generic pad path below).
                             batched[k] = arr
                             continue
-                        out = np.empty((bucket,) + arr.shape[1:], arr.dtype)
+                        out = pad_buffer((bucket,) + arr.shape[1:], arr.dtype)
                         out[: arr.shape[0]] = arr
                         out[arr.shape[0]:] = 0  # padding rows
                         batched[k] = out
@@ -1671,7 +1817,7 @@ class DynamicBatcher:
                         dt = parts[0].dtype
                         if any(p.dtype != dt for p in parts):
                             dt = np.result_type(*(p.dtype for p in parts))
-                        out = np.empty((bucket,) + parts[0].shape[1:], dt)
+                        out = pad_buffer((bucket,) + parts[0].shape[1:], dt)
                         off = 0
                         for p in parts:
                             out[off : off + p.shape[0]] = p
@@ -1679,6 +1825,8 @@ class DynamicBatcher:
                         out[off:] = 0  # padding rows
                         batched[k] = out
         except Exception as exc:  # assembly failed: fail the group, keep serving
+            if ring is not None and ring_bufs:
+                ring.release(ring_bufs)
             for it in group:
                 if not it.future.done():
                     it.future.set_exception(exc)
@@ -1686,7 +1834,7 @@ class DynamicBatcher:
         if self._dispatcher is None:
             self._run_stage(
                 None, group, total, bucket, wanted, wanted_key,
-                topk, n_valid, fused, batched, phases, scatter,
+                topk, n_valid, fused, batched, phases, scatter, ring_bufs,
             )
             return
         with self._cv:
@@ -1697,16 +1845,18 @@ class DynamicBatcher:
             self._dispatch_pending += 1
         self._dispatcher.submit(
             self._run_stage, sid, group, total, bucket, wanted, wanted_key,
-            topk, n_valid, fused, batched, phases, scatter,
+            topk, n_valid, fused, batched, phases, scatter, ring_bufs,
         )
-        # Backpressure: at most one group may queue behind the running
-        # stage — enough to keep the pipeline full (assembly of k+1
-        # overlaps the stage of k), bounded so a slow device never lets
-        # the batcher thread run arbitrarily far ahead of admission
-        # control. Bounded waits: the wedge clock advances on wall time.
+        # Backpressure: up to pipeline_depth-1 groups may queue behind the
+        # running stage — enough to keep the pipeline full (assembly of
+        # k+1 overlaps the stage of k; deeper depths stage further ahead),
+        # bounded so a slow device never lets the batcher thread run
+        # arbitrarily far ahead of admission control. Depth 1 serializes
+        # assembly against the stage. Bounded waits: the wedge clock
+        # advances on wall time.
         with self._cv:
             while (
-                self._dispatch_pending >= max(self.pipeline_depth, 2)
+                self._dispatch_pending >= self.pipeline_depth
                 and not self._stopping
             ):
                 self._cv.wait(0.005)
@@ -1725,6 +1875,7 @@ class DynamicBatcher:
         batched: dict | None,
         phases: list | None = None,
         scatter: "np.ndarray | None" = None,
+        ring_bufs: list | None = None,
     ) -> None:
         """Device stage for one assembled batch: execute, issue the async
         D2H readback, register in flight, hand off to a completer. Runs on
@@ -1736,6 +1887,14 @@ class DynamicBatcher:
         pending_closed = sid is None
         util = None  # assigned once the batch passes the early-out checks
         util_handed_off = False
+
+        def release_bufs():
+            # Pre-completion exit (shed, all-cancelled, device-stage
+            # failure): the buffers were never handed to a completer, and
+            # no async upload is in flight past this frame, so they are
+            # safe to recycle here.
+            if self.buffer_ring is not None and ring_bufs:
+                self.buffer_ring.release(ring_bufs)
 
         def sink_ctx():
             # Fresh context per use: collect_phases is a generator context
@@ -1750,11 +1909,32 @@ class DynamicBatcher:
             if sid is not None:
                 with self._cv:
                     if self._staged_groups.pop(sid, None) is None:
+                        release_bufs()
                         return  # shed by the circuit breaker while queued
                     self._staged_candidates -= total
             if all(it.future.cancelled() for it in group):
+                release_bufs()
                 return  # every waiter gave up; skip the device work
             all_warm = all(it.warmup for it in group)
+            window = self.inflight_window
+            if window and not all_warm:
+                # The k-deep in-flight window: keep issuing while fewer
+                # than k batches are executing-or-awaiting-readback; at k,
+                # wait for a completion (notified from _complete's
+                # finally). Bounded waits, and a wedged readback breaks
+                # the gate — the jit call would queue behind the wedged
+                # device anyway, and the breaker owns that failure mode.
+                waited_for_window = False
+                with self._cv:
+                    while (
+                        len(self._inflight) >= window
+                        and not self._stopping
+                        and not self._wedged_for(time.perf_counter())
+                    ):
+                        if not waited_for_window:
+                            self.stats.inflight_window_waits += 1
+                            waited_for_window = True
+                        self._cv.wait(0.005)
             with self._cv:
                 # An all-warmup group is exempt from the wedge clock:
                 # hot-load warmup (warmup_via_queue during a version
@@ -1867,6 +2047,16 @@ class DynamicBatcher:
                 batch_id = self._inflight_seq
                 if not all(it.warmup for it in group):
                     self._inflight[batch_id] = time.perf_counter()
+                    # Per-bucket in-flight accounting + high-water mark
+                    # (pipeline_stats / dts_tpu_pipeline_*): same locked
+                    # register site as the wedge clock, popped together
+                    # in _complete's finally.
+                    self._inflight_buckets[bucket] = (
+                        self._inflight_buckets.get(bucket, 0) + 1
+                    )
+                    self.stats.inflight_peak = max(
+                        self.stats.inflight_peak, len(self._inflight)
+                    )
                 # Wedge accounting moves from "dispatching" to "in flight"
                 # atomically. Clearing only in the finally below would leave
                 # a window where the completer has already resolved this
@@ -1883,10 +2073,13 @@ class DynamicBatcher:
                 phases = None  # a later submit() failure must not re-replay
             self._completers.submit(
                 self._complete, batch_id, group, fetch, issue_t0, meta, scatter,
-                stage_t0, util=util, bucket=bucket,
+                stage_t0, util=util, bucket=bucket, ring_bufs=ring_bufs,
             )
             util_handed_off = True
         except Exception as exc:  # propagate to every waiter, keep serving
+            # Ring buffers are deliberately NOT recycled on a device-stage
+            # failure: an async H2D transfer may still be reading them, so
+            # they fall to GC instead (the ring just allocates fresh ones).
             if phases is not None:
                 # The spans must show the phases (and any injected-fault
                 # annotation) that led to the failure BEFORE the waiters
@@ -1912,6 +2105,7 @@ class DynamicBatcher:
         scatter: "np.ndarray | None" = None,
         stage_t0: float | None = None,
         util=None, bucket: int = 0,
+        ring_bufs: list | None = None,
     ) -> None:
         phases: list | None = (
             [] if tracing.enabled() and any(it.span is not None for it in group)
@@ -2033,12 +2227,23 @@ class DynamicBatcher:
         finally:
             if util is not None:
                 util.depth_dec()
+            # Recycle the padded-batch buffers: the readback finished, so
+            # the H2D upload that read them is long done — the only point
+            # in the batch lifecycle where reuse is provably safe.
+            if self.buffer_ring is not None and ring_bufs:
+                self.buffer_ring.release(ring_bufs)
             # The breaker closes itself here: once the stuck (or healthy)
             # readback finishes, the wedge condition clears with it — and
-            # any coalescer free-riding the busy pipeline is woken, since
-            # dispatch capacity just opened up.
+            # any coalescer free-riding the busy pipeline (or a dispatch
+            # thread waiting on the in-flight window) is woken, since
+            # capacity just opened up.
             with self._cv:
-                self._inflight.pop(batch_id, None)
+                if self._inflight.pop(batch_id, None) is not None:
+                    left = self._inflight_buckets.get(bucket, 0) - 1
+                    if left > 0:
+                        self._inflight_buckets[bucket] = left
+                    else:
+                        self._inflight_buckets.pop(bucket, None)
                 self._cv.notify_all()
 
     @staticmethod

@@ -553,6 +553,7 @@ class RestGateway:
                 utilization=self.impl.utilization_stats(),
                 quality=self.impl.quality_stats(),
                 lifecycle=self.impl.lifecycle_stats(),
+                pipeline=self.impl.pipeline_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -584,6 +585,7 @@ class RestGateway:
             "quality": self.impl.quality_stats,
             "lifecycle": self.impl.lifecycle_stats,
             "versions": self.impl.versions_stats,
+            "pipeline": self.impl.pipeline_stats,
             "request_log": request_log,
             "draining": lambda: bool(getattr(self.impl, "draining", False)),
         }
@@ -612,7 +614,7 @@ class RestGateway:
         # Armed-plane blocks only: a disabled plane is absent, so
         # dashboards can distinguish "off" from "cold".
         for name in ("cache", "overload", "utilization", "quality",
-                     "lifecycle", "versions"):
+                     "lifecycle", "versions", "pipeline"):
             block = builders[name]()
             if block is not None:
                 snap[name] = block

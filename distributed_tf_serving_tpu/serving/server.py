@@ -90,6 +90,20 @@ def _criticality_of(context) -> str | None:
     return None
 
 
+def _stream_chunk_of(context) -> int | None:
+    """Per-request sub-batch-size override for PredictStream from the
+    x-dts-stream-chunk metadata key (candidates per sub-batch; the server
+    still clamps the resulting chunk count). None = use the configured
+    stream_chunk_candidates default."""
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == "x-dts-stream-chunk":
+                return max(int(value), 0) or None
+    except Exception:  # noqa: BLE001 — a malformed hint must not fail the RPC
+        return None
+    return None
+
+
 def _push_overload_metadata(context, exc: ServiceError | None) -> None:
     """Overload-plane trailing metadata, shared by both transports: the
     retry-after-ms pushback hint on refusals, and the degraded marker on
@@ -167,6 +181,44 @@ class _SyncServicerBase:
         finally:
             self.metrics.observe(name, time.perf_counter() - t0, ok, model=model)
 
+    def _call_stream(self, name: str, fn, request, context):
+        """_call for server-streaming RPCs: `fn(request)` returns a chunk
+        generator; the same error mapping / metrics / tracing wrap the
+        whole stream (one observe per stream, error status aborts
+        mid-stream — grpc sends already-yielded chunks first)."""
+        t0 = time.perf_counter()
+        ok = False
+        model = _model_of(request)
+        overload_on = overload_mod.active()
+        if overload_on:
+            overload_mod.consume_degraded()
+        if tracing.enabled():
+            span_ctx = tracing.start_root(
+                f"server.{name}",
+                traceparent=_traceparent_of(context),
+                attrs={"entrypoint": name, **({"model": model} if model else {})},
+            )
+        else:
+            span_ctx = None
+        try:
+            if span_ctx is not None:
+                with span_ctx:
+                    yield from fn(request)
+            else:
+                yield from fn(request)
+            ok = True
+            if overload_on:
+                _push_overload_metadata(context, None)
+        except ServiceError as e:
+            if overload_on:
+                _push_overload_metadata(context, e)
+            context.abort(_status(e.code), str(e))
+        except Exception as e:  # internal bug: surface as INTERNAL, keep serving
+            log.exception("internal error serving %s", name)
+            context.abort(grpc.StatusCode.INTERNAL, f"internal error: {e}")
+        finally:
+            self.metrics.observe(name, time.perf_counter() - t0, ok, model=model)
+
 
 def _deadline_of(context) -> float | None:
     """The client's remaining budget from the RPC context (None = no
@@ -230,6 +282,18 @@ class GrpcPredictionService(_SyncServicerBase):
 
     def GetModelMetadata(self, request, context):
         return self._call("GetModelMetadata", self.impl.get_model_metadata, request, context)
+
+    def PredictStream(self, request, context):
+        deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
+        chunk = _stream_chunk_of(context)
+        return self._call_stream(
+            "PredictStream",
+            lambda req: self.impl.predict_stream(
+                req, deadline_s=deadline_s, criticality=crit, chunk=chunk
+            ),
+            request, context,
+        )
 
 
 class GrpcModelService(_SyncServicerBase):
@@ -310,16 +374,37 @@ class AioGrpcHealthService(GrpcHealthService):
         return health_proto.HealthCheckResponse(status=st)
 
 
+def _add_uds_port(server, uds_path: str) -> None:
+    """Bind the server to a Unix-domain socket NEXT TO its TCP port
+    (transport-floor satellite, ISSUE 9): co-located fan-out clients dial
+    `unix:<path>` and skip the TCP/loopback stack — no checksums, no
+    Nagle/ACK machinery, smaller per-message syscall cost. A stale socket
+    file from a previous process is removed first (grpc refuses to bind
+    over it)."""
+    import os as _os
+
+    try:
+        if _os.path.exists(uds_path):
+            _os.unlink(uds_path)
+    except OSError:
+        pass  # bind below gives the actionable error
+    if server.add_insecure_port(f"unix:{uds_path}") == 0:
+        raise RuntimeError(f"could not bind unix:{uds_path}")
+
+
 def create_server(
     impl: PredictionServiceImpl,
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
     metrics: ServerMetrics | None = None,
     credentials: "grpc.ServerCredentials | None" = None,
+    uds_path: str | None = None,
 ) -> tuple[grpc.Server, int]:
     """Build (not start) a server; returns (server, bound_port).
     `credentials` switches the port to TLS (ssl_server_credentials — the
-    --ssl-config-file surface; see load_ssl_credentials)."""
+    --ssl-config-file surface; see load_ssl_credentials). `uds_path`
+    additionally binds a plaintext Unix-domain socket for co-located
+    clients ([transport] uds_path)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="rpc"),
         options=list(LARGE_MESSAGE_CHANNEL_OPTIONS) + list(KEEPALIVE_SERVER_OPTIONS),
@@ -332,11 +417,23 @@ def create_server(
     # probing) — NOT_SERVING until warmup completes, per-model afterward.
     add_HealthServicer_to_server(GrpcHealthService(impl), server)
     if credentials is not None:
+        if uds_path:
+            # The UDS listener is plaintext: binding it next to a TLS/mTLS
+            # TCP port would silently open an unauthenticated side door
+            # for any local process that can reach the socket file —
+            # refuse the combination instead of downgrading.
+            raise ValueError(
+                "[transport] uds_path cannot be combined with "
+                "--ssl-config-file: the unix socket is plaintext and "
+                "would bypass the TLS/mTLS the TCP port enforces"
+            )
         port = server.add_secure_port(address, credentials)
     else:
         port = server.add_insecure_port(address)
     if port == 0:
         raise RuntimeError(f"could not bind {address}")
+    if uds_path:
+        _add_uds_port(server, uds_path)
     return server, port
 
 
@@ -520,6 +617,59 @@ class AioGrpcPredictionService(_AioServicerBase):
     async def GetModelMetadata(self, request, context):
         return await self._call("GetModelMetadata", self.impl.get_model_metadata, request, context)
 
+    async def PredictStream(self, request, context):
+        """Server-streaming Predict on the coroutine server: an async
+        generator awaiting each sub-batch completion on the event loop —
+        same error mapping / metrics / tracing shape as _call, inlined
+        because the stream must YIELD through the adapter."""
+        t0 = time.perf_counter()
+        ok = False
+        model = _model_of(request)
+        overload_on = overload_mod.active()
+        if overload_on:
+            overload_mod.consume_degraded()
+        deadline_s = _deadline_of(context)
+        crit = _criticality_of(context)
+        chunk = _stream_chunk_of(context)
+        if tracing.enabled():
+            span_ctx = tracing.start_root(
+                "server.PredictStream",
+                traceparent=_traceparent_of(context),
+                attrs={"entrypoint": "PredictStream",
+                       **({"model": model} if model else {})},
+            )
+        else:
+            span_ctx = None
+        try:
+            agen = self.impl.predict_stream_async(
+                request, deadline_s=deadline_s, criticality=crit, chunk=chunk
+            )
+            if span_ctx is not None:
+                # Sync `with` across awaits: contextvars are coroutine-
+                # scoped (the _call precedent).
+                with span_ctx:
+                    async for item in agen:
+                        yield item
+            else:
+                async for item in agen:
+                    yield item
+            ok = True
+            if overload_on:
+                _push_overload_metadata(context, None)
+        except ServiceError as e:
+            if overload_on:
+                _push_overload_metadata(context, e)
+            await context.abort(_status(e.code), str(e))
+        except grpc.aio.AbortError:
+            raise
+        except Exception as e:  # internal bug: surface as INTERNAL, keep serving
+            log.exception("internal error serving PredictStream")
+            await context.abort(grpc.StatusCode.INTERNAL, f"internal error: {e}")
+        finally:
+            self.metrics.observe(
+                "PredictStream", time.perf_counter() - t0, ok, model=model
+            )
+
 
 class AioGrpcModelService(_AioServicerBase):
     """ModelService on the coroutine server: GetModelStatus is a cheap
@@ -553,9 +703,12 @@ def create_server_async(
     impl: PredictionServiceImpl,
     address: str = "127.0.0.1:0",
     metrics: ServerMetrics | None = None,
+    uds_path: str | None = None,
 ) -> tuple["grpc.aio.Server", int]:
     """Build (not start) a grpc.aio server; returns (server, bound_port).
-    Must be called from (or started on) the event loop that will own it."""
+    Must be called from (or started on) the event loop that will own it.
+    `uds_path` additionally binds a Unix-domain socket ([transport]
+    uds_path) for co-located clients."""
     server = grpc.aio.server(
         options=list(LARGE_MESSAGE_CHANNEL_OPTIONS) + list(KEEPALIVE_SERVER_OPTIONS),
     )
@@ -570,6 +723,8 @@ def create_server_async(
     port = server.add_insecure_port(address)
     if port == 0:
         raise RuntimeError(f"could not bind {address}")
+    if uds_path:
+        _add_uds_port(server, uds_path)
     return server, port
 
 
@@ -982,6 +1137,8 @@ def build_stack(
     utilization_config=None,
     quality_config=None,
     lifecycle_config=None,
+    batching_config=None,
+    transport_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -1112,12 +1269,32 @@ def build_stack(
             overload_config.shed_after_intervals,
             overload_config.stale_while_overloaded_s,
         )
+    # Continuous-batching pipeline knobs ([batching], ISSUE 9): the
+    # section's pipeline_depth (when nonzero) wins over the legacy
+    # [server] location; the in-flight window / buffer ring / stream
+    # split live only in the section and default off.
+    pipeline_depth = cfg.pipeline_depth
+    inflight_window = 0
+    buffer_ring = False
+    if batching_config is not None:
+        pipeline_depth = batching_config.pipeline_depth or pipeline_depth
+        inflight_window = batching_config.inflight_window
+        buffer_ring = batching_config.buffer_ring
+        if inflight_window or buffer_ring or batching_config.pipeline_depth:
+            log.info(
+                "continuous-batching pipeline: depth=%d inflight_window=%s "
+                "buffer_ring=%s stream_chunk=%d",
+                pipeline_depth, inflight_window or "unbounded", buffer_ring,
+                batching_config.stream_chunk_candidates,
+            )
     batcher = DynamicBatcher(
         buckets=cfg.buckets,
         max_wait_us=cfg.max_wait_us,
         compress_transfer=cfg.compress_transfer,
         run_fn=run_fn,
-        pipeline_depth=cfg.pipeline_depth,
+        pipeline_depth=pipeline_depth,
+        inflight_window=inflight_window,
+        buffer_ring=buffer_ring,
         queue_capacity_candidates=cfg.queue_capacity_candidates,
         completion_workers=cfg.completion_workers,
         output_wire_dtype=cfg.output_wire_dtype,
@@ -1137,6 +1314,14 @@ def build_stack(
         quality=quality_monitor,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
+    if batching_config is not None:
+        # Streamed sub-batch default ([batching] stream_chunk_candidates;
+        # a request's x-dts-stream-chunk metadata overrides per call).
+        impl.stream_chunk_candidates = batching_config.stream_chunk_candidates
+    if transport_config is not None and transport_config.response_arena:
+        # Reusable response-encode scratch ([transport] response_arena).
+        impl.response_arena = True
+        log.info("response-encode arenas on ([transport] response_arena)")
     # Health gating: the grpc.health.v1 servicer reports the overall server
     # NOT_SERVING until the load+warmup phase below completes (standard
     # probes and the client's half-open probing key off this).
@@ -1381,6 +1566,19 @@ def serve(argv=None) -> None:
         "dts_tpu_lifecycle_* Prometheus series)",
     )
     parser.add_argument(
+        "--uds-path", dest="uds_path",
+        help="also serve gRPC on this Unix-domain socket path (co-located "
+        "fan-out clients dial unix:<path>, skipping the TCP/loopback "
+        "stack). Equivalent to [transport] uds_path",
+    )
+    parser.add_argument(
+        "--stream-chunk", dest="stream_chunk", type=int,
+        help="default candidates per PredictStream sub-batch (server-side "
+        "split; 0 = single chunk). Equivalent to [batching] "
+        "stream_chunk_candidates; requests override via "
+        "x-dts-stream-chunk metadata",
+    )
+    parser.add_argument(
         "--batching-parameters-file", dest="batching_parameters_file",
         help="tensorflow_model_server-format batching config (text-format "
         "BatchingParameters): allowed_batch_sizes -> bucket ladder, "
@@ -1427,16 +1625,28 @@ def serve(argv=None) -> None:
     args = parser.parse_args(argv)
 
     from ..utils.config import (
+        BatchingConfig,
         CacheConfig,
         LifecycleConfig,
         ObservabilityConfig,
         OverloadConfig,
         QualityConfig,
+        TransportConfig,
         UtilizationConfig,
     )
 
     cfgs = load_config(args.config) if args.config else {"server": ServerConfig()}
     cfg = cfgs["server"]
+    batching_config = cfgs.get("batching") or BatchingConfig()
+    if args.stream_chunk is not None:
+        batching_config = dataclasses.replace(
+            batching_config, stream_chunk_candidates=max(args.stream_chunk, 0)
+        )
+    transport_config = cfgs.get("transport") or TransportConfig()
+    if args.uds_path:
+        transport_config = dataclasses.replace(
+            transport_config, uds_path=args.uds_path
+        )
     obs = cfgs.get("observability") or ObservabilityConfig()
     if args.tracing:
         obs = dataclasses.replace(obs, tracing=True)
@@ -1519,6 +1729,8 @@ def serve(argv=None) -> None:
         utilization_config=utilization_config,
         quality_config=quality_config,
         lifecycle_config=lifecycle_config,
+        batching_config=batching_config,
+        transport_config=transport_config,
     )
     if impl.lifecycle is not None:
         # The CLI server drives the controller with its background thread
@@ -1556,8 +1768,12 @@ def serve(argv=None) -> None:
     server, port = create_server(
         impl, f"{cfg.host}:{cfg.port}", cfg.max_workers, metrics,
         credentials=credentials,
+        uds_path=transport_config.uds_path or None,
     )
     server.start()
+    if transport_config.uds_path:
+        log.info("gRPC also on unix:%s (co-located transport)",
+                 transport_config.uds_path)
     shutdown.server = server
     # SIGTERM = drain: health NOT_SERVING, new admissions refused
     # UNAVAILABLE("draining"), accepted work answered up to the grace.

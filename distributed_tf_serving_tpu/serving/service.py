@@ -16,6 +16,7 @@ RESOURCE_EXHAUSTED (wired to codes in server.py via ServiceError.code).
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -106,6 +107,38 @@ class PredictionServiceImpl:
         # reads loaded/on-disk/blacklist/pin state from it — present
         # whether or not the lifecycle controller is armed.
         self.version_watcher = None
+        # Streamed sub-batch results (ISSUE 9): default server-side split
+        # size (candidates per sub-batch) for PredictStream. 0 = no split
+        # (one chunk per request — streaming stays wire-available but the
+        # behavior change is off); a request may override via the
+        # x-dts-stream-chunk metadata the transport adapters thread in.
+        self.stream_chunk_candidates = 0
+        # Reusable encode scratch ([transport] response_arena): when True,
+        # response encodes run through a per-thread codec.EncodeArena
+        # (contiguity/widen copies and the Example decoder's dense batch
+        # reuse one backing allocation) and each PredictStream reuses ONE
+        # chunk message. Off by default = historical allocate-per-call.
+        self.response_arena = False
+        self._arenas = threading.local()
+
+    def _arena(self):
+        """The calling thread's EncodeArena, or None when the plane is
+        off. Per-thread: arenas are single-owner scratch by design."""
+        if not self.response_arena:
+            return None
+        arena = getattr(self._arenas, "arena", None)
+        if arena is None:
+            arena = self._arenas.arena = codec.EncodeArena()
+        return arena
+
+    def pipeline_stats(self) -> dict | None:
+        """Continuous-batching pipeline snapshot (configured depth /
+        in-flight window, live per-bucket occupancy, overlap fraction) —
+        the `pipeline` block in /monitoring and the dts_tpu_pipeline_*
+        Prometheus series. Always available: this is core batcher state,
+        not a gated plane."""
+        fn = getattr(self.batcher, "pipeline_stats", None)
+        return fn() if callable(fn) else None
 
     def _log_request(self, kind: str, request) -> None:
         if self.request_logger is not None:
@@ -625,9 +658,7 @@ class PredictionServiceImpl:
         self._log_request("predict", request)
         return resp
 
-    def _predict_finish(
-        self, request: apis.PredictRequest, servable: Servable, out_names, outputs
-    ) -> apis.PredictResponse:
+    def _check_produced(self, out_names, outputs) -> None:
         produced = [k for k in out_names if k in outputs]
         if len(produced) != len(out_names):
             # Signature promised tensors the model never produced — a servable
@@ -638,55 +669,287 @@ class PredictionServiceImpl:
                 f"{out_names}",
             )
 
+    @staticmethod
+    def _mirror_content(request: apis.PredictRequest) -> bool:
+        """Mirror the client's tensor encoding: a client that sent
+        repeated fields (the grpc-java builder style, DCNClient.java:
+        98-108) reads outputs via getFloatValList(), which is EMPTY if
+        we reply with tensor_content — TF-Serving itself replies
+        AsProtoField-style. Clients that sent tensor_content get the
+        zero-copy fast path back.
+        upb map iteration materializes each TensorProto wrapper, which
+        is measurably slow at 500 QPS (round-3 profile: ~50 us/call);
+        iterating keys and probing one field is several times cheaper,
+        and any() still short-circuits on the first content-carrying
+        input either way."""
+        return any(
+            request.inputs[name].tensor_content for name in request.inputs
+        )
+
+    def _encode_outputs(
+        self, request, servable: Servable, out_names, outputs, dest,
+        mirror_content: bool,
+    ) -> None:
+        """The ONE per-tensor response-encode loop, shared by unary
+        responses and stream chunks (their wire encodings must never
+        drift): the half-precision wire-dtype leak guard (custom run_fns
+        returning the compact transport encoding widen back to the
+        signature's DT_FLOAT; genuinely half-precision signatures pass
+        through untouched), the client-encoding mirror, and the optional
+        per-thread encode arena. `dest` is the response's outputs map."""
+        half = (
+            codec.dtype_to_numpy(fw.DataType.DT_BFLOAT16),
+            np.dtype(np.float16),
+        )
+        sig_dtypes = None  # built lazily: the leak guard almost never
+        # fires (the batcher completer already widened), and this encode
+        # path is microbenchmark-hot (~50 us/call at 500 QPS).
+        arena = self._arena()
+        for name in out_names:
+            arr = outputs[name]
+            if arr.dtype in half:
+                if sig_dtypes is None:
+                    sig_dtypes = {
+                        s.name: s.dtype
+                        for s in servable.signature(
+                            request.model_spec.signature_name
+                        ).outputs
+                    }
+                if sig_dtypes.get(name) == fw.DataType.DT_FLOAT:
+                    arr = (
+                        arena.widen_f32(arr) if arena is not None
+                        else arr.astype(np.float32)
+                    )
+            codec.from_ndarray(
+                arr,
+                use_tensor_content=mirror_content,
+                out=dest[name],
+                arena=arena,
+            )
+
+    def _predict_finish(
+        self, request: apis.PredictRequest, servable: Servable, out_names, outputs
+    ) -> apis.PredictResponse:
+        self._check_produced(out_names, outputs)
         with request_trace.span("predict.encode"):
             resp = apis.PredictResponse()
             resp.model_spec.CopyFrom(
                 self._echo_spec(servable, request.model_spec.signature_name or "serving_default")
             )
-            # Mirror the client's tensor encoding: a client that sent
-            # repeated fields (the grpc-java builder style, DCNClient.java:
-            # 98-108) reads outputs via getFloatValList(), which is EMPTY if
-            # we reply with tensor_content — TF-Serving itself replies
-            # AsProtoField-style. Clients that sent tensor_content get the
-            # zero-copy fast path back.
-            # upb map iteration materializes each TensorProto wrapper, which
-            # is measurably slow at 500 QPS (round-3 profile: ~50 us/call);
-            # iterating keys and probing one field is several times cheaper,
-            # and any() still short-circuits on the first content-carrying
-            # input either way.
-            mirror_content = any(
-                request.inputs[name].tensor_content for name in request.inputs
+            self._encode_outputs(
+                request, servable, out_names, outputs, resp.outputs,
+                self._mirror_content(request),
             )
-            half = (
-                codec.dtype_to_numpy(fw.DataType.DT_BFLOAT16),
-                np.dtype(np.float16),
-            )
-            sig_dtypes = None  # built lazily: the leak guard below almost
-            # never fires (the batcher completer already widened), and this
-            # encode path is microbenchmark-hot (~50 us/call at 500 QPS).
-            for name in out_names:
-                arr = outputs[name]
-                if arr.dtype in half:
-                    # Wire-dtype leakage guard (custom run_fns returning the
-                    # compact transport encoding): responses stay signature-
-                    # typed DT_FLOAT. Genuinely half-precision signatures
-                    # (imported graphs declaring DT_HALF/DT_BFLOAT16) pass
-                    # through untouched.
-                    if sig_dtypes is None:
-                        sig_dtypes = {
-                            s.name: s.dtype
-                            for s in servable.signature(
-                                request.model_spec.signature_name
-                            ).outputs
-                        }
-                    if sig_dtypes.get(name) == fw.DataType.DT_FLOAT:
-                        arr = arr.astype(np.float32)
-                codec.from_ndarray(
-                    arr,
-                    use_tensor_content=mirror_content,
-                    out=resp.outputs[name],
-                )
         return resp
+
+    # --------------------------------------------------------- PredictStream
+
+    # Guard against pathological sub-batch explosions: a 32k-candidate
+    # request with a 1-candidate chunk override must not mint 32k batcher
+    # submits. The effective chunk size is raised until the request yields
+    # at most this many sub-batches.
+    _STREAM_MAX_CHUNKS = 64
+
+    def _stream_plan(
+        self, n: int, chunk: int | None
+    ) -> list[tuple[int, int]]:
+        """[(offset, count)] sub-batch split of an n-candidate request.
+        `chunk` (per-request override, e.g. the x-dts-stream-chunk
+        metadata) wins over the configured stream_chunk_candidates; 0 or
+        absent on both = one chunk (streaming stays wire-available with
+        the behavior change off)."""
+        chunk_n = int(chunk) if chunk else int(self.stream_chunk_candidates or 0)
+        if chunk_n <= 0 or chunk_n >= n:
+            return [(0, n)]
+        chunk_n = max(chunk_n, -(-n // self._STREAM_MAX_CHUNKS))
+        return [(off, min(chunk_n, n - off)) for off in range(0, n, chunk_n)]
+
+    def _stream_submit(
+        self, request, deadline_t, criticality, chunk
+    ):
+        """Shared front half of both predict_stream flavors: resolve,
+        decode, split, and submit EVERY sub-batch up front — the
+        sub-batches ride the batcher's k-deep pipeline independently, so
+        sub-batch k+1 uploads while k executes and k-1 reads back. Returns
+        (servable, out_names, mirror_content, total, {future: (off, n)}).
+        A submit failure mid-fan-out cancels the siblings already queued
+        before translating."""
+        servable, arrays, out_names, fetch_keys = self._predict_prepare(
+            request, criticality
+        )
+        total = next(iter(arrays.values())).shape[0]
+        plan = self._stream_plan(total, chunk)
+        span = tracing.current_span()
+        futs: dict = {}
+        # A split stream's sub-batches submit _solo so the coalescer never
+        # concatenates them back into the one big batch they were split
+        # from; an unsplit request keeps ordinary coalescing semantics.
+        solo = len(plan) > 1
+        try:
+            for off, cnt in plan:
+                sub = {k: v[off: off + cnt] for k, v in arrays.items()}
+                fut = self.batcher.submit(
+                    servable, sub, output_keys=fetch_keys,
+                    deadline_s=self._budget_left(deadline_t),
+                    span=span, criticality=criticality, _solo=solo,
+                )
+                futs[fut] = (off, cnt)
+        except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
+            for f in futs:
+                f.cancel()
+            raise self._translate_batcher_error(e, None) from e
+        return servable, out_names, self._mirror_content(request), total, futs
+
+    def _encode_stream_chunk(
+        self, request, servable, out_names, outputs,
+        off: int, cnt: int, total: int, final: bool,
+        mirror_content: bool, msg=None,
+    ) -> apis.PredictStreamChunk:
+        """One sub-batch -> one PredictStreamChunk (PredictResponse encode
+        semantics — _encode_outputs is the SHARED per-tensor loop, so the
+        streamed and unary wire encodings cannot drift). `msg` reuses one
+        chunk message across the stream (the response-arena mode): gRPC
+        serializes each yielded message before the generator resumes, so
+        Clear+refill after yield is safe."""
+        self._check_produced(out_names, outputs)
+        with request_trace.span("predict.encode"):
+            if msg is None:
+                chunk = apis.PredictStreamChunk()
+            else:
+                chunk = msg
+                chunk.Clear()
+            chunk.model_spec.CopyFrom(self._echo_spec(
+                servable, request.model_spec.signature_name or "serving_default"
+            ))
+            chunk.offset = int(off)
+            chunk.count = int(cnt)
+            chunk.total = int(total)
+            chunk.final = bool(final)
+            self._encode_outputs(
+                request, servable, out_names, outputs, chunk.outputs,
+                mirror_content,
+            )
+        return chunk
+
+    def predict_stream(
+        self, request: apis.PredictRequest, deadline_s: float | None = None,
+        criticality: str | None = None, chunk: int | None = None,
+    ):
+        """Server-streaming Predict (ISSUE 9): a generator of
+        PredictStreamChunk — the request is split into sub-batches that
+        ride the batcher pipeline independently, and each chunk is yielded
+        the moment its readback completes (possibly OUT OF ORDER; chunks
+        carry offset/count for the client's incremental merge), so the
+        caller's first scores decouple from the slowest sub-batch. Unary
+        Predict semantics otherwise: same resolution/validation/encode
+        path, same error taxonomy — a failed sub-batch aborts the stream
+        with the translated status after cancelling its siblings. A
+        deadline expiring mid-stream cancels the remaining sub-batches
+        and aborts DEADLINE_EXCEEDED."""
+        import concurrent.futures as cf
+
+        self._refuse_if_draining()
+        deadline_t = self._clock_deadline(deadline_s)
+        timeout = self._effective_timeout(deadline_s)
+        give_up_t = time.perf_counter() + timeout
+        servable, out_names, mirror_content, total, futs = (
+            self._stream_submit(request, deadline_t, criticality, chunk)
+        )
+        reuse = apis.PredictStreamChunk() if self.response_arena else None
+        pending = set(futs)
+        emitted = 0
+        try:
+            while pending:
+                left = give_up_t - time.perf_counter()
+                if left <= 0:
+                    raise ServiceError(
+                        "DEADLINE_EXCEEDED",
+                        "deadline expired mid-stream "
+                        f"({emitted}/{len(futs)} sub-batches delivered)",
+                    )
+                done, pending = cf.wait(
+                    pending, timeout=left,
+                    return_when=cf.FIRST_COMPLETED,
+                )
+                if not done:
+                    continue  # loop re-checks the give-up clock
+                for fut in done:
+                    try:
+                        outputs = fut.result()
+                    except Exception as e:  # noqa: BLE001 — translator re-raises
+                        raise self._translate_batcher_error(e, fut) from e
+                    off, cnt = futs[fut]
+                    emitted += 1
+                    yield self._encode_stream_chunk(
+                        request, servable, out_names, outputs,
+                        off, cnt, total, final=emitted == len(futs),
+                        mirror_content=mirror_content, msg=reuse,
+                    )
+        except BaseException:
+            # Mid-stream failure/deadline/disconnect: withdraw every
+            # sub-batch still queued so abandoned work never dispatches.
+            for f in pending:
+                f.cancel()
+            raise
+        self._log_request("predict", request)
+
+    async def predict_stream_async(
+        self, request: apis.PredictRequest, deadline_s: float | None = None,
+        criticality: str | None = None, chunk: int | None = None,
+    ):
+        """predict_stream for coroutine servers: an async generator that
+        awaits sub-batch completions instead of blocking an RPC handler
+        thread between chunks."""
+        import asyncio
+
+        self._refuse_if_draining()
+        deadline_t = self._clock_deadline(deadline_s)
+        timeout = self._effective_timeout(deadline_s)
+        give_up_t = time.perf_counter() + timeout
+        servable, out_names, mirror_content, total, futs = (
+            self._stream_submit(request, deadline_t, criticality, chunk)
+        )
+        reuse = apis.PredictStreamChunk() if self.response_arena else None
+        wrapped = {asyncio.wrap_future(f): f for f in futs}
+        pending = set(wrapped)
+        emitted = 0
+        try:
+            while pending:
+                left = give_up_t - time.perf_counter()
+                if left <= 0:
+                    raise ServiceError(
+                        "DEADLINE_EXCEEDED",
+                        "deadline expired mid-stream "
+                        f"({emitted}/{len(futs)} sub-batches delivered)",
+                    )
+                done, pending = await asyncio.wait(
+                    pending, timeout=left,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    continue  # loop re-checks the give-up clock
+                for task in done:
+                    try:
+                        outputs = task.result()
+                    except Exception as e:  # noqa: BLE001 — translator re-raises
+                        raise self._translate_batcher_error(
+                            e, wrapped[task]
+                        ) from e
+                    off, cnt = futs[wrapped[task]]
+                    emitted += 1
+                    yield self._encode_stream_chunk(
+                        request, servable, out_names, outputs,
+                        off, cnt, total, final=emitted == len(futs),
+                        mirror_content=mirror_content, msg=reuse,
+                    )
+        except BaseException:
+            for task in pending:
+                task.cancel()
+            for f in wrapped.values():
+                if not f.done():
+                    f.cancel()
+            raise
+        self._log_request("predict", request)
 
     # ----------------------------------------------------- Classify / Regress
 
@@ -695,7 +958,10 @@ class PredictionServiceImpl:
         decode. Returns (servable, arrays)."""
         servable, _ = self._resolve(request.model_spec, criticality)
         try:
-            arrays = decode_input(request.input, servable.model.config.num_fields)
+            arrays = decode_input(
+                request.input, servable.model.config.num_fields,
+                arena=self._arena(),
+            )
         except ExampleDecodeError as e:
             raise ServiceError("INVALID_ARGUMENT", str(e)) from e
         return servable, arrays

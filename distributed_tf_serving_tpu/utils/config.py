@@ -62,7 +62,10 @@ class ServerConfig:
     warmup: bool = True
     # Coalescing keeps filling past max_wait while this many batches are in
     # flight (latency-free: the dispatch would queue behind device work
-    # anyway — serving/batcher.py pipeline-aware fill; min 2).
+    # anyway — serving/batcher.py pipeline-aware fill; min 1, default 2).
+    # The [batching] section's pipeline_depth (when nonzero) wins over
+    # this legacy location; the new in-flight window / buffer-ring /
+    # streaming knobs live only there.
     pipeline_depth: int = 2
     # Admission bound in queued candidates (None = 16 max-size batches);
     # past it requests shed with RESOURCE_EXHAUSTED instead of queueing
@@ -159,6 +162,83 @@ class ClientConfig:
     tls_root_certs_file: str = ""
     tls_client_key_file: str = ""
     tls_client_cert_file: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchingConfig:
+    """Continuous-batching pipeline knobs (serving/batcher.py, ISSUE 9):
+    the k-deep dispatch/in-flight window, donation-safe padded-batch
+    buffer reuse, and the server-side sub-batch split PredictStream uses.
+    Every NEW behavior defaults off — pipeline_depth 0 inherits the
+    [server] value (historically 2), inflight_window 0 keeps in-flight
+    readbacks unbounded, buffer_ring false allocates per batch, and
+    stream_chunk_candidates 0 serves PredictStream as a single chunk."""
+
+    # Staged-dispatch depth: how many assembled batches may queue ahead
+    # of the device stage (the coalescer's free-ride gate reads it too).
+    # 0 = inherit [server] pipeline_depth; >= 1 otherwise (1 serializes
+    # assembly against the device stage).
+    pipeline_depth: int = 0
+    # Max batches simultaneously IN FLIGHT (executing or awaiting D2H
+    # readback): the dispatch thread keeps issuing batch k+2 while k
+    # awaits readback until the window fills. 0 = unbounded (historical).
+    inflight_window: int = 0
+    # Reuse padded-batch host buffers across batches (released only after
+    # the owning batch's readback completes — donation-safe).
+    buffer_ring: bool = False
+    # Default candidates per PredictStream sub-batch (the server-side
+    # split; requests may override via x-dts-stream-chunk metadata).
+    # 0 = no split: the streaming RPC answers with one chunk.
+    stream_chunk_candidates: int = 0
+
+    def __post_init__(self):
+        for name in ("pipeline_depth", "inflight_window",
+                     "stream_chunk_candidates"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                raise ValueError(
+                    f"[batching] {name} must be a non-negative integer, "
+                    f"got {v!r}"
+                )
+        if self.inflight_window and self.inflight_window > 64:
+            raise ValueError(
+                "[batching] inflight_window > 64 would pin that many "
+                "batches of HBM at once; this is almost certainly a typo"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class TransportConfig:
+    """Transport-floor knobs (ISSUE 9): the Unix-domain-socket listener
+    for co-located fan-out clients and the reusable response-encode
+    arenas. Both default off (TCP-only, allocate-per-call — the
+    historical behavior)."""
+
+    # Also bind the gRPC server to this Unix-domain socket path (next to
+    # the TCP port). Co-located clients dial "unix:<path>" as the host
+    # string. "" = TCP only.
+    uds_path: str = ""
+    # Route response encodes through per-thread codec.EncodeArena scratch
+    # (and reuse one PredictStreamChunk message per stream) instead of
+    # allocating per call.
+    response_arena: bool = False
+
+    def __post_init__(self):
+        if self.uds_path:
+            if not isinstance(self.uds_path, str):
+                raise ValueError("[transport] uds_path must be a string")
+            # The kernel's sockaddr_un limit is ~107 bytes; failing at
+            # config parse beats failing at bind time inside serve().
+            if len(self.uds_path.encode()) > 100:
+                raise ValueError(
+                    "[transport] uds_path exceeds the AF_UNIX path limit "
+                    f"(~107 bytes): {self.uds_path!r}"
+                )
+            if ":" in self.uds_path:
+                raise ValueError(
+                    "[transport] uds_path is a filesystem path, not a "
+                    f"host:port or URI: {self.uds_path!r}"
+                )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -485,6 +565,8 @@ def _model_config_cls():
 _SECTIONS = {
     "server": ServerConfig,
     "client": ClientConfig,
+    "batching": BatchingConfig,
+    "transport": TransportConfig,
     "observability": ObservabilityConfig,
     "cache": CacheConfig,
     "overload": OverloadConfig,

@@ -290,6 +290,15 @@ _HELP = {
         "role",
     "dts_tpu_lifecycle_blacklisted_versions":
         "Versions the watcher excludes from reconcile after a rollback",
+    "dts_tpu_pipeline_in_flight":
+        "Batches currently executing or awaiting D2H readback "
+        "(the continuous-batching pipeline's live occupancy)",
+    "dts_tpu_pipeline_readback_overlap_fraction":
+        "Fraction of the in-flight D2H window the completers did NOT "
+        "block on (1.0 = readback fully hidden behind other work)",
+    "dts_tpu_pipeline_window_waits_total":
+        "Times the dispatch thread waited for the k-deep in-flight "
+        "window to open before issuing the next batch",
 }
 
 
@@ -457,7 +466,7 @@ class ServerMetrics:
 
     def prometheus_text(
         self, batcher_stats=None, cache=None, overload=None,
-        utilization=None, quality=None, lifecycle=None,
+        utilization=None, quality=None, lifecycle=None, pipeline=None,
     ) -> str:
         """Prometheus exposition (text format 0.0.4) of the same data
         snapshot() serves as JSON. Metric names mirror tensorflow_model_
@@ -562,6 +571,48 @@ class ServerMetrics:
             ):
                 _family_lines(lines, metric, kind)
                 lines.append(f"{metric} {value}")
+        if pipeline is not None:
+            # Continuous-batching pipeline (ISSUE 9): the
+            # batcher.pipeline_stats() snapshot as dts_tpu_pipeline_*
+            # series — configured depth/window, live in-flight occupancy
+            # (total + per bucket), high-water marks, and the
+            # readback-overlap fraction the CPU bench gate reads.
+            for metric, kind, value in (
+                ("dts_tpu_pipeline_depth_configured", "gauge",
+                 pipeline.get("depth", 0)),
+                ("dts_tpu_pipeline_inflight_window", "gauge",
+                 pipeline.get("inflight_window", 0)),
+                ("dts_tpu_pipeline_in_flight", "gauge",
+                 pipeline.get("in_flight", 0)),
+                ("dts_tpu_pipeline_inflight_peak", "gauge",
+                 pipeline.get("inflight_peak", 0)),
+                ("dts_tpu_pipeline_dispatch_pending", "gauge",
+                 pipeline.get("dispatch_pending", 0)),
+                ("dts_tpu_pipeline_window_waits_total", "counter",
+                 pipeline.get("inflight_window_waits", 0)),
+                ("dts_tpu_pipeline_readback_overlap_fraction", "gauge",
+                 pipeline.get("readback_overlap_fraction", 0.0)),
+            ):
+                _family_lines(lines, metric, kind)
+                lines.append(f"{metric} {value}")
+            per_bucket = pipeline.get("per_bucket_in_flight") or {}
+            if per_bucket:
+                bm = "dts_tpu_pipeline_bucket_in_flight"
+                _family_lines(lines, bm, "gauge")
+                for bucket, n in sorted(per_bucket.items()):
+                    lines.append(f'{bm}{{bucket="{esc(bucket)}"}} {n}')
+            ring = pipeline.get("buffer_ring")
+            if ring is not None:
+                for metric, kind, value in (
+                    ("dts_tpu_pipeline_buffer_ring_reuses_total", "counter",
+                     ring.get("reuses", 0)),
+                    ("dts_tpu_pipeline_buffer_ring_allocs_total", "counter",
+                     ring.get("allocs", 0)),
+                    ("dts_tpu_pipeline_buffer_ring_free", "gauge",
+                     ring.get("free_buffers", 0)),
+                ):
+                    _family_lines(lines, metric, kind)
+                    lines.append(f"{metric} {value}")
         if cache is not None:
             # Cache plane (ISSUE 4): the ScoreCache snapshot dict as
             # dts_tpu_cache_* series — aggregate counters/gauges plus

@@ -1,6 +1,6 @@
 """Prometheus exposition lint (tools/check_prom.py, ISSUE 7 satellite):
 the aggregated /monitoring/prometheus/metrics text is assembled from
-seven planes and the lint is what guards the assembly — run it against a
+eight planes and the lint is what guards the assembly — run it against a
 FULLY ARMED server snapshot (every plane emitting, adversarial label
 values), and prove it actually catches each failure mode it claims to."""
 
@@ -45,8 +45,20 @@ def _fully_armed_text() -> str:
     m.observe("Predict", 0.01, ok=True, model='we"ird\\mo\ndel')
     m.observe("Predict", 0.02, ok=False, model="DCN")
     m.observe("REST.Predict", 0.03, ok=True, model="DCN")
+    m.observe("PredictStream", 0.04, ok=True, model="DCN")
     stats = BatcherStats()
     stats.batches, stats.requests = 5, 9
+    stats.inflight_peak, stats.inflight_window_waits = 3, 2
+    # Continuous-batching pipeline snapshot (ISSUE 9): the shape
+    # batcher.pipeline_stats() emits with a buffer ring armed and two
+    # buckets in flight.
+    pipeline = {
+        "depth": 4, "inflight_window": 4, "in_flight": 2,
+        "dispatch_pending": 1, "per_bucket_in_flight": {256: 1, 1024: 1},
+        "inflight_peak": 3, "inflight_window_waits": 2,
+        "readback_overlap_fraction": 0.93,
+        "buffer_ring": {"reuses": 7, "allocs": 3, "free_buffers": 2},
+    }
     cache = ScoreCache()
     ctrl = OverloadConfig(enabled=True).build()
     ctrl.bind(4096, 65536)
@@ -72,6 +84,7 @@ def _fully_armed_text() -> str:
         utilization=ledger.snapshot(),
         quality=quality.snapshot(),
         lifecycle=lifecycle.snapshot(),
+        pipeline=pipeline,
     )
 
 
@@ -82,7 +95,8 @@ def test_fully_armed_snapshot_passes_lint():
     for marker in (
         ":tensorflow:serving:request_count", "dts_tpu_batcher_",
         "dts_tpu_cache_", "dts_tpu_overload_", "dts_tpu_utilization_",
-        "dts_tpu_quality_", "dts_tpu_lifecycle_",
+        "dts_tpu_quality_", "dts_tpu_lifecycle_", "dts_tpu_pipeline_",
+        "dts_tpu_pipeline_bucket_in_flight", "buffer_ring",
     ):
         assert marker in text
 

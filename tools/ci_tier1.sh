@@ -107,6 +107,20 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_QUALITY_SMOKE:-0}" = "1" ]; then
     python tools/check_quality_smoke.py "$QUALITY_LINE" || rc=1
 fi
 
+# Streaming smoke (TIER1_STREAMING_SMOKE=1): the ISSUE-9 correctness
+# gate — streamed (PredictStream, chunked sub-batches) and unary Predict
+# must return BIT-IDENTICAL scores over both TCP and a Unix-domain
+# socket with the fault injector delaying readbacks (chunks genuinely
+# complete out of order), the k-deep pipeline (depth 4, window 4,
+# buffer ring) must overlap batches, and a mid-stream deadline must
+# abort DEADLINE_EXCEEDED (tools/check_streaming_smoke.py).
+if [ "$rc" -eq 0 ] && [ "${TIER1_STREAMING_SMOKE:-0}" = "1" ]; then
+    STREAM_LINE="${TIER1_STREAMING_LINE:-/tmp/tier1_streaming_smoke.json}"
+    echo "tier1: streaming smoke (line $STREAM_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python tools/check_streaming_smoke.py | tee "$STREAM_LINE" || rc=1
+fi
+
 # Lifecycle smoke (TIER1_LIFECYCLE_SMOKE=1): a SOAK_LIFECYCLE=1 soak —
 # trained model behind a real version watcher + lifecycle controller;
 # the driver publishes a fine-tuned GOOD canary (must auto-promote) and
