@@ -2168,6 +2168,152 @@ def child_main() -> None:
                 watcher.stop()
                 await server.stop(0)
 
+        async def serve_recovery():
+            nonlocal stage
+            stage = "recovery"
+            # Device-failure recovery cost (ISSUE 11, opt-in via
+            # DTS_BENCH_RECOVERY=1): MTTR (deterministic device_lost
+            # injection -> first post-recovery success) and the added
+            # latency of the REPLAYED in-flight requests, vs an adjacent
+            # steady window of the same closed loop — rides the PR-6
+            # --json-out mirror like every diagnostic block, so a TPU
+            # round records it even when stdout truncates. Off by default
+            # so headlines stay comparable.
+            from distributed_tf_serving_tpu import faults
+            from distributed_tf_serving_tpu.serving.recovery import (
+                RecoveryController,
+            )
+            from distributed_tf_serving_tpu.utils.config import RecoveryConfig
+
+            server, port = create_server_async(impl, "127.0.0.1:0")
+            await server.start()
+            rec = RecoveryController(
+                RecoveryConfig(
+                    enabled=True, watchdog_interval_s=0.2,
+                    wedge_quarantine_s=5.0, replay_drain_s=15.0,
+                ),
+                batcher, registry=registry, impl=impl,
+            ).start()
+            impl.recovery = rec
+            try:
+                batcher.max_batch_candidates = min(2048, batcher.buckets[-1])
+                payload = make_payload(
+                    candidates=CANDIDATES, num_fields=NUM_FIELDS, seed=88
+                )
+                conc = 4
+                window_s = float(
+                    os.environ.get("DTS_BENCH_RECOVERY_WINDOW_S", "6")
+                )
+
+                async def timed_loop(client, run_s):
+                    samples: list = []  # (t_start, t_end, ms)
+                    errs = [0]
+
+                    async def w():
+                        end = time.perf_counter() + run_s
+                        while time.perf_counter() < end:
+                            t0 = time.perf_counter()
+                            try:
+                                await client.predict(payload, sort_scores=True)
+                                t1 = time.perf_counter()
+                                samples.append((t0, t1, (t1 - t0) * 1e3))
+                            except Exception:  # noqa: BLE001 — the error
+                                errs[0] += 1    # COUNT is the measurement
+                    await asyncio.gather(*(w() for _ in range(conc)))
+                    return samples, errs[0]
+
+                async with ShardedPredictClient(
+                    [f"127.0.0.1:{port}"], "DCN",
+                    channels_per_host=scale.channels_per_host,
+                    scoreboard=True, failover_attempts=8,
+                    backoff_initial_s=0.2, backoff_max_s=2.0,
+                    timeout_s=30.0, max_attempts_total=16,
+                ) as client:
+                    for _ in range(5):
+                        await client.predict(payload, sort_scores=True)
+                    log(stage, f"steady window {window_s}s x {conc} workers")
+                    steady, err_a = await timed_loop(client, window_s)
+                    inject = {"t": None}
+
+                    async def inject_mid():
+                        await asyncio.sleep(window_s * 0.25)
+                        inject["t"] = time.perf_counter()
+                        faults.get().add(
+                            "device_lost", "error", code="UNAVAILABLE",
+                            count=1,
+                        )
+
+                    log(stage, f"fault window {window_s}s "
+                               "(device_lost at 25%)")
+                    (faulted, err_b), _ = await asyncio.gather(
+                        timed_loop(client, window_s), inject_mid()
+                    )
+                finally_inj = inject["t"]
+                steady_lat = np.asarray([ms for _, _, ms in steady])
+                fault_lat = np.asarray([ms for _, _, ms in faulted])
+                # Requests IN FLIGHT at injection are exactly the replayed
+                # cohort. MTTR here = injection -> the LAST affected
+                # request answered (fault to full recovery of the work it
+                # stranded) — NOT the first post-injection success, which
+                # with concurrent workers is just an unaffected request
+                # finishing milliseconds later.
+                replayed_done = [
+                    (t1, ms) for t0, t1, ms in faulted
+                    if finally_inj is not None and t0 < finally_inj < t1
+                ]
+                replayed = [ms for _, ms in replayed_done]
+                p50_steady = (
+                    float(np.percentile(steady_lat, 50))
+                    if steady_lat.size else None
+                )
+
+                def pct(a, q):
+                    return (
+                        round(float(np.percentile(a, q)), 3) if a.size else None
+                    )
+
+                res["recovery"] = {
+                    "window_s_each": window_s,
+                    "steady": {
+                        "requests": int(steady_lat.size),
+                        "p50_ms": pct(steady_lat, 50),
+                        "p99_ms": pct(steady_lat, 99),
+                        "errors": err_a,
+                    },
+                    "fault_window": {
+                        "requests": int(fault_lat.size),
+                        "p50_ms": pct(fault_lat, 50),
+                        "p99_ms": pct(fault_lat, 99),
+                        "errors": err_b,
+                    },
+                    "mttr_s": (
+                        round(max(t1 for t1, _ in replayed_done)
+                              - finally_inj, 3)
+                        if replayed_done and finally_inj is not None
+                        else None
+                    ),
+                    # The controller's own cycle clock (detection ->
+                    # reinit -> replay drained) next to the wall-clock
+                    # MTTR above.
+                    "cycle_duration_s": (
+                        (rec.snapshot()["last_cycle"] or {}).get("duration_s")
+                    ),
+                    "replayed_requests": len(replayed),
+                    "replayed_added_ms": (
+                        round(max(replayed) - p50_steady, 3)
+                        if replayed and p50_steady is not None else None
+                    ),
+                    "controller": {
+                        k: v for k, v in rec.snapshot()["counters"].items()
+                    },
+                }
+                log(stage, json.dumps(res["recovery"]))
+            finally:
+                impl.recovery = None
+                rec.stop()
+                faults.get().clear("device_lost")
+                await server.stop(0)
+
         asyncio.run(serve_windows())
         report = res["report"]
         s = report.summary()
@@ -2234,6 +2380,8 @@ def child_main() -> None:
             asyncio.run(serve_overload_ab())
         if os.environ.get("DTS_BENCH_LIFECYCLE", "0") == "1":
             asyncio.run(serve_lifecycle())
+        if os.environ.get("DTS_BENCH_RECOVERY", "0") == "1":
+            asyncio.run(serve_recovery())
         batcher.stop()
 
         asyncio.run(measure_host_ceiling())
@@ -2289,6 +2437,11 @@ def child_main() -> None:
             # -> canary -> promote vs an adjacent steady window; absent
             # when the block is off (the default).
             "lifecycle": res.get("lifecycle"),
+            # Device-failure recovery cost (ISSUE 11, DTS_BENCH_RECOVERY
+            # =1): MTTR (fault injection -> first post-recovery success)
+            # and the replayed in-flight requests' added latency vs the
+            # steady window; absent when the block is off (the default).
+            "recovery": res.get("recovery"),
             # Output-transfer pipeline attribution (ISSUE 1): wire bytes
             # fetched vs. the full-fp32 all-outputs baseline, and the
             # fraction of the in-flight D2H window the completers never

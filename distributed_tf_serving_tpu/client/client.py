@@ -86,6 +86,33 @@ class ResilienceCounters:
     # server-sent retry-after-ms pushback hint.
     pushbacks_received: int = 0
     retry_after_honored: int = 0
+    # Retry budget (ISSUE 11): requests whose per-request attempt budget
+    # (max_attempts_total across failover hops + hedges + streamed
+    # reroutes) ran out — the storm-suppression the recovery plane's
+    # quarantine relies on.
+    retry_budget_exhausted: int = 0
+
+
+class _AttemptBudget:
+    """Per-logical-request pool of EXTRA backend attempts (beyond each
+    shard's guaranteed first try): failover retries and hedges draw from
+    it; when dry, the shard fails with its last error instead of
+    mounting another attempt. Shared by every shard task of one request
+    (asyncio single-threaded mutation — no lock needed)."""
+
+    __slots__ = ("left", "tripped")
+
+    def __init__(self, extra: int):
+        self.left = max(int(extra), 0)
+        # Exhaustion is counted ONCE per logical request, not once per
+        # shard/hedge that notices the dry pool.
+        self.tripped = False
+
+    def take(self) -> bool:
+        if self.left > 0:
+            self.left -= 1
+            return True
+        return False
 
 
 # Overload-plane wire metadata (serving/overload.py repeats these; the
@@ -276,6 +303,7 @@ class ShardedPredictClient:
         score_cache=None,
         criticality: str = "",
         stream_chunk_candidates: int = 0,
+        max_attempts_total: int = 0,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -356,6 +384,15 @@ class ShardedPredictClient:
         # default). First-scores latencies are tracked per streamed shard
         # (bounded ring) — the number streaming exists to improve.
         self.stream_chunk_candidates = max(int(stream_chunk_candidates or 0), 0)
+        # Retry budget (ISSUE 11 satellite): cap on TOTAL backend
+        # attempts per logical request across failover hops + hedges +
+        # streamed reroutes. A replica recovering from a device failure
+        # answers UNAVAILABLE while quarantined; without a cap, every
+        # client's failover × hedging could multiply one request into a
+        # fleet-wide retry storm against the survivors. Each shard's
+        # first attempt is always allowed; the budget bounds the rest.
+        # 0 = unlimited (historical behavior).
+        self.max_attempts_total = max(int(max_attempts_total or 0), 0)
         self._first_score_ms: list[float] = []
         self.counters = ResilienceCounters()
         self._health_stubs: list[object | None] = [None] * len(self.hosts)
@@ -542,13 +579,14 @@ class ShardedPredictClient:
 
     async def _attempt(
         self, i: int, rr: int, host_idx: int, invoke, used: list[int],
-        attempt: int = 0,
+        attempt: int = 0, budget: "_AttemptBudget | None" = None,
     ):
         """One failover attempt, optionally hedged: the primary RPC runs on
         `host_idx`; after hedge_delay_s without an answer a second attempt
         fires on another healthy host — first ANSWER wins, the loser is
         cancelled. Hosts burned here are appended to `used` so the outer
-        loop never re-tries them."""
+        loop never re-tries them. A hedge is an OPTIONAL extra attempt,
+        so it draws from the per-request retry budget when one is set."""
         if not self.hedge_delay_s or len(self.hosts) < 2:
             # No task wrapper: the coroutine is awaited inline, so an outer
             # cancellation (gather's sibling-cancel on another shard's
@@ -564,6 +602,13 @@ class ShardedPredictClient:
             hedge = None
             if not done:
                 hedge_idx = self._hedge_target(used)
+                if hedge_idx is not None and (
+                    budget is not None and not budget.take()
+                ):
+                    # Budget dry: the hedge is skipped (the primary keeps
+                    # running — nothing is lost but the duplicate work).
+                    self._note_budget_exhausted(budget)
+                    hedge_idx = None
                 if hedge_idx is not None:
                     used.append(hedge_idx)
                     self.counters.hedges_fired += 1
@@ -624,8 +669,28 @@ class ShardedPredictClient:
             return False
         return resp.status == health_proto.SERVING
 
+    def _new_budget(self, shards: int) -> "_AttemptBudget | None":
+        """Per-request attempt budget, or None when the knob is off.
+        Each shard's first attempt is guaranteed (the request cannot run
+        without it), so the pool holds max(max_attempts_total - shards,
+        0) EXTRA attempts shared across failover hops and hedges."""
+        if not self.max_attempts_total:
+            return None
+        return _AttemptBudget(self.max_attempts_total - shards)
+
+    def _note_budget_exhausted(self, budget: "_AttemptBudget") -> None:
+        """Count one REQUEST's budget exhaustion (first trip only: every
+        shard task and skipped hedge of the same request shares one
+        budget, and the counter's contract is requests, not sites)."""
+        if budget.tripped:
+            return
+        budget.tripped = True
+        self.counters.retry_budget_exhausted += 1
+        if self.scoreboard is not None:
+            self.scoreboard.note_retry_budget_exhausted()
+
     async def _shard_call(
-        self, i: int, rr: int, invoke, extract=None
+        self, i: int, rr: int, invoke, extract=None, budget=None
     ) -> np.ndarray:
         """One shard's RPC with failover: `invoke(stub, metadata)` issues
         the call on the chosen stub (message path uses stub.Predict,
@@ -640,15 +705,23 @@ class ShardedPredictClient:
         on, the shard gets a span whose children are the individual
         attempts (failover hops and hedges as siblings)."""
         with tracing.start_span("client.shard", attrs={"shard": i}):
-            return await self._shard_call_impl(i, rr, invoke, extract)
+            return await self._shard_call_impl(i, rr, invoke, extract, budget)
 
     async def _shard_call_impl(
-        self, i: int, rr: int, invoke, extract=None
+        self, i: int, rr: int, invoke, extract=None, budget=None
     ) -> np.ndarray:
         n = len(self.hosts)
         used: list[int] = []
         last: _ShardAttemptError | None = None
         for attempt in range(self.failover_attempts + 1):
+            if attempt and budget is not None and not budget.take():
+                # Per-request retry budget dry (failover hops + hedges +
+                # streamed reroutes all drew from it): fail with the last
+                # error instead of mounting another attempt — a
+                # recovering replica must not face the whole fleet's
+                # multiplied retries.
+                self._note_budget_exhausted(budget)
+                break
             if self.scoreboard is not None:
                 host_idx = self.scoreboard.pick(i % n, exclude=tuple(used))
             else:
@@ -722,7 +795,8 @@ class ShardedPredictClient:
                             )
                         continue
                 resp = await self._attempt(
-                    i, rr, host_idx, invoke, used, attempt=attempt
+                    i, rr, host_idx, invoke, used, attempt=attempt,
+                    budget=budget,
                 )
             except asyncio.CancelledError:
                 if self.scoreboard is not None:
@@ -761,7 +835,9 @@ class ShardedPredictClient:
 
         return resilience_prometheus_text(self.resilience_counters())
 
-    async def _predict_shard(self, i: int, shard: dict[str, np.ndarray], rr: int) -> np.ndarray:
+    async def _predict_shard(
+        self, i: int, shard: dict[str, np.ndarray], rr: int, budget=None
+    ) -> np.ndarray:
         req = build_predict_request(
             shard,
             self.model_name,
@@ -775,6 +851,7 @@ class ShardedPredictClient:
             lambda stub, metadata=None: stub.Predict(
                 req, timeout=self.timeout_s, metadata=metadata
             ),
+            budget=budget,
         )
 
     async def _fan_out(
@@ -925,8 +1002,12 @@ class ShardedPredictClient:
             attrs={"model": self.model_name, "candidates": n,
                    "shards": len(shards)},
         ):
+            budget = self._new_budget(len(shards))
             return await self._fan_out(
-                [self._predict_shard(i, s, rr) for i, s in enumerate(shards)],
+                [
+                    self._predict_shard(i, s, rr, budget)
+                    for i, s in enumerate(shards)
+                ],
                 sort_scores,
                 bounds=bounds,
             )
@@ -958,7 +1039,7 @@ class ShardedPredictClient:
 
     async def _predict_shard_stream(
         self, i: int, shard: dict[str, np.ndarray], rr: int,
-        chunk: int | None,
+        chunk: int | None, budget=None,
     ) -> np.ndarray:
         req = build_predict_request(
             shard,
@@ -1006,7 +1087,9 @@ class ShardedPredictClient:
                 self._note_first_scores(first_ms)
             return merger.result()
 
-        return await self._shard_call(i, rr, invoke, extract=lambda r: r)
+        return await self._shard_call(
+            i, rr, invoke, extract=lambda r: r, budget=budget
+        )
 
     async def predict_streamed(
         self, arrays: dict[str, np.ndarray], sort_scores: bool = False,
@@ -1034,9 +1117,10 @@ class ShardedPredictClient:
             attrs={"model": self.model_name, "candidates": n,
                    "shards": len(shards), "streamed": True},
         ):
+            budget = self._new_budget(len(shards))
             return await self._fan_out(
                 [
-                    self._predict_shard_stream(i, s, rr, chunk)
+                    self._predict_shard_stream(i, s, rr, chunk, budget)
                     for i, s in enumerate(shards)
                 ],
                 sort_scores,
@@ -1061,12 +1145,15 @@ class ShardedPredictClient:
         n = next(iter(arrays.values())).shape[0]
         return PreparedRequest(shard_blobs=blobs, candidates=n)
 
-    async def _predict_shard_raw(self, i: int, blob: bytes, rr: int) -> np.ndarray:
+    async def _predict_shard_raw(
+        self, i: int, blob: bytes, rr: int, budget=None
+    ) -> np.ndarray:
         return await self._shard_call(
             i, rr,
             lambda stub, metadata=None: stub.PredictRaw(
                 blob, timeout=self.timeout_s, metadata=metadata
             ),
+            budget=budget,
         )
 
     async def predict_prepared(
@@ -1087,9 +1174,10 @@ class ShardedPredictClient:
             attrs={"model": self.model_name, "candidates": prep.candidates,
                    "shards": len(prep.shard_blobs), "prepared": True},
         ):
+            budget = self._new_budget(len(prep.shard_blobs))
             return await self._fan_out(
                 [
-                    self._predict_shard_raw(i, b, rr)
+                    self._predict_shard_raw(i, b, rr, budget)
                     for i, b in enumerate(prep.shard_blobs)
                 ],
                 sort_scores,
@@ -1134,6 +1222,7 @@ def client_from_config(cfg) -> ShardedPredictClient:
         keepalive_time_ms=cfg.keepalive_time_ms,
         keepalive_timeout_ms=cfg.keepalive_timeout_ms,
         criticality=cfg.criticality,
+        max_attempts_total=cfg.max_attempts_total,
     )
 
 

@@ -96,6 +96,11 @@ class BackendScoreboard:
         self.probes = 0
         self.recoveries = 0
         self.pushbacks = 0
+        # Retry-budget trips (ISSUE 11): requests whose per-request
+        # attempt cap (client max_attempts_total) ran dry — the
+        # storm-suppression evidence next to the ejection counters it
+        # guards against amplifying.
+        self.retry_budget_exhausted = 0
 
     # ------------------------------------------------------------ recording
 
@@ -243,6 +248,13 @@ class BackendScoreboard:
             self._advance_locked(self._states[idx])
             return self._states[idx].state
 
+    def note_retry_budget_exhausted(self) -> None:
+        """One request's attempt budget ran out (client retry-budget
+        satellite): counted here so the scoreboard snapshot — the
+        resilience surface benches/soaks already read — carries it."""
+        with self._lock:
+            self.retry_budget_exhausted += 1
+
     def release_probe(self, idx: int) -> None:
         """Free a half-open probe slot whose request was CANCELLED (hedge
         loser) — neither success nor failure was observed, so the slot must
@@ -280,6 +292,7 @@ class BackendScoreboard:
                 "probes": self.probes,
                 "recoveries": self.recoveries,
                 "pushbacks": self.pushbacks,
+                "retry_budget_exhausted": self.retry_budget_exhausted,
                 "backends": {
                     host: {
                         "state": st.state,

@@ -25,6 +25,21 @@ Named sites (the instrumented hooks):
                         the rule is installed — brownout stale-serve and
                         shed-lane behavior become testable without
                         generating real overload
+- ``device_lost``       the device stage of one batch, fired once per
+                        member request with ``key`` = that request's
+                        poison digest (batcher.poison_fault_key over its
+                        prepared arrays) — a KEYLESS rule kills any batch
+                        (the device-died scenario the recovery plane
+                        quarantines on), a KEYED rule kills exactly the
+                        batches containing one specific request's content
+                        (the deterministic poisoned-input the bisection
+                        isolates). Only fired while a device_lost rule is
+                        installed (has_site), so chaos runs without one
+                        never pay the per-item digest
+- ``executor_abort``    the completer's result path (batcher._complete,
+                        next to ``readback``): the executor aborted after
+                        dispatch — the recovery plane classifies it
+                        device-fatal exactly like device_lost
 
 Rule kinds:
 
@@ -60,7 +75,10 @@ import time
 
 from .utils import tracing
 
-SITES = ("decode", "batcher.dispatch", "readback", "client.rpc")
+SITES = (
+    "decode", "batcher.dispatch", "readback", "client.rpc",
+    "device_lost", "executor_abort",
+)
 KINDS = ("delay", "error", "wedge")
 
 
@@ -165,6 +183,13 @@ class FaultInjector:
             self.fires.clear()
             if seed is not None:
                 self.seed = seed
+
+    def has_site(self, site: str) -> bool:
+        """True when ANY rule (spent or not) targets `site` — the cheap
+        pre-gate call sites use before paying per-item key derivation
+        (the device_lost poison digests)."""
+        with self._lock:
+            return any(r.site == site for r in self._rules)
 
     def snapshot(self) -> dict:
         with self._lock:

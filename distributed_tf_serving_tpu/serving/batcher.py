@@ -121,6 +121,54 @@ class DeviceWedgedError(RuntimeError):
     moment the stuck batch completes."""
 
 
+class DeviceQuarantinedError(DeviceWedgedError):
+    """The recovery plane (serving/recovery.py) has quarantined this
+    replica: the device executor is being torn down and rebuilt, so new
+    work fails fast (UNAVAILABLE — fan-out clients reroute via the
+    scoreboard) while the in-flight/queued work the replica already
+    accepted rides the replay path instead of dying. Subclasses
+    DeviceWedgedError so every existing status mapping and handler stays
+    correct."""
+
+
+class PoisonedInputError(ValueError):
+    """This request's input deterministically kills the device executor:
+    the recovery plane's bisection replayed progressively smaller
+    sub-batches after repeated executor deaths and isolated THIS request
+    as the culprit. A ValueError (-> INVALID_ARGUMENT at the RPC layer,
+    the DISTINCT status the recovery contract promises): retrying the
+    same bytes anywhere would kill another executor, so the client must
+    not fail over with it — while the batchmates it took down are
+    re-dispatched and succeed."""
+
+
+class BatcherThreadDead(RuntimeError):
+    """The batching loop, the pipelined dispatch stage, or a completer
+    worker died from an unhandled exception. Every queued waiter is
+    failed with this immediately and new submits raise it up front —
+    submitters must never hang on the condition variable waiting for a
+    thread that no longer exists. Maps to UNAVAILABLE (RuntimeError
+    catch-all); the recovery plane, when armed, revives the thread and
+    replays the shed work instead."""
+
+
+def poison_fault_key(arrays: dict) -> str:
+    """Content digest of one request's PREPARED input arrays (the bytes
+    _WorkItem.arrays holds — post prepare_inputs, pre fold) — the `key`
+    the device_lost fault site fires with once per batch member, so a
+    keyed rule deterministically kills exactly the batches containing one
+    specific request's content. Tests/soaks compute the same digest over
+    the payload they submit to address their poison rule."""
+    h = hashlib.blake2b(digest_size=8)
+    for name in sorted(arrays):
+        arr = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        # uint8 view: ml_dtypes arrays refuse the buffer protocol
+        # directly (the DeviceInputCache._key precedent).
+        h.update(arr.view(np.uint8).data)
+    return h.hexdigest()
+
+
 class RequestDeadlineError(TimeoutError):
     """Queued work whose CLIENT deadline expired before a dispatch slot
     opened: shed instead of executed — the caller stopped listening, so the
@@ -455,6 +503,14 @@ class _WorkItem:
     # chunk flush) completes independently. Coalescing would concatenate
     # the stream right back into the one big batch it was split from.
     solo: bool = False
+    # Recovery plane (ISSUE 11): how many times this item has been
+    # re-dispatched by the replay path, how many device executors its
+    # batches have killed, and — during poisoned-input bisection — the
+    # half it belongs to (the coalescer only merges items with EQUAL
+    # bisect_key, so a bisected half dispatches as its own batch).
+    replays: int = 0
+    device_kills: int = 0
+    bisect_key: int | None = None
 
 
 def _replay_group_phases(group: list["_WorkItem"], phases: list) -> None:
@@ -570,6 +626,21 @@ class DynamicBatcher:
         quality=None,
     ):
         self.compress_transfer = compress_transfer
+        # Device-failure recovery plane (serving/recovery.py): a
+        # RecoveryController attached post-construction. When set, a
+        # device-fatal batch failure hands its work items to the
+        # controller for quarantine -> reinit -> replay instead of
+        # failing their futures, new submits are refused while the
+        # executor rebuilds, and the dispatching/in-flight GROUPS are
+        # tracked so a wedge-triggered capture can replay them. None
+        # (default) costs one attribute read per hook — the
+        # tracing/cache/overload precedent.
+        self.recovery = None
+        # Thread-death watchdog (recovery satellite): set to the
+        # BatcherThreadDead the moment any batcher-owned thread dies from
+        # an unhandled exception; submit() fails fast on it instead of
+        # letting submitters hang on the condition variable.
+        self._dead: BatcherThreadDead | None = None
         # Model-quality plane (serving/quality.py): a QualityMonitor fed
         # one observe() per completed non-warmup request from _complete —
         # scores are already in host f32 memory post-readback, so the
@@ -673,6 +744,13 @@ class DynamicBatcher:
         self._dispatching_since: float | None = None
         self._inflight: dict[int, float] = {}
         self._inflight_seq = 0
+        # Recovery bookkeeping (populated only while a RecoveryController
+        # is attached): the group currently in the device stage and the
+        # groups executing-or-awaiting-readback, registered/popped at the
+        # same _cv sites as the wedge clock so a quarantine capture can
+        # replay the EXACT work a wedged device stranded.
+        self._dispatching_group: list | None = None
+        self._inflight_groups: dict[int, list] = {}
         # Per-bucket in-flight accounting (continuous batching, ISSUE 9):
         # bucket -> batches currently executing-or-awaiting-readback, fed
         # under _cv at the same register/pop sites as _inflight so the
@@ -720,6 +798,10 @@ class DynamicBatcher:
         # what pipelines over host<->device link latency (jax dispatch is
         # async; only the fetch blocks). Several workers = several batches'
         # readbacks in flight.
+        # Retained for the recovery plane's pool rebuild
+        # (replace_workers_for_recovery) — the recovered server must keep
+        # this configured readback concurrency.
+        self.completion_workers = completion_workers
         self._completers = ThreadPoolExecutor(
             # At least one completer per in-flight-window slot: a window
             # deeper than the pool would leave issued readbacks queued
@@ -747,8 +829,17 @@ class DynamicBatcher:
         flight — or `timeout_s` elapses. True = fully drained. The
         graceful-shutdown path (serving/server.py GracefulShutdown) calls
         this AFTER new admissions are refused, so the wait is bounded by
-        the work already accepted, not by arriving traffic."""
+        the work already accepted, not by arriving traffic.
+
+        Recovery interplay (ISSUE 11 satellite): while the recovery plane
+        holds captured work (quarantine/reinit/replay in progress), the
+        queue can look empty here even though accepted requests are still
+        pending replay — the predicate observes the controller's
+        cycle_active() so drain neither returns a false True mid-REINIT
+        nor deadlocks: the wait stays bounded by `timeout_s` (the
+        remaining grace) and GracefulShutdown aborts the cycle first."""
         deadline = time.perf_counter() + max(timeout_s, 0.0)
+        rec = self.recovery
         with self._cv:
             while (
                 self._items
@@ -756,7 +847,12 @@ class DynamicBatcher:
                 or self._inflight
                 or self._dispatch_pending
                 or self._dispatching_since is not None
+                or (rec is not None and rec.cycle_active())
             ):
+                if self._dead is not None:
+                    # A dead batching thread will never drain this work;
+                    # the waiters were already failed fast.
+                    return False
                 left = deadline - time.perf_counter()
                 if left <= 0:
                     return False
@@ -837,6 +933,12 @@ class DynamicBatcher:
         queueing work no deadline survives."""
         if self._stopping:
             raise RuntimeError("batcher is stopped")
+        if self._dead is not None:
+            # Thread-death watchdog: a batcher-owned thread died from an
+            # unhandled exception — fail fast instead of queueing work
+            # nobody will ever dispatch (the recovery plane, when armed,
+            # revives the thread and clears this).
+            raise self._dead
         ns = {k: v.shape[0] for k, v in arrays.items()}
         n = next(iter(ns.values()))
         if any(v != n for v in ns.values()):
@@ -907,7 +1009,20 @@ class DynamicBatcher:
         # afford it. Capacity is reserved under the lock so concurrent
         # submits cannot overshoot while this one prepares its arrays.
         ov = self.overload
+        rec = self.recovery
         with self._cv:
+            if rec is not None and not _warmup and rec.refusing():
+                # Quarantine gate (recovery plane): the executor is being
+                # torn down/rebuilt — refuse NEW work fast (UNAVAILABLE,
+                # clients failover via the scoreboard) while the already-
+                # accepted work rides the replay path. Warmup is exempt:
+                # the REINIT phase re-warms the bucket ladder through
+                # this very queue.
+                raise DeviceQuarantinedError(
+                    "replica quarantined: device executor is being "
+                    f"rebuilt (recovery state {rec.state()}); retry "
+                    "against another backend"
+                )
             stuck_s = self._wedged_for(time.perf_counter())
             if stuck_s:
                 exc = DeviceWedgedError(
@@ -1164,6 +1279,181 @@ class DynamicBatcher:
         if self.buffer_ring is not None:
             out["buffer_ring"] = self.buffer_ring.snapshot()
         return out
+
+    # ------------------------------------------- recovery plane (ISSUE 11)
+
+    def wedge_age(self) -> float:
+        """Seconds the OLDEST dispatched-or-in-flight batch has been
+        outstanding (0.0 when idle/healthy) — the raw wedge clock the
+        recovery watchdog escalates into a quarantine decision at its own
+        (usually much lower) threshold, independent of the circuit
+        breaker's fail-fast bound."""
+        with self._cv:
+            now = time.perf_counter()
+            worst = 0.0
+            if self._dispatching_since is not None:
+                worst = now - self._dispatching_since
+            for t0 in self._inflight.values():
+                worst = max(worst, now - t0)
+            return worst
+
+    def capture_for_recovery(self) -> tuple[list, list]:
+        """Quarantine capture: pop EVERY accepted-but-unanswered work item
+        out of the batcher — queued items, staged groups, the group in the
+        device stage, and every group executing-or-awaiting-readback — and
+        clear the wedge bookkeeping so the rebuilt executor starts with a
+        clean clock. Returns (queued_items, inflight_groups): queued items
+        were never in a failing device call (replayed without a kill
+        mark), in-flight groups were (the wedge IS their kill evidence).
+
+        Safe against the stranded threads by construction: a wedged stage
+        call whose sid was popped no-ops when it eventually runs, a stuck
+        readback that eventually completes resolves futures the replay
+        already resolved (set_result is first-wins, InvalidStateError
+        guarded), and the pending-count decrements clamp at zero."""
+        with self._cv:
+            queued: list[_WorkItem] = []
+            while self._items:
+                it = self._items.popleft()
+                self._queued_candidates -= it.n
+                if not it.future.done():
+                    queued.append(it)
+            for sid in list(self._staged_groups):
+                group, total = self._staged_groups.pop(sid)
+                self._staged_candidates -= total
+                queued.extend(it for it in group if not it.future.done())
+            inflight: list[list[_WorkItem]] = []
+            if self._dispatching_group is not None:
+                live = [
+                    it for it in self._dispatching_group
+                    if not it.future.done()
+                ]
+                if live:
+                    inflight.append(live)
+                self._dispatching_group = None
+            for group in self._inflight_groups.values():
+                live = [it for it in group if not it.future.done()]
+                if live:
+                    inflight.append(live)
+            self._inflight_groups.clear()
+            self._inflight.clear()
+            self._inflight_buckets.clear()
+            self._dispatching_since = None
+            self._dispatch_pending = 0
+            self._cv.notify_all()
+        return queued, inflight
+
+    def requeue_for_replay(self, items: list) -> None:
+        """Re-enqueue captured/failed items at the FRONT of the queue (the
+        replay path; they were accepted before anything now queued).
+        Admission is deliberately bypassed — this work was already
+        admitted once — and enqueue_t restarts so replay queue-wait is
+        charged to the replay, while the propagated client deadline rides
+        along unchanged (a waiter that gave up mid-recovery is shed
+        exactly like any expired item)."""
+        now = time.perf_counter()
+        with self._cv:
+            for it in reversed(items):
+                it.enqueue_t = now
+                self._items.appendleft(it)
+                self._queued_candidates += it.n
+            self._cv.notify_all()
+
+    def replace_workers_for_recovery(self) -> None:
+        """Abandon the dispatch/completer pools (a thread wedged inside a
+        native device call cannot be preempted in-process — the pool
+        around it can) and mint fresh ones so REPLAY has live workers.
+        The old pools shut down without waiting: their idle threads exit,
+        a stranded one finishes (or never does) against bookkeeping that
+        capture_for_recovery already reset."""
+        old_dispatcher, old_completers = self._dispatcher, self._completers
+        if self._dispatcher is not None:
+            self._dispatcher = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="batch-dispatch"
+            )
+        self._completers = ThreadPoolExecutor(
+            # The constructor's sizing rule, not a hardcoded floor: a
+            # recovered server must keep its configured readback
+            # concurrency.
+            max_workers=max(self.completion_workers, self.inflight_window),
+            thread_name_prefix="batch-complete",
+        )
+        for pool in (old_dispatcher, old_completers):
+            if pool is not None:
+                pool.shutdown(wait=False)
+
+    def revive_batching_thread(self) -> bool:
+        """Clear a thread-death verdict and restart the batching loop if
+        it is gone (recovery REINIT). True when a restart happened. The
+        dying thread reports its own death BEFORE its final frames
+        unwind, so on a RECORDED death a brief join lets it actually exit
+        — without it the is_alive() check would read the corpse as a
+        live loop. No death recorded = no join: a healthy loop blocked
+        in _take must not add a fixed stall to every recovery cycle."""
+        with self._cv:
+            died = self._dead is not None
+            self._dead = None
+        t = self._thread
+        if died and t.is_alive() and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        if (
+            self._started
+            and not self._stopping
+            and not self._thread.is_alive()
+        ):
+            self._thread = threading.Thread(
+                target=self._loop, name="batcher", daemon=True
+            )
+            self._thread.start()
+            return True
+        return False
+
+    def _note_thread_death(self, which: str, exc: BaseException) -> None:
+        """A batcher-owned thread died from an unhandled exception: record
+        the verdict so submit() fails fast, fail everything queued (no
+        recovery plane) or hand the death to the recovery plane (armed —
+        it revives the thread and replays), and wake every waiter."""
+        err = BatcherThreadDead(
+            f"batcher {which} thread died: {type(exc).__name__}: {exc}"
+        )
+        err.__cause__ = exc
+        rec = self.recovery
+        with self._cv:
+            first = self._dead is None
+            if first:
+                self._dead = err
+            self._cv.notify_all()
+        # Hand the death to the recovery plane ONLY if it accepts it (a
+        # stopped controller — drain in progress — returns False): queued
+        # waiters are either replayed by the cycle or failed fast here,
+        # never left hanging between the two.
+        handled = rec is not None and first and rec.note_thread_death(err)
+        if first and not handled:
+            with self._cv:
+                self._shed_queued(err)
+                self._cv.notify_all()
+
+    def _guard_worker_future(self, fut: Future, group: list, which: str) -> None:
+        """Done-callback on dispatch/completer pool submissions: the stage
+        bodies catch Exception, so anything surfacing HERE is an escape
+        (BaseException, a bug in a finally) that would otherwise strand
+        the group's waiters silently. Fail them fast and record the
+        death."""
+        exc = fut.exception()
+        if exc is None:
+            return
+        for it in group:
+            if not it.future.done():
+                try:
+                    it.future.set_exception(
+                        BatcherThreadDead(
+                            f"batcher {which} worker died: "
+                            f"{type(exc).__name__}: {exc}"
+                        )
+                    )
+                except InvalidStateError:
+                    pass
+        self._note_thread_death(which, exc)
 
     # ------------------------------------------------------------- internals
 
@@ -1656,6 +1946,10 @@ class DynamicBatcher:
                 if (
                     nxt.servable is item.servable
                     and not nxt.solo
+                    # Bisection halves (recovery plane) only merge with
+                    # their OWN half: a half that re-absorbed the other
+                    # half's rows would never isolate the poison.
+                    and nxt.bisect_key == item.bisect_key
                     and nxt.arrays.keys() == item.arrays.keys()
                     and total + nxt.n <= self.max_batch_candidates
                 ):
@@ -1665,6 +1959,17 @@ class DynamicBatcher:
                 return None
 
     def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as exc:  # noqa: BLE001 — thread-death watchdog
+            # An unhandled exception here would silently kill the batching
+            # thread and leave every submitter hanging on the condition
+            # variable until its RPC deadline. Fail fast and visibly
+            # instead (BatcherThreadDead), and let the recovery plane —
+            # when armed — revive the thread and replay the shed work.
+            self._note_thread_death("batching", exc)
+
+    def _loop_inner(self) -> None:
         while True:
             item = self._take()
             if item is None:
@@ -1846,6 +2151,12 @@ class DynamicBatcher:
         self._dispatcher.submit(
             self._run_stage, sid, group, total, bucket, wanted, wanted_key,
             topk, n_valid, fused, batched, phases, scatter, ring_bufs,
+        ).add_done_callback(
+            # Thread-death guard: _run_stage catches Exception broadly,
+            # so only a BaseException (or a bug in its own finally) can
+            # escape — which would leave this group's waiters hanging and
+            # the stage slot poisoned. Fail them fast instead.
+            lambda f, g=group: self._guard_worker_future(f, g, "dispatch")
         )
         # Backpressure: up to pipeline_depth-1 groups may queue behind the
         # running stage — enough to keep the pipeline full (assembly of
@@ -1945,6 +2256,10 @@ class DynamicBatcher:
                 self._dispatching_since = (
                     None if all_warm else time.perf_counter()
                 )
+                if self.recovery is not None:
+                    # The group now entering the device stage — what a
+                    # wedge-triggered quarantine capture must replay.
+                    self._dispatching_group = None if all_warm else group
             servable = group[0].servable
             stage_t0 = time.perf_counter()
             # Utilization ledger: captured here (detachable mid-flight,
@@ -1978,6 +2293,18 @@ class DynamicBatcher:
                 # breaker and deadline tests drive deterministically. Inside
                 # the sink so an injected fault annotates the member spans.
                 faults.fire("batcher.dispatch")
+                if faults.active() and faults.get().has_site("device_lost"):
+                    # Recovery-plane chaos site: fired once per member
+                    # request with that request's content digest as the
+                    # key — a keyless rule kills any batch (device died),
+                    # a keyed rule deterministically kills exactly the
+                    # batches carrying one request's bytes (the poison
+                    # the bisection isolates). The has_site gate keeps
+                    # ordinary chaos runs from paying the digests.
+                    for it in group:
+                        faults.fire(
+                            "device_lost", key=poison_fault_key(it.arrays)
+                        )
                 with request_trace.span("batch.dispatch"):
                     if fused is not None:
                         outputs = self._execute_fused(
@@ -2047,6 +2374,11 @@ class DynamicBatcher:
                 batch_id = self._inflight_seq
                 if not all(it.warmup for it in group):
                     self._inflight[batch_id] = time.perf_counter()
+                    if self.recovery is not None:
+                        # Same register site as the wedge clock: a
+                        # quarantine capture replays exactly the groups
+                        # the stuck readbacks strand.
+                        self._inflight_groups[batch_id] = group
                     # Per-bucket in-flight accounting + high-water mark
                     # (pipeline_stats / dts_tpu_pipeline_*): same locked
                     # register site as the wedge clock, popped together
@@ -2064,8 +2396,13 @@ class DynamicBatcher:
                 # dispatch start — a submit racing that window would read a
                 # long-finished dispatch as a wedged device.
                 self._dispatching_since = None
+                self._dispatching_group = None
                 if not pending_closed:
-                    self._dispatch_pending -= 1
+                    # Clamped at zero: a quarantine capture resets the
+                    # pending count while abandoned stage calls may still
+                    # be queued behind a wedged worker — their eventual
+                    # decrements must not drive it negative.
+                    self._dispatch_pending = max(self._dispatch_pending - 1, 0)
                     pending_closed = True
                 self._cv.notify_all()
             if phases is not None:
@@ -2074,6 +2411,8 @@ class DynamicBatcher:
             self._completers.submit(
                 self._complete, batch_id, group, fetch, issue_t0, meta, scatter,
                 stage_t0, util=util, bucket=bucket, ring_bufs=ring_bufs,
+            ).add_done_callback(
+                lambda f, g=group: self._guard_worker_future(f, g, "completer")
             )
             util_handed_off = True
         except Exception as exc:  # propagate to every waiter, keep serving
@@ -2085,9 +2424,18 @@ class DynamicBatcher:
                 # annotation) that led to the failure BEFORE the waiters
                 # unblock and finish their root spans.
                 _replay_group_phases(group, phases)
-            for it in group:
-                if not it.future.done():
-                    it.future.set_exception(exc)
+            rec = self.recovery  # capture: detachable mid-flight
+            if rec is not None and rec.take_group(group, exc):
+                # Device-fatal failure with the recovery plane armed: the
+                # controller owns these items now (quarantine -> reinit ->
+                # replay); their futures resolve from the replay path —
+                # or with a distinct poisoned/budget-exhausted status —
+                # never from this frame.
+                pass
+            else:
+                for it in group:
+                    if not it.future.done():
+                        it.future.set_exception(exc)
         finally:
             if util is not None and not util_handed_off:
                 # A device-stage failure never reaches _complete: close
@@ -2095,8 +2443,9 @@ class DynamicBatcher:
                 util.depth_dec()
             with self._cv:
                 self._dispatching_since = None
+                self._dispatching_group = None
                 if not pending_closed:
-                    self._dispatch_pending -= 1
+                    self._dispatch_pending = max(self._dispatch_pending - 1, 0)
                 self._cv.notify_all()
 
     def _complete(
@@ -2114,11 +2463,15 @@ class DynamicBatcher:
         trace_ctx = (
             tracing.collect_phases(phases) if phases is not None else _NULL_CTX
         )
+        taken_by_recovery = False
         try:
             with trace_ctx:
-                # Named fault site (faults.py): a readback that stalls or
-                # dies — inside the sink so chaos annotates member spans.
+                # Named fault sites (faults.py): a readback that stalls or
+                # dies — inside the sink so chaos annotates member spans —
+                # and the recovery plane's executor_abort (the executable
+                # aborted after dispatch; classified device-fatal).
                 faults.fire("readback")
+                faults.fire("executor_abort")
                 # The fetch: with async_readback the copy is already in
                 # flight (issued at dispatch), so this measures the residual
                 # WAIT, not a full synchronous transfer — the split the
@@ -2221,16 +2574,30 @@ class DynamicBatcher:
         except Exception as exc:
             if phases is not None:
                 _replay_group_phases(group, phases)
-            for it in group:
-                if not it.future.done():
-                    it.future.set_exception(exc)
+            rec = self.recovery  # capture: detachable mid-flight
+            if rec is not None and rec.take_group(group, exc):
+                # Device-fatal readback failure: the recovery plane owns
+                # these items (replay resolves their futures).
+                taken_by_recovery = True
+            else:
+                for it in group:
+                    if not it.future.done():
+                        it.future.set_exception(exc)
         finally:
             if util is not None:
                 util.depth_dec()
             # Recycle the padded-batch buffers: the readback finished, so
             # the H2D upload that read them is long done — the only point
-            # in the batch lifecycle where reuse is provably safe.
-            if self.buffer_ring is not None and ring_bufs:
+            # in the batch lifecycle where reuse is provably safe. The
+            # EXCEPTION is a device-fatal failure the recovery plane took:
+            # a lost/wedged device may still hold async references into
+            # these host buffers, so they leak to GC (the _run_stage
+            # failure-path precedent) — the _HostBufferRing recycle
+            # contract extension the replay path relies on.
+            if (
+                self.buffer_ring is not None and ring_bufs
+                and not taken_by_recovery
+            ):
                 self.buffer_ring.release(ring_bufs)
             # The breaker closes itself here: once the stuck (or healthy)
             # readback finishes, the wedge condition clears with it — and
@@ -2238,6 +2605,7 @@ class DynamicBatcher:
             # thread waiting on the in-flight window) is woken, since
             # capacity just opened up.
             with self._cv:
+                self._inflight_groups.pop(batch_id, None)
                 if self._inflight.pop(batch_id, None) is not None:
                     left = self._inflight_buckets.get(bucket, 0) - 1
                     if left > 0:

@@ -151,6 +151,12 @@ class LifecycleController:
         self._tick_mutex = threading.Lock()
         self._state = IDLE
         self._state_since = clock()
+        # Recovery-plane interplay (ISSUE 11): while the serving replica
+        # is quarantined/rebuilding its executor, canary ticks pause —
+        # judging (or ramping) a canary against a dying device would
+        # read device failure as model regression. Plain bool, flipped
+        # by pause()/resume(); tick() no-ops while set.
+        self._paused = False
         self._stable: int | None = None
         self._canary: int | None = None
         self._fraction = 0.0
@@ -223,10 +229,28 @@ class LifecycleController:
 
     # --------------------------------------------------------------- ticks
 
+    def pause(self) -> None:
+        """Suspend canary ticks (recovery quarantine): routing keeps its
+        current answer but the state machine stops advancing — no ramp
+        steps, no promote dwell credit accrual source, and critically no
+        rollback judged against quarantine-corrupted evidence."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+
+    @property
+    def paused(self) -> bool:
+        return self._paused
+
     def tick(self, now: float | None = None) -> None:
         """One control-loop pass. Reentrancy-safe; quality reads happen
         OUTSIDE the controller lock (the monitor locks itself), then the
         transition re-checks state before applying."""
+        if self._paused:
+            return  # recovery quarantine in progress (see pause())
         now = self._clock() if now is None else now
         if not self._tick_mutex.acquire(blocking=False):
             return  # a concurrent tick is already evaluating this state
@@ -626,6 +650,7 @@ class LifecycleController:
             out = {
                 "enabled": True,
                 "model": self.model,
+                "paused": self._paused,
                 "state": self._state,
                 "state_age_s": round(now - self._state_since, 3),
                 "stable_version": self._stable,

@@ -1,0 +1,699 @@
+"""Device-failure recovery plane — executor quarantine, in-flight batch
+replay, poisoned-input bisection (ISSUE 11).
+
+PR 9's k-deep continuous batching raised the blast radius of a device
+fault: one wedged jit call or readback strands up to `inflight_window`
+batches plus everything queued behind them, and before this plane the
+only exits were DeviceWedgedError failing every affected request or
+killing the process. TF-Serving treats servable isolation and recovery as
+a first-class serving concern; at the fleet scale of "Scaling TensorFlow
+to 300 million predictions per second" a replica that self-heals in
+seconds instead of paging a human is the difference between a blip and an
+incident. This module turns device failure from request death into a
+bounded, observable recovery cycle:
+
+    SERVING -> QUARANTINED -> REINIT -> REPLAY -> SERVING
+
+- **Quarantine decision.** A watchdog escalates the batcher's EXISTING
+  wedge clock (`DynamicBatcher.wedge_age` — the same
+  dispatching/in-flight timestamps the circuit breaker reads, at a
+  usually much lower threshold) and the completer-side failure hooks into
+  a trigger: a device-fatal batch failure (`take_group` — injected
+  device_lost/executor_abort faults, XLA DEVICE_LOST-shaped runtime
+  errors), a wedged device (watchdog), or a dead batcher thread
+  (`note_thread_death`). Transient non-device errors never trigger it —
+  they keep today's fail-the-group semantics.
+
+- **QUARANTINED.** grpc.health.v1 flips to NOT_SERVING (the health
+  servicer reads `not_serving()`), new submits are refused fast with
+  DeviceQuarantinedError (UNAVAILABLE — fan-out clients reroute via the
+  PR-2 scoreboard), the lifecycle plane's canary ticks pause (a rollout
+  must not judge a canary against a dying device), and EVERY accepted-
+  but-unanswered work item is captured out of the batcher — queued,
+  staged, dispatching, and in-flight (the capture clears the wedge
+  bookkeeping; the stranded threads no-op or lose the set-result race by
+  construction).
+
+- **REINIT.** The jitted entries and content-addressed device input
+  cache are torn down and rebuilt in-process (fresh executables against a
+  fresh backend state; `jax.clear_caches()`, optionally the backend
+  itself), wedged worker pools are replaced (a thread stuck in native
+  code cannot be preempted — the pool around it can), a dead batching
+  thread is revived, and the bucket ladder re-warms THROUGH the queue —
+  warmup exempt from occupancy and the wedge clock, as today.
+
+- **REPLAY.** Captured items re-enter the queue FRONT with their
+  original host arrays (the padded device-side buffers of a failed batch
+  are never recycled into the _HostBufferRing — they leak to GC, the
+  ring's recycle-contract extension) and a per-item replay budget. A
+  batch that deterministically kills the executor again is BISECTED: its
+  member requests split into halves carrying distinct `bisect_key`s (the
+  coalescer only merges equal keys), each half replays as its own batch,
+  and the half that keeps killing splits again until a SINGLE request is
+  isolated — it alone fails with PoisonedInputError (INVALID_ARGUMENT,
+  the distinct do-not-retry status) while its batchmates are
+  re-dispatched and succeed.
+
+Off by default ([recovery] enabled=false / --recovery); when off the
+batcher pays one attribute read per hook — the tracing/cache/overload
+precedent — and behavior is bit-identical to the pre-plane stack.
+Surfaces: GET /recoveryz, a `recovery` block in /monitoring, and
+dts_tpu_recovery_* Prometheus series.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+from .batcher import (
+    DeviceWedgedError,
+    PoisonedInputError,
+    poison_fault_key,  # noqa: F401 — re-exported for tests/soaks
+)
+
+log = logging.getLogger("dts_tpu.recovery")
+
+# States (string values are the wire/JSON encoding, lowercase for labels).
+SERVING = "serving"
+QUARANTINED = "quarantined"
+REINIT = "reinit"
+REPLAY = "replay"
+STATES = (SERVING, QUARANTINED, REINIT, REPLAY)
+
+# Fault-injector sites classified device-fatal, and the error-message
+# markers a real runtime's device death carries (XlaRuntimeError text —
+# kept narrow: an ordinary INVALID_ARGUMENT trace error must never read
+# as a dead device).
+_FATAL_SITES = ("device_lost", "executor_abort")
+_FATAL_MARKERS = (
+    "DEVICE_LOST", "device lost", "Device lost", "DATA_LOSS",
+    "executor aborted",
+)
+
+
+def device_fatal(exc: BaseException) -> bool:
+    """True when `exc` means the device executor is gone (quarantine +
+    replay), False for everything else (today's fail-the-group path)."""
+    from .. import faults as faults_mod
+
+    if isinstance(exc, faults_mod.InjectedFaultError):
+        return exc.site in _FATAL_SITES
+    # Marker match only — deliberately narrow: a deterministic per-shape
+    # XlaRuntimeError("INTERNAL: ...") compile/runtime bug is NOT a dead
+    # device, and classifying it fatal would loop quarantine cycles (and
+    # eventually convict requests as poisoned) over an error today's
+    # fail-the-group path reports in one RPC.
+    msg = str(exc)
+    return any(m in msg for m in _FATAL_MARKERS)
+
+
+class RecoveryController:
+    """The quarantine -> reinit -> replay state machine over one batcher.
+
+    Collaborators are injected — `batcher` (capture/requeue/reinit
+    surface; the controller attaches itself as `batcher.recovery`),
+    `registry` (which servables to re-warm after REINIT; None skips the
+    re-warm), `impl` (late-bound lifecycle access: the canary ticks pause
+    while quarantined) — so the machine is testable with a fake clock, a
+    fake batcher, and no threads (`run_cycle()` is the whole cycle;
+    `check()` is one watchdog pass). `start()` adds the optional
+    background watchdog."""
+
+    def __init__(
+        self,
+        config,
+        batcher,
+        registry=None,
+        impl=None,
+        lifecycle=None,
+        clock=time.monotonic,
+    ):
+        self.config = config
+        self.batcher = batcher
+        self.registry = registry
+        self.impl = impl
+        self.lifecycle = lifecycle
+        self._clock = clock
+        self._lock = threading.Lock()
+        # One cycle at a time: a failure arriving mid-cycle lands in
+        # _pending and the active cycle's round loop absorbs it.
+        self._cycle_mutex = threading.Lock()
+        self._state = SERVING
+        self._state_since = clock()
+        # Replay units: lists of _WorkItems that must re-dispatch
+        # together (a bisection half shares one unit + bisect_key).
+        self._pending: list[list] = []
+        self._pending_ids: set[int] = set()
+        self._bisect_seq = 0
+        self._trigger: str | None = None
+        # Spawn one-shot cycle threads on demand when no watchdog runs.
+        # Tests that drive run_cycle() themselves set this False.
+        self.auto_cycle = True
+        # Counters (all monotonic; Prometheus reads them off snapshot()).
+        self.quarantines = 0
+        self.reinits = 0
+        self.cycles_completed = 0
+        self.device_failures = 0
+        self.replayed_items = 0
+        self.replay_budget_exhausted = 0
+        self.poisoned_requests = 0
+        self.bisections = 0
+        self.watchdog_wedge_trips = 0
+        self.thread_deaths = 0
+        self._last_cycle: dict | None = None
+        self._events: deque[dict] = deque(
+            maxlen=max(int(getattr(config, "history_events", 64)), 8)
+        )
+        self._stop_evt = threading.Event()
+        self._wake = threading.Event()
+        self._worker: threading.Thread | None = None
+        batcher.recovery = self
+
+    # ---------------------------------------------------------- fast reads
+    # Lock-free single-attribute reads: these run inside batcher.submit
+    # (under the batcher's condition variable) and inside future
+    # done-callbacks — taking self._lock there could deadlock against a
+    # cycle thread resolving futures.
+
+    def state(self) -> str:
+        return self._state
+
+    def refusing(self) -> bool:
+        """New (non-warmup) submits are refused while the executor is
+        down or rebuilding; REPLAY accepts again — replayed items are
+        merely queue-first."""
+        return self._state in (QUARANTINED, REINIT)
+
+    def not_serving(self) -> bool:
+        """grpc.health.v1 reports NOT_SERVING through the whole cycle:
+        load balancers route back only once replay has drained."""
+        return self._state != SERVING
+
+    def cycle_active(self) -> bool:
+        """True while a cycle is running OR work is captured/requested —
+        batcher.drain() observes this so a drain mid-REINIT neither
+        returns a false 'drained' nor waits past its own bound."""
+        return (
+            self._state != SERVING
+            or bool(self._pending)
+            or self._trigger is not None
+        )
+
+    # ------------------------------------------------------------ triggers
+
+    def take_group(self, group: list, exc: BaseException) -> bool:
+        """Batcher failure hook: called from _run_stage/_complete when a
+        batch fails. True = this failure is device-fatal and the
+        controller now owns the group's outcome (futures resolve from
+        replay, or with the poisoned/budget-exhausted status); False =
+        not a device failure, fail the group exactly as before."""
+        if self._stop_evt.is_set():
+            # A stopped controller (drain in progress) must not capture
+            # work nobody will replay.
+            return False
+        if not device_fatal(exc):
+            return False
+        self.device_failures += 1
+        fails: list[tuple] = []
+        for it in group:
+            if it.warmup and not it.future.done():
+                # Warmup is re-run wholesale by REINIT; replaying the
+                # item too would double-compile for nothing.
+                fails.append((it, exc))
+        self._apply_fails(fails)
+        self._absorb([it for it in group if not it.warmup], exc)
+        self._request_cycle("device_fatal")
+        return True
+
+    def note_thread_death(self, err: BaseException) -> bool:
+        """Batcher thread-death hook: revive + replay via a cycle. False
+        when this controller is stopped (drain in progress) — the caller
+        must then fail queued waiters fast itself, or they would hang
+        between a dead thread and a cycle that will never run."""
+        if self._stop_evt.is_set():
+            return False
+        self.thread_deaths += 1
+        self._request_cycle("thread_death")
+        return True
+
+    def check(self) -> str:
+        """One watchdog pass: escalate the batcher's wedge clock into a
+        quarantine decision, then run any requested cycle. Returns the
+        state afterward. The background watchdog calls this on its
+        interval; tests drive it directly."""
+        if self._stop_evt.is_set():
+            return self._state
+        with self._lock:
+            trig = self._trigger
+        if trig is None and self._state == SERVING:
+            age = self._safe(self.batcher.wedge_age, 0.0) or 0.0
+            threshold = max(self.config.wedge_quarantine_s, 0.1)
+            if age >= threshold:
+                self.watchdog_wedge_trips += 1
+                with self._lock:
+                    self._trigger = trig = "wedge"
+        if trig is not None:
+            self.run_cycle(trig)
+        return self._state
+
+    def _request_cycle(self, trigger: str) -> None:
+        with self._lock:
+            if self._trigger is None:
+                self._trigger = trigger
+        if self._stop_evt.is_set():
+            return
+        if self._worker is not None and self._worker.is_alive():
+            self._wake.set()
+        elif self.auto_cycle:
+            threading.Thread(
+                target=self.run_cycle, args=(trigger,),
+                name="recovery-cycle", daemon=True,
+            ).start()
+
+    # --------------------------------------------------- failure absorption
+
+    def _absorb(self, group: list, exc: BaseException | None) -> None:
+        """Classify one failed/abandoned batch's live items into replay
+        units: kill accounting, the poison verdict (a single-request
+        batch that keeps killing), the per-item replay budget, and the
+        bisection split. Future resolution happens OUTSIDE the lock —
+        done-callbacks (cache single-flight) re-enter the batcher."""
+        cfg = self.config
+        fails: list[tuple] = []
+        with self._lock:
+            live = [
+                it for it in group
+                if not it.future.done() and id(it) not in self._pending_ids
+            ]
+            if not live:
+                return
+            for it in live:
+                it.device_kills += 1
+            kills = max(it.device_kills for it in live)
+            # The poison VERDICT (INVALID_ARGUMENT — "do not retry these
+            # bytes anywhere") demands an actual device-kill ERROR on the
+            # final solo batch. Wedge-derived kills (exc None) still
+            # drive bisection and burn replay budget, but a persistently
+            # wedging DEVICE must fail its solo captives with the
+            # retryable wedge error (budget exhaustion below), never
+            # convict innocent requests a healthy replica would serve.
+            if (
+                len(live) == 1
+                and exc is not None
+                and kills >= max(cfg.poison_kills, 1)
+            ):
+                it = live[0]
+                self.poisoned_requests += 1
+                err = PoisonedInputError(
+                    "poisoned input isolated by recovery bisection: this "
+                    "request's batch deterministically killed the device "
+                    f"executor {it.device_kills}x (last failure: "
+                    f"{type(exc).__name__ if exc is not None else 'wedge'}); "
+                    "failing it alone — do not retry these bytes"
+                )
+                if exc is not None:
+                    err.__cause__ = exc
+                fails.append((it, err))
+            else:
+                keep = []
+                for it in live:
+                    if it.replays >= max(cfg.replay_budget, 1):
+                        self.replay_budget_exhausted += 1
+                        err = exc if exc is not None else DeviceWedgedError(
+                            "batch abandoned by recovery quarantine and "
+                            "replay budget exhausted"
+                        )
+                        fails.append((it, err))
+                    else:
+                        keep.append(it)
+                if keep:
+                    if len(keep) > 1 and kills >= max(cfg.bisect_after_kills, 1):
+                        # Deterministic killer: split into halves, each a
+                        # separate replay unit the coalescer keeps apart.
+                        self.bisections += 1
+                        mid = (len(keep) + 1) // 2
+                        for half in (keep[:mid], keep[mid:]):
+                            if half:
+                                self._bisect_seq += 1
+                                for it in half:
+                                    it.bisect_key = self._bisect_seq
+                                self._stash_locked(half)
+                    else:
+                        self._stash_locked(keep)
+        self._apply_fails(fails)
+
+    def _stash_locked(self, unit: list) -> None:
+        self._pending.append(unit)
+        self._pending_ids.update(id(it) for it in unit)
+
+    def _drain_pending(self) -> list[list]:
+        with self._lock:
+            units, self._pending = self._pending, []
+            self._pending_ids.clear()
+        return units
+
+    @staticmethod
+    def _apply_fails(fails: list) -> None:
+        from concurrent.futures import InvalidStateError
+
+        for it, err in fails:
+            try:
+                if not it.future.done():
+                    it.future.set_exception(err)
+            except InvalidStateError:
+                pass
+
+    # ------------------------------------------------------------ the cycle
+
+    def run_cycle(self, trigger: str = "manual") -> bool:
+        """One full QUARANTINED -> REINIT -> REPLAY -> SERVING pass,
+        looping reinit+replay rounds until the replay drains clean (a
+        replayed batch that kills the executor again re-enters _pending
+        through take_group and forces another round — this is how the
+        bisection converges inside ONE cycle). False when another cycle
+        already holds the mutex (it will absorb the pending work)."""
+        if not self._cycle_mutex.acquire(blocking=False):
+            return False
+        try:
+            t0 = self._clock()
+            with self._lock:
+                trig = self._trigger or trigger
+                self._trigger = None
+            self.quarantines += 1
+            self._enter(QUARANTINED, trigger=trig)
+            lc = self._lifecycle()
+            if lc is not None:
+                # Canary ticks pause: a rollout must not judge (or
+                # promote) a canary against a dying device.
+                self._safe(lambda: lc.pause())
+            queued, inflight = self._safe(
+                self.batcher.capture_for_recovery, ([], [])
+            ) or ([], [])
+            if queued:
+                with self._lock:
+                    self._stash_locked(list(queued))
+            for group in inflight:
+                # These groups were IN a device call when the device was
+                # declared gone — the wedge is their kill evidence, so
+                # the bisection converges on wedge-shaped poison too.
+                self._absorb(group, None)
+            if trig in ("wedge", "thread_death"):
+                # A thread stuck in native device code cannot be
+                # preempted in-process; the pools around it can.
+                self._safe(self.batcher.replace_workers_for_recovery)
+            rounds = 0
+            replayed_this_cycle = 0
+            failed_this_cycle = 0
+            poisoned_before = self.poisoned_requests
+            while not self._stop_evt.is_set():
+                rounds += 1
+                self._enter(REINIT, round=rounds)
+                self.reinits += 1
+                self._reinit_executors()
+                if getattr(self.config, "reinit_warmup", True):
+                    self._rewarm()
+                # Atomic drain + trigger clear: the trigger may only be
+                # consumed while _pending is observably empty in the SAME
+                # lock hold — a take_group stashing work between a drain
+                # and a separate trigger-clear would otherwise be erased
+                # with its items stranded in _pending and no cycle ever
+                # scheduled for them.
+                with self._lock:
+                    units, self._pending = self._pending, []
+                    self._pending_ids.clear()
+                    if not units:
+                        # This round's reinit also covers any kill that
+                        # raced the previous round's drain but left
+                        # nothing to replay (a poison verdict's final
+                        # solo kill): the trigger it set is satisfied
+                        # here, not by a whole extra quarantine cycle
+                        # after this one ends.
+                        self._trigger = None
+                if not units:
+                    break
+                self._enter(REPLAY, round=rounds, units=len(units))
+                futs = []
+                for unit in units:
+                    for it in unit:
+                        it.replays += 1
+                    self.replayed_items += len(unit)
+                    replayed_this_cycle += len(unit)
+                    self._safe(
+                        lambda u=unit: self.batcher.requeue_for_replay(u)
+                    )
+                    futs.extend(it.future for it in unit)
+                self._wait_replay(futs)
+                with self._lock:
+                    still_pending = bool(self._pending)
+                    retriggered = self._trigger is not None
+                    if not still_pending:
+                        self._trigger = None
+                if not still_pending:
+                    if retriggered:
+                        # A kill landed during this replay but resolved
+                        # every item it touched (poison verdict): the
+                        # executor still died AFTER the last reinit, so
+                        # run one more reinit round before declaring the
+                        # cycle done.
+                        continue
+                    break
+                if rounds >= max(int(self.config.max_cycle_rounds), 1):
+                    err = DeviceWedgedError(
+                        f"recovery gave up after {rounds} reinit/replay "
+                        "rounds; the device keeps failing"
+                    )
+                    for unit in self._drain_pending():
+                        failed_this_cycle += len(unit)
+                        self._apply_fails([(it, err) for it in unit])
+                    break
+            if lc is not None:
+                self._safe(lambda: lc.resume())
+            duration = self._clock() - t0
+            with self._lock:
+                self.cycles_completed += 1
+                self._last_cycle = {
+                    "trigger": trig,
+                    "rounds": rounds,
+                    "duration_s": round(duration, 4),
+                    "replayed_items": replayed_this_cycle,
+                    "poisoned": self.poisoned_requests - poisoned_before,
+                    "gave_up_items": failed_this_cycle,
+                }
+            self._enter(SERVING, trigger=trig,
+                        duration_s=round(duration, 4))
+            return True
+        finally:
+            self._cycle_mutex.release()
+
+    def _reinit_executors(self) -> None:
+        """Tear down and rebuild the device-execution state in-process:
+        fresh jitted entries, a cleared content-addressed input cache
+        (its device arrays reference the dead backend), cleared jax
+        compilation caches, a revived batching thread if one died, and —
+        config-gated, heavyweight — the backend itself."""
+        b = self.batcher
+        try:
+            with b._jit_lock:
+                b._jitted.clear()
+        except Exception:  # noqa: BLE001 — a fake batcher may lack these
+            pass
+        cache = getattr(b, "input_cache", None)
+        if cache is not None:
+            self._safe(cache.clear)
+        self._safe(b.revive_batching_thread)
+        try:
+            import jax
+
+            jax.clear_caches()
+            if getattr(self.config, "reinit_clear_backend", False):
+                # Deprecated-but-present escape hatch: a genuinely lost
+                # TPU needs the runtime client rebuilt, not just fresh
+                # executables. Never the default — it is process-global.
+                clear = getattr(jax, "clear_backends", None)
+                if clear is not None:
+                    clear()
+        except Exception:  # noqa: BLE001 — cache clearing is best-effort
+            log.exception("recovery: jax cache clear failed")
+
+    def _rewarm(self) -> None:
+        """Re-warm every registered servable's bucket ladder THROUGH the
+        queue (compiles on the batching thread; _warmup=True keeps the
+        wedge clock, occupancy ledger, and the quarantine gate out of
+        it). Bounded; failures log and never wedge the cycle."""
+        from concurrent.futures import wait as fut_wait
+
+        reg = self.registry
+        b = self.batcher
+        if reg is None:
+            return
+        try:
+            names = sorted(reg.models() or {})
+        except Exception:  # noqa: BLE001 — registry quirks never wedge
+            return
+        futs = []
+        for name in names:
+            try:
+                sv = reg.resolve(name)
+            except Exception:  # noqa: BLE001 — vanished mid-cycle
+                continue
+            for bucket in b.buckets:
+                try:
+                    futs.append(b.submit(
+                        sv, b.warmup_arrays(sv, bucket), _warmup=True
+                    ))
+                except Exception:  # noqa: BLE001 — keep warming the rest
+                    log.exception("recovery re-warm submit failed (%s/%d)",
+                                  name, bucket)
+        if futs:
+            fut_wait(futs, timeout=max(
+                getattr(self.config, "rewarm_timeout_s", 120.0), 1.0
+            ))
+
+    def _wait_replay(self, futs: list) -> None:
+        """Bounded wait for the replayed futures: ends early when a
+        replayed batch fails device-fatally again (pending refills — the
+        round loop reinits and replays the split immediately) or when a
+        drain is stopping the controller. Wall-clock bounded regardless
+        of the injected state-machine clock."""
+        from concurrent.futures import wait as fut_wait
+
+        deadline = time.monotonic() + max(
+            getattr(self.config, "replay_drain_s", 30.0), 0.0
+        )
+        remaining = list(futs)
+        while remaining and not self._stop_evt.is_set():
+            left = deadline - time.monotonic()
+            if left <= 0:
+                break
+            done, not_done = fut_wait(remaining, timeout=min(left, 0.1))
+            remaining = list(not_done)
+            with self._lock:
+                if self._pending:
+                    return  # a replay died again: next round handles it
+
+    # ------------------------------------------------------------- watchdog
+
+    def start(self) -> "RecoveryController":
+        """Background watchdog: polls check() every watchdog_interval_s
+        (wakeable early by a failure trigger). Tests with fake clocks
+        never call this — check()/run_cycle() are the whole machine."""
+        if self._worker is None or not self._worker.is_alive():
+            self._stop_evt = threading.Event()
+            self._worker = threading.Thread(
+                target=self._watchdog_loop,
+                args=(self._stop_evt, self._wake),
+                name="recovery-watchdog", daemon=True,
+            )
+            self._worker.start()
+        return self
+
+    def _watchdog_loop(self, stop_evt, wake) -> None:
+        interval = max(self.config.watchdog_interval_s, 0.05)
+        while not stop_evt.is_set():
+            wake.wait(interval)
+            wake.clear()
+            if stop_evt.is_set():
+                return
+            try:
+                self.check()
+            except Exception:  # noqa: BLE001 — the watchdog must survive
+                log.exception("recovery watchdog pass failed")
+
+    def stop(self) -> None:
+        self.shutdown_for_drain(2.0)
+
+    def shutdown_for_drain(self, grace_s: float = 2.0) -> None:
+        """GracefulShutdown interplay (ISSUE 11 satellite): called BEFORE
+        batcher.drain() so a SIGTERM arriving mid-REINIT cannot deadlock
+        the drain on replayed batches — the watchdog stops, the active
+        cycle aborts at its next phase boundary, and anything still
+        captured fails UNAVAILABLE (clients reroute; this replica is
+        going away regardless). Bounded by min(grace, 2s)."""
+        self._stop_evt.set()
+        self._wake.set()
+        bound = min(max(grace_s, 0.0), 2.0)
+        if self._worker is not None:
+            self._worker.join(timeout=bound)
+            self._worker = None
+        t_end = time.monotonic() + bound
+        while self._cycle_mutex.locked() and time.monotonic() < t_end:
+            time.sleep(0.02)
+        err = DeviceWedgedError(
+            "server draining during device recovery; retry against "
+            "another backend"
+        )
+        for unit in self._drain_pending():
+            self._apply_fails([(it, err) for it in unit])
+        with self._lock:
+            self._trigger = None
+        if self._state != SERVING:
+            self._enter(SERVING, trigger="drain_abort")
+
+    # ------------------------------------------------------------- plumbing
+
+    def _lifecycle(self):
+        if self.lifecycle is not None:
+            return self.lifecycle
+        return getattr(self.impl, "lifecycle", None)
+
+    def _enter(self, state: str, **detail) -> None:
+        now = self._clock()
+        with self._lock:
+            self._state = state
+            self._state_since = now
+            self._events.append({
+                "t": round(now, 3), "state": state, **detail,
+            })
+        log.info("recovery -> %s %s", state, detail or "")
+
+    @staticmethod
+    def _safe(fn, default=None):
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — collaborator quirks must not
+            log.exception("recovery collaborator call failed")  # kill a cycle
+            return default
+
+    # ------------------------------------------------------------- surfaces
+
+    def snapshot(self) -> dict:
+        """The /recoveryz body, the `recovery` /monitoring block, and the
+        dts_tpu_recovery_* Prometheus source."""
+        now = self._clock()
+        cfg = self.config
+        with self._lock:
+            return {
+                "enabled": True,
+                "state": self._state,
+                "state_age_s": round(now - self._state_since, 3),
+                "pending_replay_units": len(self._pending),
+                "pending_replay_items": sum(len(u) for u in self._pending),
+                "counters": {
+                    "quarantines": self.quarantines,
+                    "reinits": self.reinits,
+                    "cycles_completed": self.cycles_completed,
+                    "device_failures": self.device_failures,
+                    "replayed_items": self.replayed_items,
+                    "replay_budget_exhausted": self.replay_budget_exhausted,
+                    "poisoned_requests": self.poisoned_requests,
+                    "bisections": self.bisections,
+                    "watchdog_wedge_trips": self.watchdog_wedge_trips,
+                    "thread_deaths": self.thread_deaths,
+                },
+                "last_cycle": self._last_cycle,
+                "events": list(self._events),
+                "config": {
+                    "watchdog_interval_s": cfg.watchdog_interval_s,
+                    "wedge_quarantine_s": cfg.wedge_quarantine_s,
+                    "replay_budget": cfg.replay_budget,
+                    "poison_kills": cfg.poison_kills,
+                    "bisect_after_kills": cfg.bisect_after_kills,
+                    "reinit_warmup": cfg.reinit_warmup,
+                    "reinit_clear_backend": cfg.reinit_clear_backend,
+                    "replay_drain_s": cfg.replay_drain_s,
+                    "max_cycle_rounds": cfg.max_cycle_rounds,
+                },
+            }

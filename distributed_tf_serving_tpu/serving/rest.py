@@ -189,6 +189,11 @@ class RestGateway:
             # rollback history, and the version watcher's blacklist/pin
             # state.
             web.get("/lifecyclez", self.lifecyclez),
+            # Recovery plane (ISSUE 11): the device-failure recovery
+            # state machine — quarantine/reinit/replay counters, the
+            # poisoned-input bisection verdicts, and the last cycle's
+            # duration (the live MTTR evidence).
+            web.get("/recoveryz", self.recoveryz),
         ])
 
     # ------------------------------------------------------------- helpers
@@ -554,6 +559,7 @@ class RestGateway:
                 quality=self.impl.quality_stats(),
                 lifecycle=self.impl.lifecycle_stats(),
                 pipeline=self.impl.pipeline_stats(),
+                recovery=self.impl.recovery_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -584,6 +590,7 @@ class RestGateway:
             "utilization": self.impl.utilization_stats,
             "quality": self.impl.quality_stats,
             "lifecycle": self.impl.lifecycle_stats,
+            "recovery": self.impl.recovery_stats,
             "versions": self.impl.versions_stats,
             "pipeline": self.impl.pipeline_stats,
             "request_log": request_log,
@@ -614,7 +621,7 @@ class RestGateway:
         # Armed-plane blocks only: a disabled plane is absent, so
         # dashboards can distinguish "off" from "cold".
         for name in ("cache", "overload", "utilization", "quality",
-                     "lifecycle", "versions", "pipeline"):
+                     "lifecycle", "recovery", "versions", "pipeline"):
             block = builders[name]()
             if block is not None:
                 snap[name] = block
@@ -758,6 +765,18 @@ class RestGateway:
         no controller is armed ([lifecycle] enabled=false), so probes
         need no config knowledge."""
         stats = self.impl.lifecycle_stats()
+        return web.json_response(
+            stats if stats is not None else {"enabled": False}
+        )
+
+    async def recoveryz(self, request: web.Request) -> web.Response:
+        """GET /recoveryz: the device-failure recovery surface — the
+        SERVING/QUARANTINED/REINIT/REPLAY state machine, quarantine/
+        reinit/replay/bisection counters, the last cycle's trigger +
+        duration (MTTR evidence), and the transition-event history.
+        `{"enabled": false}` when no controller is armed ([recovery]
+        enabled=false), so probes need no config knowledge."""
+        stats = self.impl.recovery_stats()
         return web.json_response(
             stats if stats is not None else {"enabled": False}
         )

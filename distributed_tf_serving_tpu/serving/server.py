@@ -334,11 +334,16 @@ class GrpcHealthService:
             ready = any(served.values())
             # A draining server (SIGTERM received, GracefulShutdown in
             # progress) reports NOT_SERVING so load balancers stop routing
-            # to it while accepted work finishes.
+            # to it while accepted work finishes. So does a QUARANTINED
+            # one (recovery plane mid quarantine/reinit/replay): clients
+            # failover via the scoreboard until the rebuilt executor has
+            # drained its replay.
+            recovery = getattr(self.impl, "recovery", None)
             return (
                 health_proto.SERVING
                 if (self.impl.warmup_complete and ready
-                    and not getattr(self.impl, "draining", False))
+                    and not getattr(self.impl, "draining", False)
+                    and not (recovery is not None and recovery.not_serving()))
                 else health_proto.NOT_SERVING
             )
         if served.get(service):
@@ -1044,6 +1049,7 @@ class GracefulShutdown:
         watcher=None,
         request_logger=None,
         lifecycle=None,
+        recovery=None,
     ):
         self.impl = impl
         self.batcher = batcher
@@ -1054,6 +1060,13 @@ class GracefulShutdown:
         # watcher so a mid-drain tick can't publish/promote/rollback into
         # a stack that is tearing down.
         self.lifecycle = lifecycle
+        # Recovery controller (serving/recovery.py): aborted BEFORE the
+        # batcher drain — a SIGTERM arriving mid-REINIT must not leave
+        # drain() waiting its whole grace on replayed batches the dying
+        # replica will never finish (quarantine × shutdown interplay,
+        # ISSUE 11 satellite). Captured-but-unreplayed items fail
+        # UNAVAILABLE so their clients reroute immediately.
+        self.recovery = recovery
         self.server = None  # attached once created (create_server[_async])
         self.drained: bool | None = None
         self._lock = threading.Lock()
@@ -1099,6 +1112,13 @@ class GracefulShutdown:
                 self.lifecycle.stop()
             if self.watcher is not None:
                 self.watcher.stop()
+            # 2.5. Abort any in-flight recovery cycle BEFORE the drain:
+            # its watchdog stops, captured-but-unreplayed work fails
+            # UNAVAILABLE (clients reroute — this replica is going away),
+            # and drain() below can no longer deadlock waiting on a
+            # replay that will never be issued.
+            if self.recovery is not None:
+                self.recovery.shutdown_for_drain(self.grace_s)
             # 3. Answer everything already accepted, bounded by grace.
             self.drained = self.batcher.drain(self.grace_s)
             if not self.drained:
@@ -1139,6 +1159,7 @@ def build_stack(
     lifecycle_config=None,
     batching_config=None,
     transport_config=None,
+    recovery_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -1322,6 +1343,23 @@ def build_stack(
         # Reusable response-encode scratch ([transport] response_arena).
         impl.response_arena = True
         log.info("response-encode arenas on ([transport] response_arena)")
+    if recovery_config is not None and recovery_config.enabled:
+        # Device-failure recovery plane (serving/recovery.py): attaches
+        # itself as batcher.recovery; impl.recovery drives the health
+        # flip and /recoveryz. The watchdog thread starts in serve() —
+        # embedded callers drive check()/run_cycle() themselves.
+        from .recovery import RecoveryController
+
+        impl.recovery = RecoveryController(
+            recovery_config, batcher, registry=registry, impl=impl
+        )
+        log.info(
+            "device-failure recovery on: wedge_quarantine_s=%.1f "
+            "replay_budget=%d poison_kills=%d — GET /recoveryz on the "
+            "REST surface",
+            recovery_config.wedge_quarantine_s,
+            recovery_config.replay_budget, recovery_config.poison_kills,
+        )
     # Health gating: the grpc.health.v1 servicer reports the overall server
     # NOT_SERVING until the load+warmup phase below completes (standard
     # probes and the client's half-open probing key off this).
@@ -1566,6 +1604,20 @@ def serve(argv=None) -> None:
         "dts_tpu_lifecycle_* Prometheus series)",
     )
     parser.add_argument(
+        "--recovery", action="store_true", default=None,
+        help="device-failure recovery plane (serving/recovery.py): a "
+        "watchdog escalates the batcher's wedge clock into a "
+        "quarantine (health NOT_SERVING, new work refused UNAVAILABLE "
+        "so clients failover), tears down and rebuilds the jitted "
+        "executors in-process, replays every in-flight and queued "
+        "request, and bisects a batch that deterministically kills the "
+        "executor to isolate poisoned inputs (they alone fail "
+        "INVALID_ARGUMENT). Equivalent to [recovery] enabled=true; the "
+        "[recovery] section carries the watchdog/replay/bisection knobs "
+        "(GET /recoveryz, `recovery` block in /monitoring, "
+        "dts_tpu_recovery_* Prometheus series)",
+    )
+    parser.add_argument(
         "--uds-path", dest="uds_path",
         help="also serve gRPC on this Unix-domain socket path (co-located "
         "fan-out clients dial unix:<path>, skipping the TCP/loopback "
@@ -1631,6 +1683,7 @@ def serve(argv=None) -> None:
         ObservabilityConfig,
         OverloadConfig,
         QualityConfig,
+        RecoveryConfig,
         TransportConfig,
         UtilizationConfig,
     )
@@ -1667,6 +1720,9 @@ def serve(argv=None) -> None:
     lifecycle_config = cfgs.get("lifecycle") or LifecycleConfig()
     if args.lifecycle:
         lifecycle_config = dataclasses.replace(lifecycle_config, enabled=True)
+    recovery_config = cfgs.get("recovery") or RecoveryConfig()
+    if args.recovery:
+        recovery_config = dataclasses.replace(recovery_config, enabled=True)
     if lifecycle_config.enabled and not quality_config.enabled:
         # --lifecycle implies the quality plane it reads: arming the
         # actuator without its signal would fail build_stack's check, and
@@ -1731,12 +1787,18 @@ def serve(argv=None) -> None:
         lifecycle_config=lifecycle_config,
         batching_config=batching_config,
         transport_config=transport_config,
+        recovery_config=recovery_config,
     )
     if impl.lifecycle is not None:
         # The CLI server drives the controller with its background thread
         # (ticks + the fine-tune publisher cadence); embedded callers and
         # tests drive tick() themselves.
         impl.lifecycle.start()
+    if impl.recovery is not None:
+        # Watchdog thread: escalates the batcher's wedge clock into a
+        # quarantine decision on its poll cadence; failure-triggered
+        # cycles wake it early.
+        impl.recovery.start()
     # ONE teardown path for every exit: SIGTERM, REST-startup failure, and
     # normal termination all drain through this (admissions refused, queued
     # + in-flight work answered up to [overload] drain_grace_s, transport
@@ -1746,6 +1808,7 @@ def serve(argv=None) -> None:
         grace_s=overload_config.drain_grace_s,
         watcher=watcher,
         lifecycle=impl.lifecycle,
+        recovery=impl.recovery,
     )
     request_logger = None
     if cfg.request_log_file:

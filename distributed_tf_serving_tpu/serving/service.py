@@ -38,6 +38,7 @@ from .batcher import (
     BatchTooLargeError,
     DeviceWedgedError,
     DynamicBatcher,
+    PoisonedInputError,
     QueueOverloadError,
     RequestDeadlineError,
 )
@@ -107,6 +108,12 @@ class PredictionServiceImpl:
         # reads loaded/on-disk/blacklist/pin state from it — present
         # whether or not the lifecycle controller is armed.
         self.version_watcher = None
+        # Device-failure recovery plane (serving/recovery.py): when a
+        # RecoveryController is set, the grpc.health.v1 servicer reports
+        # NOT_SERVING through its quarantine/reinit/replay cycle and
+        # GET /recoveryz serves its snapshot. None (default) costs one
+        # attribute read where consulted.
+        self.recovery = None
         # Streamed sub-batch results (ISSUE 9): default server-side split
         # size (candidates per sub-batch) for PredictStream. 0 = no split
         # (one chunk per request — streaming stays wire-available but the
@@ -233,6 +240,15 @@ class PredictionServiceImpl:
         enabled=false)."""
         lc = self.lifecycle
         return lc.snapshot() if lc is not None else None
+
+    def recovery_stats(self) -> dict | None:
+        """Recovery-plane snapshot (state machine, quarantine/replay/
+        bisection counters, last-cycle MTTR evidence) — the body of
+        GET /recoveryz, the `recovery` block in /monitoring, and the
+        dts_tpu_recovery_* Prometheus series. None when no controller is
+        armed ([recovery] enabled=false)."""
+        rec = self.recovery
+        return rec.snapshot() if rec is not None else None
 
     def versions_stats(self) -> dict | None:
         """Version-watcher snapshot (loaded versions, last reconcile
@@ -448,6 +464,13 @@ class PredictionServiceImpl:
         threaded (_run) and coroutine (_run_async) paths — they must never
         return different codes for the same failure. Re-raises anything
         that is not a batcher failure."""
+        if isinstance(exc, PoisonedInputError):
+            # Recovery-plane bisection verdict: this request's bytes
+            # deterministically kill the device executor — a DISTINCT,
+            # non-retryable status (its batchmates were re-dispatched and
+            # answered normally). Without this branch the ValueError
+            # would re-raise and surface as INTERNAL.
+            return ServiceError("INVALID_ARGUMENT", str(exc))
         if isinstance(exc, (BatchTooLargeError, QueueOverloadError)):
             # Overload-plane refusals (AdmissionRefusedError) carry a
             # retry-after-ms pushback hint; it rides the ServiceError so

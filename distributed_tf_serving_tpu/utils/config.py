@@ -121,6 +121,15 @@ class ClientConfig:
     # UNAVAILABLE/DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED, up to this many
     # extra attempts (0 = the reference's fail-fast behavior).
     failover_attempts: int = 0
+    # Retry budget (ISSUE 11 satellite): cap on TOTAL backend attempts
+    # per logical request across every shard's failover hops, hedges,
+    # and streamed reroutes — one recovering/quarantined replica must
+    # not be able to multiply a request into a fleet-wide retry storm.
+    # Each shard's FIRST attempt is always allowed (the request needs
+    # it); the budget bounds everything beyond. 0 = unlimited (the
+    # historical behavior). Exhaustion counts as
+    # `retry_budget_exhausted` in the scoreboard snapshot.
+    max_attempts_total: int = 0
     # ---- resilience layer (client/health.py + client.py) -----------------
     # Per-backend scoreboard: EWMA latency + consecutive-failure ejection
     # with half-open probing; steers shard placement and failover rotation
@@ -556,6 +565,77 @@ class LifecycleConfig:
     history_events: int = 64
 
 
+@dataclasses.dataclass(frozen=True)
+class RecoveryConfig:
+    """Device-failure recovery knobs (serving/recovery.py): the watchdog
+    that escalates the batcher's wedge clock into a quarantine, the
+    in-process executor reinit, the in-flight/queued replay budget, and
+    the poisoned-input bisection thresholds. Off by default; when off
+    the batcher pays one attribute read per hook and behavior is
+    bit-identical to the pre-plane stack (the tracing/cache/overload
+    precedent)."""
+
+    # Master switch: build a RecoveryController and attach it to the
+    # batcher + impl.
+    enabled: bool = False
+    # Watchdog poll cadence (the background thread; failure-triggered
+    # cycles wake it early).
+    watchdog_interval_s: float = 0.5
+    # A dispatched/in-flight batch outstanding this long quarantines the
+    # replica — the ESCALATION threshold, far below the circuit
+    # breaker's fail-fast bound (default 90s): the breaker protects
+    # handler threads, this protects the replica.
+    wedge_quarantine_s: float = 15.0
+    # Max re-dispatches per work item across the whole recovery history;
+    # past it the item fails with the original device error. Sized for
+    # bisection: isolating one poison row in a 64-request batch takes
+    # ~log2(64)+2 replays of the innocent rows.
+    replay_budget: int = 8
+    # A SINGLE-request batch that has killed the executor this many
+    # times is the poison: it alone fails (INVALID_ARGUMENT).
+    poison_kills: int = 2
+    # A MULTI-request batch whose members have this many kills is
+    # bisected into halves instead of replayed whole.
+    bisect_after_kills: int = 2
+    # Re-warm every registered servable's bucket ladder through the
+    # queue after the executor rebuild (recommended: the first replayed
+    # batch must not pay a compile storm under the wedge clock).
+    reinit_warmup: bool = True
+    rewarm_timeout_s: float = 120.0
+    # Also tear down the jax backend client itself (process-global,
+    # heavyweight; only for genuinely lost devices — never the default).
+    reinit_clear_backend: bool = False
+    # How long REPLAY waits for the requeued items to complete before
+    # declaring the cycle done (failures re-trigger; this only bounds
+    # the state machine's dwell).
+    replay_drain_s: float = 30.0
+    # Hard bound on reinit+replay rounds inside one cycle (bisection of
+    # pathological batches); past it the remaining items fail.
+    max_cycle_rounds: int = 20
+    # Retained transition-event history (/recoveryz `events`).
+    history_events: int = 64
+
+    def __post_init__(self):
+        for name in ("replay_budget", "poison_kills", "bisect_after_kills",
+                     "max_cycle_rounds"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"[recovery] {name} must be a positive integer, got {v!r}"
+                )
+        for name in ("watchdog_interval_s", "wedge_quarantine_s",
+                     "replay_drain_s", "rewarm_timeout_s"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v <= 0:
+                # Refuse up front (the other planes' precedent) instead
+                # of silently flooring a 0/negative into hair-trigger
+                # quarantines or unbounded dwells downstream.
+                raise ValueError(
+                    f"[recovery] {name} must be a positive number, got {v!r}"
+                )
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -573,6 +653,7 @@ _SECTIONS = {
     "utilization": UtilizationConfig,
     "quality": QualityConfig,
     "lifecycle": LifecycleConfig,
+    "recovery": RecoveryConfig,
 }
 
 

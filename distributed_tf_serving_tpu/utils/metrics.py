@@ -299,6 +299,18 @@ _HELP = {
     "dts_tpu_pipeline_window_waits_total":
         "Times the dispatch thread waited for the k-deep in-flight "
         "window to open before issuing the next batch",
+    "dts_tpu_recovery_state":
+        "Device-failure recovery state machine, one-hot over serving/"
+        "quarantined/reinit/replay",
+    "dts_tpu_recovery_replayed_items_total":
+        "In-flight/queued requests re-dispatched by the replay path "
+        "instead of failed on device death",
+    "dts_tpu_recovery_poisoned_requests_total":
+        "Requests isolated by bisection as deterministic executor "
+        "killers and failed alone (INVALID_ARGUMENT)",
+    "dts_tpu_recovery_last_cycle_seconds":
+        "Duration of the last completed quarantine->reinit->replay "
+        "cycle (the live MTTR evidence)",
 }
 
 
@@ -467,6 +479,7 @@ class ServerMetrics:
     def prometheus_text(
         self, batcher_stats=None, cache=None, overload=None,
         utilization=None, quality=None, lifecycle=None, pipeline=None,
+        recovery=None,
     ) -> str:
         """Prometheus exposition (text format 0.0.4) of the same data
         snapshot() serves as JSON. Metric names mirror tensorflow_model_
@@ -750,6 +763,8 @@ class ServerMetrics:
             lines.extend(_quality_prometheus_lines(quality))
         if lifecycle is not None:
             lines.extend(_lifecycle_prometheus_lines(lifecycle))
+        if recovery is not None:
+            lines.extend(_recovery_prometheus_lines(recovery))
         return "\n".join(lines) + "\n"
 
 
@@ -923,6 +938,55 @@ def _lifecycle_prometheus_lines(lifecycle: dict) -> list[str]:
     pr = "dts_tpu_lifecycle_probe_routed_total"
     _family_lines(lines, pr, "counter")
     lines.append(f"{pr} {counters.get('routed_probe', 0)}")
+    return lines
+
+
+def _recovery_prometheus_lines(recovery: dict) -> list[str]:
+    """dts_tpu_recovery_* exposition from a RecoveryController snapshot
+    dict (ISSUE 11): the one-hot state gauge (the overload/lifecycle enum
+    encoding), the quarantine/reinit/replay/bisection counters, the
+    pending-replay gauge, and the last cycle's duration (the live MTTR
+    evidence). Families grouped and declared once — the exposition lint's
+    invariants."""
+    esc = escape_label_value
+    lines: list[str] = []
+    st = "dts_tpu_recovery_state"
+    _family_lines(lines, st, "gauge")
+    current = recovery.get("state", "serving")
+    for state in ("serving", "quarantined", "reinit", "replay"):
+        lines.append(
+            f'{st}{{state="{esc(state)}"}} {1 if state == current else 0}'
+        )
+    counters = recovery.get("counters") or {}
+    last = recovery.get("last_cycle") or {}
+    for metric, kind, value in (
+        ("dts_tpu_recovery_quarantines_total", "counter",
+         counters.get("quarantines", 0)),
+        ("dts_tpu_recovery_reinits_total", "counter",
+         counters.get("reinits", 0)),
+        ("dts_tpu_recovery_cycles_completed_total", "counter",
+         counters.get("cycles_completed", 0)),
+        ("dts_tpu_recovery_device_failures_total", "counter",
+         counters.get("device_failures", 0)),
+        ("dts_tpu_recovery_replayed_items_total", "counter",
+         counters.get("replayed_items", 0)),
+        ("dts_tpu_recovery_replay_budget_exhausted_total", "counter",
+         counters.get("replay_budget_exhausted", 0)),
+        ("dts_tpu_recovery_poisoned_requests_total", "counter",
+         counters.get("poisoned_requests", 0)),
+        ("dts_tpu_recovery_bisections_total", "counter",
+         counters.get("bisections", 0)),
+        ("dts_tpu_recovery_watchdog_wedge_trips_total", "counter",
+         counters.get("watchdog_wedge_trips", 0)),
+        ("dts_tpu_recovery_thread_deaths_total", "counter",
+         counters.get("thread_deaths", 0)),
+        ("dts_tpu_recovery_pending_replay_items", "gauge",
+         recovery.get("pending_replay_items", 0)),
+        ("dts_tpu_recovery_last_cycle_seconds", "gauge",
+         last.get("duration_s", 0.0)),
+    ):
+        _family_lines(lines, metric, kind)
+        lines.append(f"{metric} {value}")
     return lines
 
 

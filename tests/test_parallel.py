@@ -290,7 +290,7 @@ def test_client_full_async_mode_knob():
         # Scheduling-equivalence on a live socket is covered by the serving
         # integration tests; here pin the wiring + the sequential code path
         # via a stubbed shard call.
-        async def fake_shard(i, shard, rr):
+        async def fake_shard(i, shard, rr, budget=None):
             calls.append(i)
             await asyncio.sleep(0.01 if i == 0 else 0)  # tempt reordering
             return np.full((shard["feat_ids"].shape[0],), float(i), np.float32)

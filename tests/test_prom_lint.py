@@ -1,6 +1,6 @@
 """Prometheus exposition lint (tools/check_prom.py, ISSUE 7 satellite):
 the aggregated /monitoring/prometheus/metrics text is assembled from
-eight planes and the lint is what guards the assembly — run it against a
+nine planes and the lint is what guards the assembly — run it against a
 FULLY ARMED server snapshot (every plane emitting, adversarial label
 values), and prove it actually catches each failure mode it claims to."""
 
@@ -35,10 +35,12 @@ def _fully_armed_text() -> str:
     from distributed_tf_serving_tpu.serving.batcher import BatcherStats
     from distributed_tf_serving_tpu.serving.lifecycle import LifecycleController
     from distributed_tf_serving_tpu.serving.quality import QualityMonitor
+    from distributed_tf_serving_tpu.serving.recovery import RecoveryController
     from distributed_tf_serving_tpu.serving.utilization import OccupancyLedger
     from distributed_tf_serving_tpu.utils.config import (
         LifecycleConfig,
         OverloadConfig,
+        RecoveryConfig,
     )
 
     m = ServerMetrics()
@@ -77,6 +79,14 @@ def _fully_armed_text() -> str:
     )
     lifecycle.tick()
     lifecycle_mod.deactivate()  # drop the criticality-scan gate it armed
+
+    class _BatcherSlot:  # the controller only needs somewhere to attach
+        recovery = None
+
+    recovery = RecoveryController(
+        RecoveryConfig(enabled=True), _BatcherSlot(), clock=lambda: 12.0
+    )
+    recovery.auto_cycle = False
     return m.prometheus_text(
         stats,
         cache=cache.snapshot(),
@@ -85,6 +95,7 @@ def _fully_armed_text() -> str:
         quality=quality.snapshot(),
         lifecycle=lifecycle.snapshot(),
         pipeline=pipeline,
+        recovery=recovery.snapshot(),
     )
 
 
@@ -97,6 +108,7 @@ def test_fully_armed_snapshot_passes_lint():
         "dts_tpu_cache_", "dts_tpu_overload_", "dts_tpu_utilization_",
         "dts_tpu_quality_", "dts_tpu_lifecycle_", "dts_tpu_pipeline_",
         "dts_tpu_pipeline_bucket_in_flight", "buffer_ring",
+        "dts_tpu_recovery_",
     ):
         assert marker in text
 

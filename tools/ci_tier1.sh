@@ -121,6 +121,23 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_STREAMING_SMOKE:-0}" = "1" ]; then
         python tools/check_streaming_smoke.py | tee "$STREAM_LINE" || rc=1
 fi
 
+# Recovery smoke (TIER1_RECOVERY_SMOKE=1): a SOAK_RECOVERY=1 soak — the
+# device-failure recovery plane under live traffic on a depth-4
+# pipeline: an injected wedge at the device stage must quarantine the
+# replica (watchdog escalation), reinit + replay the captured pipeline
+# with ZERO client-visible non-poison failures and a bounded MTTR, and
+# a content-keyed poisoned input coalesced with clean companions must
+# fail ALONE via bisection (PoisonedInputError) while the companions
+# replay to success (tools/check_recovery_smoke.py).
+if [ "$rc" -eq 0 ] && [ "${TIER1_RECOVERY_SMOKE:-0}" = "1" ]; then
+    RECOVERY_LINE="${TIER1_RECOVERY_LINE:-/tmp/tier1_recovery_soak.json}"
+    echo "tier1: recovery smoke (SOAK_RECOVERY=1, line $RECOVERY_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_RECOVERY_SECONDS:-14}" SOAK_RECOVERY=1 \
+        python tools/soak.py | tee "$RECOVERY_LINE" || rc=1
+    python tools/check_recovery_smoke.py "$RECOVERY_LINE" || rc=1
+fi
+
 # Lifecycle smoke (TIER1_LIFECYCLE_SMOKE=1): a SOAK_LIFECYCLE=1 soak —
 # trained model behind a real version watcher + lifecycle controller;
 # the driver publishes a fine-tuned GOOD canary (must auto-promote) and

@@ -122,6 +122,26 @@ route/series probes — gated in CI by tools/check_lifecycle_smoke.py
 (promote AND rollback observed, blacklist survived reconcile, ZERO
 failed requests attributable to either swap).
 
+Recovery mode (SOAK_RECOVERY=1): the device-failure recovery plane
+(ISSUE 11, serving/recovery.py) end to end against live traffic on a
+depth-4 continuous-batching pipeline (inflight_window=4, buffer ring).
+A RecoveryController with a fast watchdog runs armed while gRPC workers
+(scoreboard + deep failover retries whose horizon outlasts the cycle,
+plus the new per-request max_attempts_total budget) hammer the replica.
+A driver task then (a) WEDGES the device stage (faults.py wedge rule) —
+the watchdog must escalate the wedge clock into a quarantine (health
+NOT_SERVING), replace the stranded worker pools, reinit + re-warm the
+executor, and replay the captured pipeline with zero client-visible
+failures; MTTR is measured from injection to the first post-recovery
+success; (b) submits a content-keyed POISONED input (device_lost rule
+keyed on batcher.poison_fault_key) coalesced with clean companions —
+the bisection must fail exactly the poison with PoisonedInputError
+(INVALID_ARGUMENT) while the companions replay to success. End probes
+hit the LIVE /recoveryz, /monitoring?section=recovery, and Prometheus
+surfaces. The JSON line gains a `recovery` block gated in CI by
+tools/check_recovery_smoke.py (quarantine + replay observed, MTTR
+bounded, zero non-poison failures, bisection isolating the poison).
+
 Tracing (SOAK_TRACE_OUT=/path/trace.json): per-request span tracing runs
 for the whole soak (utils/tracing.py; SOAK_TRACE_SAMPLE sets the tail-
 sampling rate, default 0.05 — errors/fault-annotated/slowest-N traces are
@@ -221,8 +241,23 @@ def main() -> None:
     # back, mid-traffic, with zero failed requests. Small requests and
     # no REST mixer, like quality mode.
     lifecycle_mode = os.environ.get("SOAK_LIFECYCLE", "0") == "1"
+    # Recovery mode (SOAK_RECOVERY=1): the device-failure recovery plane
+    # under live traffic on a depth-4 pipeline — a scenario driver
+    # injects a WEDGE at the device stage (the watchdog must quarantine,
+    # reinit, and replay with zero client-visible failures) and then a
+    # content-keyed poisoned input coalesced with clean companions (the
+    # bisection must fail exactly the poison with its distinct status
+    # while the companions replay to success).
+    recovery_mode = os.environ.get("SOAK_RECOVERY", "0") == "1"
     if quality_mode or lifecycle_mode:
         candidates = int(os.environ.get("SOAK_CANDIDATES", "16"))
+        grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "4"))
+        rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "0"))
+    elif recovery_mode:
+        # Small bucket + modest load: each reinit round re-warms the
+        # ladder, so the cycle time (and with it the client retry
+        # horizon) must stay in low seconds on a CPU-only CI host.
+        candidates = int(os.environ.get("SOAK_CANDIDATES", "200"))
         grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "4"))
         rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "0"))
     trace_out = os.environ.get("SOAK_TRACE_OUT", "")
@@ -406,12 +441,24 @@ def main() -> None:
         # One small bucket: three versions each warm the ladder through
         # the queue mid-soak, and the candidates are 16-row requests.
         buckets = (64,)
+    elif recovery_mode:
+        # One small bucket: every reinit round re-warms the whole ladder
+        # through the queue, and the recovery cycle must finish inside
+        # the client retry horizon.
+        buckets = (256,)
     else:
         buckets = (1024, 2048, 4096, 8192, 16384) if tpu else (1024, 2048)
+    batcher_kw = {}
+    if recovery_mode:
+        # The acceptance scenario: a wedge at PIPELINE DEPTH 4 — several
+        # batches in flight behind the stuck one, all captured + replayed.
+        batcher_kw = dict(
+            pipeline_depth=4, inflight_window=4, buffer_ring=True
+        )
     batcher = DynamicBatcher(
         buckets=buckets, max_wait_us=2000, completion_workers=12,
         score_cache=score_cache, dedup=cache_mode, overload=overload_ctrl,
-        utilization=ledger, quality=quality_monitor,
+        utilization=ledger, quality=quality_monitor, **batcher_kw,
     ).start()
     batcher.max_batch_candidates = buckets[-1]
     if not lifecycle_mode:
@@ -501,6 +548,27 @@ def main() -> None:
     if lifecycle_mode:
         impl.lifecycle = lifecycle_ctrl
         impl.version_watcher = lifecycle_watcher
+
+    recovery_block: dict = {}
+    recovery_ctrl = None
+    if recovery_mode:
+        from distributed_tf_serving_tpu.serving.recovery import (
+            RecoveryController,
+        )
+        from distributed_tf_serving_tpu.utils.config import RecoveryConfig
+
+        recovery_ctrl = RecoveryController(
+            RecoveryConfig(
+                enabled=True,
+                watchdog_interval_s=0.2,
+                wedge_quarantine_s=float(
+                    os.environ.get("SOAK_RECOVERY_WEDGE_S", "1.0")
+                ),
+                replay_drain_s=15.0,
+            ),
+            batcher, registry=registry, impl=impl,
+        ).start()
+        impl.recovery = recovery_ctrl
 
     quality_block: dict = {}
     q_pools: dict = {}
@@ -943,6 +1011,123 @@ def main() -> None:
             if ln.startswith("dts_tpu_lifecycle_")
         )
 
+    async def recovery_driver(client):
+        """The scenario script: (1) wedge the device stage mid-run — the
+        watchdog must quarantine, reinit, and replay with the in-flight
+        depth-4 pipeline's work answered, MTTR measured to the first
+        post-recovery success; (2) submit a content-keyed poisoned input
+        coalesced with clean companions — the bisection must fail exactly
+        the poison (PoisonedInputError) while the companions score."""
+        from distributed_tf_serving_tpu import faults as faults_mod
+        from distributed_tf_serving_tpu.serving.batcher import (
+            PoisonedInputError,
+            poison_fault_key,
+            prepare_inputs,
+        )
+
+        loop_ = asyncio.get_running_loop()
+        # --- phase 1: wedge at pipeline depth 4 -------------------------
+        await asyncio.sleep(
+            seconds * float(os.environ.get("SOAK_RECOVERY_WEDGE_AT", "0.3"))
+        )
+        t_inject = time.perf_counter()
+        # delay_s doubles as the stranded thread's safety release; count=1
+        # so the REPLAYED batch does not re-wedge.
+        faults_mod.get().add(
+            "batcher.dispatch", "wedge", delay_s=10.0, count=1
+        )
+        recovery_block["wedge_injected"] = True
+        while time.perf_counter() < deadline:
+            if recovery_ctrl.snapshot()["counters"]["quarantines"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        recovery_block["quarantine_wait_s"] = round(
+            time.perf_counter() - t_inject, 3
+        )
+        while time.perf_counter() < deadline:
+            if (recovery_ctrl.state() == "serving"
+                    and not recovery_ctrl.cycle_active()):
+                break
+            await asyncio.sleep(0.05)
+        probe = make_payload(candidates=64, num_fields=NUM_FIELDS, seed=901)
+        while time.perf_counter() < deadline:
+            try:
+                await client.predict(probe)
+                break
+            except Exception:  # noqa: BLE001 — still recovering
+                await asyncio.sleep(0.05)
+        recovery_block["mttr_s"] = round(time.perf_counter() - t_inject, 3)
+        faults_mod.get().clear("batcher.dispatch")
+        # --- phase 2: poisoned input + bisection ------------------------
+        poison = make_payload(candidates=32, num_fields=NUM_FIELDS, seed=777)
+        companions = [
+            make_payload(candidates=32, num_fields=NUM_FIELDS, seed=778 + i)
+            for i in range(2)
+        ]
+        key = poison_fault_key(
+            prepare_inputs(model, poison, fold_ids=False)
+        )
+        faults_mod.get().add(
+            "device_lost", "error", code="DATA_LOSS", key=key
+        )
+
+        def submit_all():
+            # Companions first, poison in the middle, tight sequence: all
+            # three land inside one 2ms coalesce window, so the first
+            # kill hits a MULTI-request batch and the bisection has
+            # something to split.
+            f1 = batcher.submit(servable, companions[0])
+            fp = batcher.submit(servable, poison)
+            f2 = batcher.submit(servable, companions[1])
+            return fp, [f1, f2]
+
+        fp, fcs = await loop_.run_in_executor(None, submit_all)
+
+        def harvest():
+            out = {"poisoned": False, "companions_ok": 0}
+            try:
+                fp.result(timeout=90)
+                out["poison_error"] = "succeeded (rule did not fire?)"
+            except PoisonedInputError:
+                out["poisoned"] = True
+            except Exception as e:  # noqa: BLE001 — report the taxonomy
+                out["poison_error"] = type(e).__name__
+            for fc in fcs:
+                try:
+                    fc.result(timeout=90)
+                    out["companions_ok"] += 1
+                except Exception as e:  # noqa: BLE001
+                    out.setdefault("companion_errors", []).append(
+                        type(e).__name__
+                    )
+            return out
+
+        recovery_block["poison"] = await loop_.run_in_executor(None, harvest)
+        faults_mod.get().clear("device_lost")
+
+    async def probe_recovery(session) -> None:
+        """End-of-run probes against the LIVE surfaces: /recoveryz, the
+        ?section= filter, and the dts_tpu_recovery_* Prometheus series."""
+        async with session.get("/recoveryz") as r:
+            rz = await r.json()
+        recovery_block["recoveryz_enabled"] = bool(rz.get("enabled"))
+        recovery_block["final_state"] = rz.get("state")
+        recovery_block["counters"] = rz.get("counters")
+        recovery_block["last_cycle"] = rz.get("last_cycle")
+        async with session.get("/monitoring?section=recovery") as r:
+            sec = await r.json()
+            recovery_block["section_filter_ok"] = (
+                r.status == 200
+                and set(sec) == {"recovery"}
+                and bool(sec["recovery"].get("enabled"))
+            )
+        async with session.get("/monitoring/prometheus/metrics") as r:
+            prom_text = await r.text()
+        recovery_block["prom_recovery_series"] = sum(
+            1 for ln in prom_text.splitlines()
+            if ln.startswith("dts_tpu_recovery_")
+        )
+
     async def control_worker(gport: int):
         import grpc as grpc_mod
 
@@ -1026,9 +1211,25 @@ def main() -> None:
                 # (same single host — exercises the backoff path). Overload
                 # soaks run it too: sheds must land as PUSHBACK (busy) on
                 # the scoreboard and the one retry honors retry-after-ms.
-                scoreboard=chaos or overload_mode,
-                failover_attempts=1 if (chaos or overload_mode) else 0,
+                scoreboard=chaos or overload_mode or recovery_mode,
+                failover_attempts=(
+                    8 if recovery_mode
+                    else 1 if (chaos or overload_mode) else 0
+                ),
             )
+            if recovery_mode:
+                # Retries must OUTLAST the recovery cycles (quarantined
+                # submits answer UNAVAILABLE until REPLAY, and the wedge
+                # + poison phases can run 2-3 back-to-back cycles of a
+                # few seconds each on a CPU host — in production the
+                # scoreboard reroutes to another replica instead). The
+                # new per-request attempt budget rides along, sized so
+                # it never binds here while still exercising the knob
+                # end to end.
+                client_kwargs.update(
+                    backoff_initial_s=0.3, backoff_max_s=2.0,
+                    timeout_s=25.0, max_attempts_total=16,
+                )
             if overload_mode:
                 # The RPC deadline IS the goodput bar: a success under
                 # this client is by construction an in-deadline success.
@@ -1097,6 +1298,7 @@ def main() -> None:
                         ]
                     await asyncio.gather(
                         *data_workers,
+                        *([recovery_driver(client)] if recovery_mode else []),
                         *(burst_worker(client, w) for w in range(burst_workers)),
                         *(rest_worker(session, w) for w in range(rest_workers)),
                         *([] if lifecycle_mode else [control_worker(gport)]),
@@ -1128,6 +1330,11 @@ def main() -> None:
                             await probe_lifecycle(session)
                         except Exception as e:  # noqa: BLE001 — report, keep line
                             lifecycle_block["error"] = f"{type(e).__name__}: {e}"
+                    if recovery_mode:
+                        try:
+                            await probe_recovery(session)
+                        except Exception as e:  # noqa: BLE001 — report, keep line
+                            recovery_block["error"] = f"{type(e).__name__}: {e}"
                     if trace_out:
                         try:
                             await export_trace(session)
@@ -1293,6 +1500,10 @@ def main() -> None:
         # blacklist-persistence evidence with live-route probes — the CI
         # gate (tools/check_lifecycle_smoke.py) reads this.
         "lifecycle": lifecycle_block if lifecycle_mode else None,
+        # Recovery plane (SOAK_RECOVERY=1): wedge-trip MTTR + poison
+        # bisection evidence with live-route probes — the CI gate
+        # (tools/check_recovery_smoke.py) reads this.
+        "recovery": recovery_block if recovery_mode else None,
         "chaos": None,
         "input_cache": (
             {
@@ -1306,12 +1517,14 @@ def main() -> None:
             else None
         ),
     }
-    if chaos or overload_mode:
+    if chaos or overload_mode or recovery_mode:
         from distributed_tf_serving_tpu import faults
 
         if chaos:
             line["chaos"] = faults.get().snapshot()
         faults.reset()
+    if recovery_ctrl is not None:
+        recovery_ctrl.stop()
     if lifecycle_ctrl is not None:
         lifecycle_ctrl.stop()
     if lifecycle_watcher is not None:
