@@ -648,16 +648,16 @@ def pallas_probe(scale: Scale, config, cross_params) -> dict:
     """Fused Pallas cross-stack capability probe: equality + timing vs the
     per-layer XLA path on the real device (interpret on the CPU smoke).
 
-    DECISION (2026-07-31, round 4): the kernel is RETIRED from the serving
-    auto-enable path. Three rounds of on-chip measurement put it at
+    DECISION (2026-07-31, round 4): the cross-ONLY kernel is retired from
+    any auto-enable path — three rounds of on-chip measurement put it at
     0.81-0.96x XLA at the flagship widths while the XLA path itself runs
-    at 0.70-0.73 MFU end-to-end (device_decomposition) — within ~1.4x of
-    the chip's roofline — and serving is host-bound at ~1% device
-    utilization, so even a winning kernel would not move the headline.
-    The kernel, its numerics-equality tests, and the explicit
-    ModelConfig.use_pallas_cross opt-in remain as a capability; this probe
-    keeps publishing the measured ratio so the decision stays auditable.
-    (README "Pallas" section carries the same note.)"""
+    at 0.70-0.73 MFU end-to-end. This probe keeps publishing the measured
+    ratio so that decision stays auditable. ISSUE 12 superseded the
+    STRATEGY: the reworked kernel fuses the whole serving step (embedding
+    gather + cross + MLP head, int8 weight operands) and competes through
+    the ops/autotune.py harness (DTS_BENCH_KERNELS=1 `kernels` block),
+    which enables it per bucket only where it measures a live win — the
+    retirement lesson enforced by machinery instead of a docstring."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -715,6 +715,88 @@ def pallas_probe(scale: Scale, config, cross_params) -> dict:
         "utilization — kernel kept as ModelConfig.use_pallas_cross opt-in"
     )
     return block
+
+
+def kernel_ab_block(batcher, servable, scale: Scale, config) -> dict:
+    """Kernels A/B (ISSUE 12, opt-in via DTS_BENCH_KERNELS=1): run the
+    ops/autotune.py harness over the timed buckets on the live device —
+    per-bucket XLA/Pallas x f32/int8 step times through the SAME jitted
+    entries the batcher serves with, the emitted per-bucket decision
+    table, the wire-bytes deltas (score bytes per candidate per wire
+    dtype; quantized weight-stream shrink), and the accuracy gates: max
+    |dScore| vs the f32 baseline and AUC on a held-out labeled synthetic
+    block against the train block's number (the 0.84-on-TPU anchor) —
+    quantized must land within [kernels] auc_margin (0.005). The
+    decision table persists to artifacts/kernel_autotune.json, so a
+    serving process on this same device adopts these measurements at
+    warmup instead of re-tuning. The manager detaches afterward: the
+    bench's own windows never serve variant executables, keeping
+    headlines comparable across rounds."""
+    from distributed_tf_serving_tpu.ops.autotune import KernelManager
+    from distributed_tf_serving_tpu.ops.quantize import (
+        quantize_params,
+        quantized_param_bytes,
+    )
+    from distributed_tf_serving_tpu.train.data import (
+        SyntheticCTRConfig,
+        SyntheticCTRStream,
+    )
+    from distributed_tf_serving_tpu.utils.config import KernelsConfig
+
+    kc = KernelsConfig(
+        enabled=True,
+        table_file=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "kernel_autotune.json",
+        ),
+        measure_iters=int(os.environ.get("DTS_BENCH_KERNEL_ITERS", "0")),
+    )
+    manager = KernelManager(kc)
+    batcher.kernels = manager
+    try:
+        # Held-out labeled eval: the train stream's generator at an index
+        # far past anything training touched (train/data batch(i) is
+        # deterministic per index) — same teacher, fresh rows.
+        stream = SyntheticCTRStream(SyntheticCTRConfig(
+            num_fields=config.num_fields, id_space=scale.train_id_space,
+            seed=0,
+        ))
+        held_out = stream.batch(1024, 10_000_019)
+        eval_data = (
+            {"feat_ids": held_out["feat_ids"], "feat_wts": held_out["feat_wts"]},
+            held_out["labels"],
+        )
+        buckets = tuple(b for b in scale.timed_buckets if b <= 4096)
+        # force=True: the A/B block's contract is FRESH per-round numbers
+        # — a deterministic re-train would otherwise digest-match round
+        # 1's persisted entry and replay its timings as this round's.
+        table = manager.autotune(
+            batcher, servable, buckets=buckets, eval_data=eval_data,
+            force=True,
+        )
+        q, f = quantized_param_bytes(quantize_params(servable.params))
+        decisions = {
+            b: row.get("decision")
+            for b, row in (table.get("buckets") or {}).items()
+        }
+        return {
+            "table": table,
+            "decisions": decisions,
+            "any_enabled": any(
+                d not in (None, "xla_f32") for d in decisions.values()
+            ),
+            # The readback/wire half of the int8 story: bytes per score
+            # crossing D2H (and, with [kernels] int8_score_wire + the
+            # client opt-in, the response wire) per wire dtype.
+            "wire_bytes_per_score": {"float32": 4, "bfloat16": 2, "int8": 1},
+            "quantized_weight_bytes": q,
+            "f32_weight_bytes": f,
+            "weight_stream_shrink": round(f / q, 2) if q else None,
+            "table_file": kc.table_file,
+        }
+    finally:
+        # Detach: headline windows must serve the baseline executables.
+        batcher.kernels = None
 
 
 def device_decomposition(batcher, servable, scale: Scale, rtt_floor_ms, device: str) -> dict:
@@ -2382,6 +2464,13 @@ def child_main() -> None:
             asyncio.run(serve_lifecycle())
         if os.environ.get("DTS_BENCH_RECOVERY", "0") == "1":
             asyncio.run(serve_recovery())
+        if os.environ.get("DTS_BENCH_KERNELS", "0") == "1":
+            stage = "kernels"
+            res["kernels"] = kernel_ab_block(batcher, servable, scale, config)
+            log(stage, json.dumps({
+                "decisions": res["kernels"]["decisions"],
+                "any_enabled": res["kernels"]["any_enabled"],
+            }))
         batcher.stop()
 
         asyncio.run(measure_host_ceiling())
@@ -2442,6 +2531,13 @@ def child_main() -> None:
             # and the replayed in-flight requests' added latency vs the
             # steady window; absent when the block is off (the default).
             "recovery": res.get("recovery"),
+            # Kernel autotune A/B (ISSUE 12, DTS_BENCH_KERNELS=1): per-
+            # bucket XLA/Pallas x f32/int8 step times + the emitted
+            # decision table + wire-bytes deltas + the max|dScore| / AUC
+            # gates; absent when the block is off (the default). The
+            # decision table also lands in artifacts/kernel_autotune.json
+            # for serving processes on this device to adopt.
+            "kernels": res.get("kernels"),
             # Output-transfer pipeline attribution (ISSUE 1): wire bytes
             # fetched vs. the full-fp32 all-outputs baseline, and the
             # fraction of the in-flight D2H window the completers never

@@ -91,6 +91,14 @@ class ResilienceCounters:
     # reroutes) ran out — the storm-suppression the recovery plane's
     # quarantine relies on.
     retry_budget_exhausted: int = 0
+    # Recovery plane (ISSUE 12 satellite): UNAVAILABLE answers that
+    # carried the replica-rebuilding marker (a quarantined backend
+    # announcing its own recovery cycle) — steered around as "alive but
+    # rebuilding", never charged to the ejection budget.
+    rebuilding_hints: int = 0
+    # int8 score response wire (ISSUE 12): responses whose score tensor
+    # arrived as DT_INT8 + sidecars and was dequantized locally.
+    int8_responses: int = 0
 
 
 class _AttemptBudget:
@@ -120,6 +128,17 @@ class _AttemptBudget:
 # dependency, so the literals live on both sides).
 _CRITICALITY_KEY = "x-dts-criticality"
 _RETRY_AFTER_KEY = "retry-after-ms"
+# int8 score response wire opt-in (ops/autotune.py SCORE_WIRE_KEY — the
+# literal lives on both sides for the same jax-free-import reason).
+_SCORE_WIRE_KEY = "x-dts-score-wire"
+# Substring a quarantined replica's UNAVAILABLE refusal carries
+# (serving/batcher.py DeviceQuarantinedError message: "replica
+# quarantined: device executor is being rebuilt ..."): the backend is
+# alive and ANSWERING — it announced its own executor rebuild — so the
+# scoreboard marks it rebuilding instead of burning ejection budget.
+# A drain refusal ("server draining ...") deliberately does NOT match:
+# a draining replica is leaving, not coming back.
+_REBUILDING_MARKER = "replica quarantined"
 
 
 def _retry_after_ms_of(err) -> int | None:
@@ -304,6 +323,7 @@ class ShardedPredictClient:
         criticality: str = "",
         stream_chunk_candidates: int = 0,
         max_attempts_total: int = 0,
+        score_wire_int8: bool = False,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -393,6 +413,12 @@ class ShardedPredictClient:
         # first attempt is always allowed; the budget bounds the rest.
         # 0 = unlimited (historical behavior).
         self.max_attempts_total = max(int(max_attempts_total or 0), 0)
+        # int8 score response wire (ISSUE 12): opt into DT_INT8 score
+        # tensors (+ scale/min sidecar outputs, dequantized locally) via
+        # x-dts-score-wire metadata — 4x fewer response bytes per score
+        # against a server with [kernels] int8_score_wire on; servers
+        # without the plane ignore the metadata and answer normally.
+        self.score_wire_int8 = bool(score_wire_int8)
         self._first_score_ms: list[float] = []
         self.counters = ResilienceCounters()
         self._health_stubs: list[object | None] = [None] * len(self.hosts)
@@ -462,6 +488,8 @@ class ShardedPredictClient:
                 )
             if self.criticality:
                 md.append((_CRITICALITY_KEY, self.criticality))
+            if self.score_wire_int8:
+                md.append((_SCORE_WIRE_KEY, "int8"))
             metadata = tuple(md) or None
             t0 = time.perf_counter()
             try:
@@ -516,8 +544,30 @@ class ShardedPredictClient:
                     self.counters.pushbacks_received += 1
                     if span is not None and retry_after_ms:
                         span.attrs["retry_after_ms"] = retry_after_ms
+                rebuilding = (
+                    code_name == "UNAVAILABLE"
+                    and _REBUILDING_MARKER in (e.details() or "")
+                )
+                if rebuilding:
+                    # Quarantine-aware hint (ISSUE 12 satellite): the
+                    # backend ANSWERED with its own recovery-cycle
+                    # announcement — it is alive and will be back in
+                    # seconds (MTTR ~1-4s measured). Mirror the PR-5
+                    # pushback-is-not-death pattern: steer around it
+                    # without consuming the consecutive-failure ejection
+                    # budget (ejecting would hold traffic off for the
+                    # full doubling ejection window after a sub-second
+                    # rebuild, and a fleet-wide chaos event would cascade
+                    # exactly like the overload case did).
+                    self.counters.rebuilding_hints += 1
+                    if span is not None:
+                        span.attrs["rebuilding"] = True
                 if self.scoreboard is not None:
-                    if code_name == "RESOURCE_EXHAUSTED":
+                    if rebuilding:
+                        self.scoreboard.record_failure(
+                            host_idx, kind="rebuilding"
+                        )
+                    elif code_name == "RESOURCE_EXHAUSTED":
                         self.scoreboard.record_failure(
                             host_idx, kind="pushback",
                             retry_after_s=(
@@ -642,10 +692,13 @@ class ShardedPredictClient:
                     except BaseException:  # noqa: BLE001 — reaping only
                         pass
 
-    async def _health_check_ok(self, host_idx: int) -> bool:
+    async def _health_check(self, host_idx: int) -> str:
         """grpc.health.v1 Check on the host's first channel (overall server
         health, service \"\") — the cheap half-open probe that never costs a
-        real request its latency."""
+        real request its latency. Returns "serving", "not_serving" (the
+        server ANSWERED — alive but refusing, e.g. a recovery-cycle
+        rebuild or warmup), "inconclusive" (no health service — the
+        answer proves liveness), or "down"."""
         from ..proto import health as health_proto
 
         stub = self._health_stubs[host_idx]
@@ -663,11 +716,14 @@ class ShardedPredictClient:
                 # Backend build without the health service: the answer
                 # PROVES it is alive — inconclusive, so fall through to
                 # the real-request probe instead of re-ejecting forever.
-                return True
-            return False
+                return "inconclusive"
+            return "down"
         except Exception:  # noqa: BLE001 — any other probe failure = down
-            return False
-        return resp.status == health_proto.SERVING
+            return "down"
+        return (
+            "serving" if resp.status == health_proto.SERVING
+            else "not_serving"
+        )
 
     def _new_budget(self, shards: int) -> "_AttemptBudget | None":
         """Per-request attempt budget, or None when the knob is off.
@@ -783,7 +839,26 @@ class ShardedPredictClient:
                     and self.scoreboard is not None
                     and self.scoreboard.state(host_idx) == HALF_OPEN
                 ):
-                    if not await self._health_check_ok(host_idx):
+                    status = await self._health_check(host_idx)
+                    if status == "not_serving":
+                        # The server ANSWERED NOT_SERVING: alive but
+                        # refusing — a recovery-cycle rebuild (or warmup).
+                        # Mark it rebuilding (steer-around bias) instead
+                        # of a probe FAILURE, whose doubled re-ejection
+                        # would hold traffic off long after the ~seconds
+                        # rebuild finished (ISSUE 12 satellite).
+                        self.counters.rebuilding_hints += 1
+                        self.scoreboard.record_failure(
+                            host_idx, kind="rebuilding"
+                        )
+                        if last is None:
+                            last = _ShardAttemptError(
+                                host_idx,
+                                grpc.StatusCode.UNAVAILABLE,
+                                "health probe reported not serving",
+                            )
+                        continue
+                    if status == "down":
                         # Probe says still down: re-eject (doubled interval)
                         # without burning a real RPC + timeout on it.
                         self.scoreboard.record_failure(host_idx)
@@ -791,7 +866,7 @@ class ShardedPredictClient:
                             last = _ShardAttemptError(
                                 host_idx,
                                 grpc.StatusCode.UNAVAILABLE,
-                                "health probe reported not serving",
+                                "health probe did not answer",
                             )
                         continue
                 resp = await self._attempt(
@@ -812,6 +887,13 @@ class ShardedPredictClient:
                 ) from e
             if extract is not None:
                 return extract(resp)
+            if self.score_wire_int8:
+                tp = resp.outputs[self.output_key]
+                if tp.dtype == codec.DataType.DT_INT8:
+                    self.counters.int8_responses += 1
+                return codec.dequantize_response_output(
+                    resp.outputs, self.output_key
+                )
             return codec.to_ndarray(resp.outputs[self.output_key])
         assert last is not None, "exhaustion implies at least one failure"
         raise PredictClientError(

@@ -54,6 +54,22 @@ class ScoreboardConfig:
     # retry-after hint. Short on purpose: overload drains in queue-wait
     # units, not ejection units.
     pushback_busy_s: float = 0.25
+    # How long a rebuilding hint (kind="rebuilding" — a quarantined
+    # replica's UNAVAILABLE refusal or a NOT_SERVING health answer
+    # during its recovery cycle) biases steering away. Sized to the
+    # measured recovery MTTR (~1-4s): long enough to skip the rebuild,
+    # short enough that the recovered replica gets traffic back without
+    # waiting out an ejection window it never earned.
+    rebuilding_busy_s: float = 2.0
+    # CONSECUTIVE rebuilding hints (no intervening success) a host may
+    # accumulate before further ones count as ordinary failures again.
+    # A genuine recovery cycle resolves within its MTTR — one or two
+    # hints; a DRAINING replica (health also answers NOT_SERVING while
+    # leaving) or a replica stuck in endless quarantine would otherwise
+    # cycle healthy-busy forever with the ejection backoff zeroed each
+    # round. Past the streak, the normal eject-with-doubling machinery
+    # takes over.
+    rebuilding_streak_limit: int = 3
 
 
 @dataclasses.dataclass
@@ -71,6 +87,13 @@ class _HostState:
     # never sees these.
     pushbacks: int = 0
     busy_until: float = 0.0
+    # Recovery-plane rebuilds announced by the host itself (ISSUE 12
+    # satellite): alive, answering, temporarily refusing — shares the
+    # busy_until steering bias, never the ejection budget. The
+    # consecutive streak (reset by any success) bounds how long the
+    # hint can defer ejection — see rebuilding_streak_limit.
+    rebuilds: int = 0
+    consecutive_rebuilds: int = 0
 
 
 class BackendScoreboard:
@@ -96,6 +119,9 @@ class BackendScoreboard:
         self.probes = 0
         self.recoveries = 0
         self.pushbacks = 0
+        # Rebuilding hints (ISSUE 12 satellite): quarantine refusals /
+        # NOT_SERVING health answers recorded as kind="rebuilding".
+        self.rebuilds = 0
         # Retry-budget trips (ISSUE 11): requests whose per-request
         # attempt cap (client max_attempts_total) ran dry — the
         # storm-suppression evidence next to the ejection counters it
@@ -109,6 +135,7 @@ class BackendScoreboard:
             st = self._states[idx]
             st.successes += 1
             st.consecutive_failures = 0
+            st.consecutive_rebuilds = 0
             if latency_s is not None:
                 ms = latency_s * 1e3
                 a = self.config.ewma_alpha
@@ -129,6 +156,13 @@ class BackendScoreboard:
 
         kind="failure" (default): a reroutable failure — the backend may be
         dead; counts toward the consecutive-failure ejection budget.
+        kind="rebuilding": the backend itself announced a recovery-cycle
+        rebuild (a quarantine UNAVAILABLE refusal, or NOT_SERVING from
+        its health service mid-cycle) — it is provably alive and will be
+        back within its MTTR, so it is marked busy for rebuilding_busy_s
+        (or the caller-provided window) and steered around WITHOUT
+        touching the ejection budget; exactly the PR-5
+        pushback-is-not-death pattern applied below the RPC layer.
         kind="pushback": an overload shed (RESOURCE_EXHAUSTED with the
         serving stack's retry-after hint) — the backend ANSWERED, so it is
         provably alive; it is marked busy for `retry_after_s` (or the
@@ -140,6 +174,36 @@ class BackendScoreboard:
         ejection cascade and the survivors inherit ALL the traffic."""
         with self._lock:
             st = self._states[idx]
+            if kind == "rebuilding" and \
+                    st.consecutive_rebuilds >= self.config.rebuilding_streak_limit:
+                # The host has announced "rebuilding" this many times in a
+                # row without once answering a request: that is a draining
+                # replica (its health also reads NOT_SERVING) or a
+                # quarantine loop, not a bounded recovery cycle. Fall
+                # through to the ordinary failure path so the
+                # eject-with-doubling machinery bounds further probing.
+                kind = "failure"
+            if kind == "rebuilding":
+                st.rebuilds += 1
+                st.consecutive_rebuilds += 1
+                self.rebuilds += 1
+                busy = (
+                    retry_after_s if retry_after_s is not None
+                    else self.config.rebuilding_busy_s
+                )
+                st.busy_until = max(st.busy_until, self._clock() + busy)
+                # The refusal PROVES the host answers (same reasoning as
+                # the pushback branch): the failure streak is over, and
+                # an ejected/half-open host that announced its rebuild
+                # recovers to HEALTHY (busy) instead of re-ejecting with
+                # a doubled interval.
+                st.consecutive_failures = 0
+                if st.state != HEALTHY:
+                    st.state = HEALTHY
+                    st.probe_inflight = False
+                    st.current_ejection_s = 0.0
+                    self.recoveries += 1
+                return
             if kind == "pushback":
                 st.pushbacks += 1
                 self.pushbacks += 1
@@ -292,6 +356,7 @@ class BackendScoreboard:
                 "probes": self.probes,
                 "recoveries": self.recoveries,
                 "pushbacks": self.pushbacks,
+                "rebuilds": self.rebuilds,
                 "retry_budget_exhausted": self.retry_budget_exhausted,
                 "backends": {
                     host: {
@@ -301,6 +366,7 @@ class BackendScoreboard:
                         "successes": st.successes,
                         "failures": st.failures,
                         "pushbacks": st.pushbacks,
+                        "rebuilds": st.rebuilds,
                         "busy": st.busy_until > now,
                     }
                     for host, st in zip(self.hosts, self._states)

@@ -37,11 +37,22 @@ def _cross_init(rng, num_layers: int, d: int, full_matrix: bool, dtype):
 
 
 def cross_apply(layers, x0: jax.Array, compute_dtype) -> jax.Array:
-    """Apply the stack of cross layers; x0 is [n, d] in compute_dtype."""
+    """Apply the stack of cross layers; x0 is [n, d] in compute_dtype.
+    Accepts both the float {"w","b"} layers and the int8 weight-only
+    quantized {"qw","qscale","b"} form (ops/quantize.py): the per-channel
+    scale folds into the f32 xw before the elementwise update, so the
+    quantized stack differs from f32 only by the weight rounding."""
     x = x0
     for p in layers:
-        w = p["w"].astype(compute_dtype)
         b = p["b"].astype(jnp.float32)
+        if "qw" in p:  # quantized DCN-v2 (v1 rank-1 layers never quantize)
+            xw = jax.lax.dot_general(
+                x, p["qw"].astype(compute_dtype),
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            ) * p["qscale"].astype(jnp.float32)
+            x = (x0.astype(jnp.float32) * (xw + b) + x.astype(jnp.float32)).astype(compute_dtype)
+            continue
+        w = p["w"].astype(compute_dtype)
         if w.ndim == 2:  # DCN-v2
             xw = jax.lax.dot_general(
                 x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -73,7 +84,15 @@ def _build(config: ModelConfig) -> Model:
         cd = config.cdtype
         emb = field_embed(params["embedding"], batch["feat_ids"], batch["feat_wts"], cd)
         x0 = emb.reshape(emb.shape[0], d)  # [n, F*D]
-        use_fused = config.use_pallas_cross and config.cross_full_matrix
+        use_fused = (
+            config.use_pallas_cross
+            and config.cross_full_matrix
+            # The legacy cross-only kernel takes float stacked weights; a
+            # quantized tree (ops/quantize.py {"qw"} form) rides the XLA
+            # path here — the int8-operand FUSED kernel is the serving
+            # batcher's per-bucket variant, not this opt-in.
+            and "w" in params["cross"][0]
+        )
         if use_fused:
             from ..ops.cross_kernel import fits_vmem
 

@@ -24,6 +24,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -135,3 +136,349 @@ def cross_params_to_stacked(cross_layers: list) -> tuple[jax.Array, jax.Array]:
     w = jnp.stack([p["w"] for p in cross_layers])
     b = jnp.stack([p["b"] for p in cross_layers])
     return w, b
+
+
+# ===========================================================================
+# Fused SERVING kernel (ISSUE 12): embedding-gather + cross + MLP head
+# ===========================================================================
+#
+# The cross-only kernel above lost to XLA on-chip (BENCH r2-r5: 0.81-0.96x)
+# because it fused the one stage XLA already runs near the roofline. This
+# rework fuses the WHOLE per-candidate serving step into one kernel so the
+# intermediate activations (the [n, F, D] gathered embeddings, the [n, d]
+# cross/MLP activations) never round-trip through HBM at all:
+#
+#   ids --(per-row DMA gather from the HBM-resident table)--> x0 in VMEM
+#      --> L cross layers --> MLP stack --> output head --> sigmoid
+#
+# int8 weights are FIRST-CLASS operands: the quantized variant streams the
+# ops/quantize.py per-channel int8 matrices (4x fewer weight bytes than
+# f32) and folds the per-output-channel scale into the f32 accumulator —
+# the same algebra as models/base.py dense_apply, inside the kernel.
+#
+# Mosaic/interpret caveats, stated honestly: the gather issues one small
+# (1, D) DMA per (row, field) pair — correct everywhere (interpret mode
+# included; CPU tests run it), but on real hardware its win depends on the
+# DMA engine hiding the latency, which is exactly why ops/autotune.py
+# enables this kernel per bucket ONLY where it measures faster than the
+# XLA path on the live device (a kernel that fails to lower or loses is
+# recorded and left disabled — the BENCH_r05 lesson, now enforced by
+# machinery instead of a docstring).
+
+_SERVE_ROW_TILE = 128
+
+
+def serve_fits_vmem(
+    d: int,
+    num_layers: int,
+    mlp_dims: tuple[int, ...],
+    compute_dtype=jnp.bfloat16,
+    row_tile: int = _SERVE_ROW_TILE,
+    quantized: bool = False,
+) -> bool:
+    """Whether the fused serving kernel's VMEM-resident set fits: all cross
+    + MLP + head weights (int8 when quantized) plus the per-tile activation
+    scratch. The embedding table stays in HBM and never counts."""
+    dp = _pad_to(d, LANE)
+    itemsize = 1 if quantized else jnp.dtype(compute_dtype).itemsize
+    weights = num_layers * dp * dp * itemsize + num_layers * dp * 8
+    d_in = dp
+    for m in mlp_dims:
+        mp = _pad_to(m, LANE)
+        weights += d_in * mp * itemsize + mp * 8
+        d_in = mp
+    weights += (dp + d_in) * LANE * 4  # output head (f32 col block)
+    # x0 f32 + compute-dtype copy + cross/mlp f32 temps + two out tiles.
+    tiles = row_tile * dp * 16 + row_tile * LANE * 8
+    return weights + tiles <= VMEM_BUDGET_BYTES
+
+
+def serve_params_supported(params) -> bool:
+    """True when a servable's param tree has the dcn_v2 shape the fused
+    serving kernel understands: an embedding table, a full-matrix cross
+    stack, an MLP list, and a 1-wide output head — in either the float
+    {"w"} or the ops/quantize.py {"qw"} form."""
+    try:
+        emb = params["embedding"]
+        cross, mlp, out = params["cross"], params["mlp"], params["out"]
+    except (KeyError, TypeError):
+        return False
+
+    def dense_ok(p, out_dim=None):
+        w = p.get("qw", p.get("w"))
+        if w is None or w.ndim != 2:
+            return False
+        return out_dim is None or w.shape[1] == out_dim
+
+    if getattr(emb, "ndim", 0) != 2 or not cross or not mlp:
+        return False
+    return (
+        all(dense_ok(p) for p in cross)
+        and all(dense_ok(p) for p in mlp)
+        and dense_ok(out, out_dim=1)
+    )
+
+
+def _pad2(arr, rows: int, cols: int, dtype) -> jnp.ndarray:
+    out = jnp.zeros((rows, cols), dtype)
+    a = jnp.asarray(arr)
+    return out.at[: a.shape[0], : a.shape[1]].set(a.astype(dtype))
+
+
+def _pad1(arr, cols: int, dtype=jnp.float32) -> jnp.ndarray:
+    a = jnp.asarray(arr)
+    return jnp.zeros((cols,), dtype).at[: a.shape[0]].set(a.astype(dtype))
+
+
+def _prep_dense(p: dict, rows: int, cols: int, cd):
+    """(w_padded, scale_padded_or_None, b_padded) for one dense layer in
+    either param form. int8 weights stay int8 (the operand win); scales
+    pad with ONES so padded output channels stay exactly zero after the
+    zero-padded weights."""
+    if "qw" in p:
+        w = _pad2(p["qw"], rows, cols, jnp.int8)
+        s = jnp.ones((cols,), jnp.float32).at[: p["qscale"].shape[0]].set(
+            jnp.asarray(p["qscale"], jnp.float32)
+        )
+    else:
+        w = _pad2(p["w"], rows, cols, cd)
+        s = None
+    return w, s, _pad1(p["b"], cols)
+
+
+def build_fused_serve(params, config, *, interpret: bool = False,
+                      row_tile: int = _SERVE_ROW_TILE):
+    """Build the fused-serving callable for ONE servable's params
+    (float or ops/quantize.py-quantized tree).
+
+    Returns apply_fn(params, batch) -> {"prediction_node", "logits"} with
+    the model.apply contract the batcher's jitted entries expect. The
+    weight operands are prepared (stacked/padded/cast) HERE, once, and
+    closed over — they enter the jaxpr as constants, so per-call tracing
+    never re-pads the parameter set; the `params` argument is accepted for
+    signature compatibility and deliberately unused (ops/autotune.py
+    rebuilds this callable when a servable's params object is swapped).
+    `batch` must carry host-folded int32 feat_ids and feat_wts."""
+    cfg = config
+    cd = cfg.cdtype
+    F, D = cfg.num_fields, cfg.embed_dim
+    d = F * D
+    dp = _pad_to(d, LANE)
+    L = len(params["cross"])
+    mlp_dims = tuple(
+        (p.get("qw", p.get("w"))).shape[1] for p in params["mlp"]
+    )
+    quantized = "qw" in params["cross"][0]
+    if not serve_params_supported(params):
+        raise ValueError("fused serving kernel requires a dcn_v2 param tree")
+    if not serve_fits_vmem(d, L, mlp_dims, cd, row_tile, quantized):
+        raise ValueError(
+            f"fused serving kernel (d={d}, L={L}, mlp={mlp_dims}) exceeds "
+            f"the {VMEM_BUDGET_BYTES >> 20} MB VMEM budget"
+        )
+
+    table = jnp.asarray(params["embedding"], jnp.float32)  # HBM-resident
+    # Cross stack: [L, dp, dp] (+ [L, dp] scales when quantized) + biases.
+    if quantized:
+        wc = jnp.stack([_pad2(p["qw"], dp, dp, jnp.int8) for p in params["cross"]])
+        sc = jnp.stack([
+            jnp.ones((dp,), jnp.float32).at[: p["qscale"].shape[0]].set(
+                jnp.asarray(p["qscale"], jnp.float32))
+            for p in params["cross"]
+        ])
+    else:
+        wc = jnp.stack([_pad2(p["w"], dp, dp, cd) for p in params["cross"]])
+        sc = None
+    bc = jnp.stack([_pad1(p["b"], dp) for p in params["cross"]])
+    # MLP stack: per-layer padded operands (dims differ per layer).
+    mlp_ops = []
+    d_in = dp
+    for p, m in zip(params["mlp"], mlp_dims):
+        mp = _pad_to(m, LANE)
+        mlp_ops.append(_prep_dense(p, d_in, mp, cd))
+        d_in = mp
+    mp_last = d_in
+    # Output head: [dp + mp_last, LANE] f32 column block, col 0 real. The
+    # head is one [*, 1] matvec — f32 operands cost nothing material and
+    # skip a quantization step whose win would be ~512 bytes.
+    out_p = params["out"]
+    w_out = out_p.get("qw")
+    if w_out is not None:
+        w_full = np.asarray(w_out, np.float32) * np.asarray(
+            out_p["qscale"], np.float32
+        )[None, :]
+    else:
+        w_full = np.asarray(out_p["w"], np.float32)
+    wo = jnp.zeros((dp + mp_last, LANE), jnp.float32)
+    wo = wo.at[:d, 0].set(jnp.asarray(w_full[:d, 0]))
+    wo = wo.at[dp: dp + mlp_dims[-1], 0].set(jnp.asarray(w_full[d:, 0]))
+    bo = jnp.zeros((1, LANE), jnp.float32).at[0, 0].set(
+        jnp.asarray(out_p["b"], jnp.float32)[0]
+    )
+
+    def kernel(ids_ref, *refs):
+        # Positional layout mirrors in_specs + out_specs + scratch_shapes:
+        # wts, cross (w[, s], b), per-mlp-layer (w[, s], b), head (w, b),
+        # table, then the two out tiles and the three scratch operands.
+        it = iter(refs)
+        wts_ref = next(it)
+        wc_ref = next(it)
+        sc_ref = next(it) if quantized else None
+        bc_ref = next(it)
+        mlp_refs = []
+        for _, s, _ in mlp_ops:
+            wr = next(it)
+            sr = next(it) if s is not None else None
+            br = next(it)
+            mlp_refs.append((wr, sr, br))
+        wo_ref, bo_ref = next(it), next(it)
+        table_ref = next(it)
+        pred_ref, logit_ref = next(it), next(it)
+        x0_s, emb_s, sem = next(it), next(it), next(it)
+        i = pl.program_id(0)
+        bn = x0_s.shape[0]
+
+        # ---- embedding gather: one (1, D) DMA per (row, field) from the
+        # HBM table, weighted into the VMEM-resident x0 tile. Fields are a
+        # static Python loop (F is small and the f*D slice start must be
+        # static); rows ride fori_loop. The scalar-prefetched ids (SMEM)
+        # are exactly what computes the DMA source index.
+        def gather_row(r, carry):
+            wrow = wts_ref[pl.ds(r, 1), :]  # (1, F_pad) f32
+            for f in range(F):
+                idx = ids_ref[i * bn + r, f]
+                copy = pltpu.make_async_copy(
+                    table_ref.at[pl.ds(idx, 1), :], emb_s, sem
+                )
+                copy.start()
+                copy.wait()
+                x0_s[pl.ds(r, 1), pl.ds(f * D, D)] = (
+                    emb_s[:, :] * wrow[0, f]
+                )
+            return carry
+
+        # Scratch arrives uninitialized: the padded lane tail [d, dp) must
+        # be EXACTLY zero (garbage there rides NaN*0=NaN through the
+        # zero-padded weights), and only [0, d) is written by the gather.
+        x0_s[:, :] = jnp.zeros_like(x0_s)
+        jax.lax.fori_loop(0, bn, gather_row, 0)
+
+        x0_f32 = x0_s[:, :]
+        x0 = x0_f32.astype(cd)
+
+        # ---- cross stack (the existing _cross_kernel math, quantized-
+        # aware: per-channel scale folds into the f32 xw).
+        def cross_layer(l, x):
+            xw = jax.lax.dot_general(
+                x, wc_ref[l].astype(cd), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if sc_ref is not None:
+                xw = xw * sc_ref[pl.ds(l, 1), :]
+            nxt = x0_f32 * (xw + bc_ref[pl.ds(l, 1), :]) + x.astype(jnp.float32)
+            return nxt.astype(cd)
+
+        xc = jax.lax.fori_loop(0, L, cross_layer, x0)
+
+        # ---- MLP stack over x0 (models/base.py mlp_apply, final relu).
+        h = x0
+        for wr, sr, br in mlp_refs:
+            y = jax.lax.dot_general(
+                h, wr[:, :].astype(cd), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if sr is not None:
+                y = y * sr[:].reshape(1, -1)
+            y = y + br[:].reshape(1, -1)
+            h = jax.nn.relu(y).astype(cd)
+
+        # ---- output head: logit = [xc | xd] @ w_out + b (col 0 real).
+        lo = (
+            jax.lax.dot_general(
+                xc.astype(jnp.float32), wo_ref[:dp, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + jax.lax.dot_general(
+                h.astype(jnp.float32), wo_ref[dp:, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + bo_ref[:, :]
+        )
+        logit_ref[:, :] = lo
+        pred_ref[:, :] = jax.nn.sigmoid(lo)
+
+    def apply_fn(_params, batch):
+        ids = batch["feat_ids"].astype(jnp.int32)
+        wts = batch["feat_wts"].astype(jnp.float32)
+        n = ids.shape[0]
+        bn = min(row_tile, _pad_to(n, 8))
+        np_ = _pad_to(n, bn)
+        f_pad = _pad_to(F, LANE)
+        ids_p = jnp.zeros((np_, F), jnp.int32).at[:n, :].set(ids)
+        wts_p = jnp.zeros((np_, f_pad), jnp.float32).at[:n, :F].set(wts)
+
+        weight_args = [wc] + ([sc] if quantized else []) + [bc]
+        in_specs = [
+            pl.BlockSpec((bn, f_pad), lambda i, *_: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, dp, dp), lambda i, *_: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        if quantized:
+            in_specs.append(pl.BlockSpec((L, dp), lambda i, *_: (0, 0),
+                                         memory_space=pltpu.VMEM))
+        in_specs.append(pl.BlockSpec((L, dp), lambda i, *_: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        for (w, s, b) in mlp_ops:
+            weight_args.append(w)
+            in_specs.append(pl.BlockSpec(w.shape, lambda i, *_: (0, 0),
+                                         memory_space=pltpu.VMEM))
+            if s is not None:
+                weight_args.append(s)
+                in_specs.append(pl.BlockSpec(s.shape, lambda i, *_: (0,),
+                                             memory_space=pltpu.VMEM))
+            weight_args.append(b)
+            in_specs.append(pl.BlockSpec(b.shape, lambda i, *_: (0,),
+                                         memory_space=pltpu.VMEM))
+        weight_args += [wo, bo]
+        in_specs += [
+            pl.BlockSpec(wo.shape, lambda i, *_: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(bo.shape, lambda i, *_: (0, 0), memory_space=pltpu.VMEM),
+        ]
+        # The table: whole-array, compiler-placed (HBM) — gathered by DMA.
+        weight_args.append(table)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(np_ // bn,),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((bn, LANE), lambda i, *_: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((bn, LANE), lambda i, *_: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bn, dp), jnp.float32),
+                pltpu.VMEM((1, D), jnp.float32),
+                pltpu.SemaphoreType.DMA,
+            ],
+        )
+        pred, logit = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((np_, LANE), jnp.float32),
+                jax.ShapeDtypeStruct((np_, LANE), jnp.float32),
+            ],
+            interpret=interpret,
+        )(ids_p, wts_p, *weight_args)
+        return {
+            "prediction_node": pred[:n, 0],
+            "logits": logit[:n, 0],
+        }
+
+    return apply_fn

@@ -67,6 +67,7 @@ from ..ops.transfer import (
     combined_layout,
     combined_supported,
     compact_outputs_device,
+    is_wire_sidecar,
     output_wire_dtype as _wire_dtype_of,
     pack_host,
     pack_host_combined,
@@ -648,6 +649,13 @@ class DynamicBatcher:
         # never reach the completer, so only freshly computed scores are
         # sketched. None (default) costs one attribute read per batch.
         self.quality = quality
+        # Kernel plane (ops/autotune.py, ISSUE 12): a KernelManager whose
+        # per-bucket decision table routes device execution to the int8
+        # weight-quantized params and/or the fused Pallas serving kernel —
+        # ONLY where the autotune harness measured a win and the accuracy
+        # gates passed. None (default) costs one attribute read per
+        # dispatch and behavior is bit-identical to the pre-plane stack.
+        self.kernels = None
         # Utilization plane (serving/utilization.py): an OccupancyLedger
         # fed one interval per completed batch from the existing
         # dispatch/readback sites, plus cheap wait-interval records while
@@ -1549,43 +1557,51 @@ class DynamicBatcher:
             # before.
             def fn(
                 params, buf, layout, out_keys=None, donate=False,
-                topk=0, n_valid=None, _cache=variants,
+                topk=0, n_valid=None, k_apply=None, _cache=variants,
             ):
-                key = (layout, out_keys, donate, topk)
+                # k_apply (kernel plane, ISSUE 12): an alternate apply
+                # callable — the fused Pallas serving kernel — swapped in
+                # per the per-bucket autotune decision. Its identity joins
+                # the variant key so the Pallas and XLA executables
+                # coexist; quantized params need no key (jax.jit retraces
+                # on the distinct param-tree structure).
+                key = (layout, out_keys, donate, topk, k_apply)
                 jfn = _cache.get(key)
                 if jfn is None:
                     donargs = (1,) if donate else ()
+                    ap = k_apply or apply
                     if topk:
-                        def run(p, b, nv, _l=layout, _k=topk):
-                            out = apply(p, unpack_device_combined(b, _l))
+                        def run(p, b, nv, _l=layout, _k=topk, _ap=ap):
+                            out = _ap(p, unpack_device_combined(b, _l))
                             finish(out, None)  # records the baseline
                             return topk_compact_device(out[score_key], nv, _k, wire)
                     else:
-                        def run(p, b, _l=layout, _ok=out_keys):
-                            return finish(apply(p, unpack_device_combined(b, _l)), _ok)
+                        def run(p, b, _l=layout, _ok=out_keys, _ap=ap):
+                            return finish(_ap(p, unpack_device_combined(b, _l)), _ok)
                     jfn = _cache[key] = jax.jit(run, donate_argnums=donargs)
                 return jfn(params, buf, n_valid) if topk else jfn(params, buf)
         else:
             def fn(
                 params, packed, out_keys=None, donate=False,
-                topk=0, n_valid=None, _cache=variants,
+                topk=0, n_valid=None, k_apply=None, _cache=variants,
             ):
-                key = (out_keys, topk)
+                key = (out_keys, topk, k_apply)
                 jfn = _cache.get(key)
                 if jfn is None:
+                    ap = k_apply or apply
                     if topk:
-                        def run(p, b, nv, _k=topk):
+                        def run(p, b, nv, _k=topk, _ap=ap):
                             batch = unpack_device(b, spec) if spec else b
-                            out = apply(p, batch)
+                            out = _ap(p, batch)
                             finish(out, None)
                             return topk_compact_device(out[score_key], nv, _k, wire)
                     else:
-                        def run(p, b, _ok=out_keys):
+                        def run(p, b, _ok=out_keys, _ap=ap):
                             # Transfer decompression is traced into the
                             # executable, so it fuses with the embedding
                             # lookup's index arithmetic.
                             batch = unpack_device(b, spec) if spec else b
-                            return finish(apply(p, batch), _ok)
+                            return finish(_ap(p, batch), _ok)
                     jfn = _cache[key] = jax.jit(run)
                 return jfn(params, packed, n_valid) if topk else jfn(params, packed)
 
@@ -1717,11 +1733,34 @@ class DynamicBatcher:
         # different jax aval (weak type) and would force a fresh trace on
         # the first live fused top-k batch despite warmup's precompile.
         n_valid = None if not topk else np.int32(n_valid)
+        # Kernel plane: the fused native assembler and the kernel variants
+        # compose — the packed buffer is variant-independent input bytes.
+        k_params, k_apply = self._kernel_variant(servable, bucket)
         with request_trace.span("batch.jitcall"):
             return fn(
-                servable.params, buf, layout,
+                k_params, buf, layout,
                 out_keys=out_keys, donate=donate, topk=topk, n_valid=n_valid,
+                k_apply=k_apply,
             )
+
+    def _kernel_variant(self, servable: Servable, rows: int, override=None):
+        """(params, k_apply) per the kernel plane's per-bucket decision —
+        the int8-quantized param tree and/or the fused Pallas serving
+        apply — or (servable.params, None) for the baseline. `override`
+        is the autotune harness's (quantized, pallas) pin, so measurement
+        runs through the EXACT entry (and jit cache) live traffic uses."""
+        kern = self.kernels
+        if kern is None or self._run_fn is not None:
+            return servable.params, None
+        dec = override if override is not None else kern.decision(servable, rows)
+        if not dec or dec == (False, False):
+            return servable.params, None
+        quantized, pallas = dec
+        params = (
+            kern.params_for(servable, True) if quantized else servable.params
+        )
+        k_apply = kern.pallas_apply_for(servable, quantized) if pallas else None
+        return params, k_apply
 
     def _execute(
         self,
@@ -1731,12 +1770,15 @@ class DynamicBatcher:
         topk: int = 0,
         n_valid: int | None = None,
         _force_donate: bool = False,
+        _kernel_override=None,
     ):
         """Device stage for one padded batch: fold, content cache, pack,
         upload, jit call. out_keys/topk/n_valid ride through to the jitted
         entry (output selection and top-k compaction are traced into the
         executable); _force_donate is the warmup hook that precompiles the
-        donating variant without going through cache-bypass traffic."""
+        donating variant without going through cache-bypass traffic;
+        _kernel_override pins the kernel plane's (quantized, pallas)
+        variant for the autotune harness."""
         ids = arrays.get("feat_ids")
         if ids is not None and ids.dtype == np.int64 and servable.model.folds_ids_on_host:
             # Deferred per-request fold (prepare_inputs fold_ids=False):
@@ -1747,6 +1789,9 @@ class DynamicBatcher:
             arrays["feat_ids"] = fold_ids_host(ids, servable.model.config.vocab_size)
         if self._run_fn is not None:
             return self._run_fn(servable, arrays)
+        k_params, k_apply = self._kernel_variant(
+            servable, next(iter(arrays.values())).shape[0], _kernel_override
+        )
         fn, spec, combined = self._jit_for(servable)
         if combined and not combined_supported(arrays):
             # Rare servable whose inputs cannot ride a byte buffer (string/
@@ -1785,9 +1830,9 @@ class DynamicBatcher:
                     donate = _force_donate or self._donation_ok()
                 with request_trace.span("batch.jitcall"):
                     return fn(
-                        servable.params, buf, layout,
+                        k_params, buf, layout,
                         out_keys=out_keys, donate=donate,
-                        topk=topk, n_valid=n_valid,
+                        topk=topk, n_valid=n_valid, k_apply=k_apply,
                     )
             if self.input_cache is not None and not _force_donate:
                 # Digest BEFORE packing: a content hit skips both the upload
@@ -1803,14 +1848,16 @@ class DynamicBatcher:
                     }
                 with request_trace.span("batch.jitcall"):
                     return fn(
-                        servable.params, inputs,
+                        k_params, inputs,
                         out_keys=out_keys, topk=topk, n_valid=n_valid,
+                        k_apply=k_apply,
                     )
             packed = pack_host(arrays, spec) if spec else arrays
             with request_trace.span("batch.jitcall"):
                 return fn(
-                    servable.params, packed,
+                    k_params, packed,
                     out_keys=out_keys, topk=topk, n_valid=n_valid,
+                    k_apply=k_apply,
                 )
 
     def _shed_expired_locked(self, it: _WorkItem) -> bool:
@@ -2324,7 +2371,10 @@ class DynamicBatcher:
             else:
                 fetch = {
                     k: v for k, v in outputs.items()
-                    if wanted is None or k in wanted
+                    # int8-wire scale/min sidecars always ride the fetch:
+                    # a filtered request's quantized score is undecodable
+                    # without them (restore_outputs_host strips them).
+                    if wanted is None or k in wanted or is_wire_sidecar(k)
                 }
             # What a full-fp32 all-outputs readback of this batch would
             # have moved: the baseline the compaction win is charged

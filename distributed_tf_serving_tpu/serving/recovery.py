@@ -167,6 +167,13 @@ class RecoveryController:
         self._events: deque[dict] = deque(
             maxlen=max(int(getattr(config, "history_events", 64)), 8)
         )
+        # Per-cycle MTTR history ring (ISSUE 12 satellite): one record
+        # per completed cycle — the longitudinal evidence /recoveryz
+        # serves next to the instantaneous last_cycle ("is recovery
+        # getting slower as this replica degrades?").
+        self._mttr_ring: deque[dict] = deque(
+            maxlen=max(int(getattr(config, "history_events", 64)), 8)
+        )
         self._stop_evt = threading.Event()
         self._wake = threading.Event()
         self._worker: threading.Thread | None = None
@@ -482,6 +489,13 @@ class RecoveryController:
                     "poisoned": self.poisoned_requests - poisoned_before,
                     "gave_up_items": failed_this_cycle,
                 }
+                self._mttr_ring.append({
+                    "t": round(t0, 3),
+                    "trigger": trig,
+                    "mttr_s": round(duration, 4),
+                    "rounds": rounds,
+                    "replayed_items": replayed_this_cycle,
+                })
             self._enter(SERVING, trigger=trig,
                         duration_s=round(duration, 4))
             return True
@@ -659,6 +673,18 @@ class RecoveryController:
 
     # ------------------------------------------------------------- surfaces
 
+    def _mttr_block_locked(self) -> dict:
+        """Per-cycle MTTR history (ring) + summary stats. Lock held."""
+        hist = list(self._mttr_ring)
+        vals = [h["mttr_s"] for h in hist]
+        return {
+            "cycles": len(hist),
+            "last_s": vals[-1] if vals else None,
+            "mean_s": round(sum(vals) / len(vals), 4) if vals else None,
+            "max_s": max(vals) if vals else None,
+            "history": hist,
+        }
+
     def snapshot(self) -> dict:
         """The /recoveryz body, the `recovery` /monitoring block, and the
         dts_tpu_recovery_* Prometheus source."""
@@ -684,6 +710,7 @@ class RecoveryController:
                     "thread_deaths": self.thread_deaths,
                 },
                 "last_cycle": self._last_cycle,
+                "mttr": self._mttr_block_locked(),
                 "events": list(self._events),
                 "config": {
                     "watchdog_interval_s": cfg.watchdog_interval_s,

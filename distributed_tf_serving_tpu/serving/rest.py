@@ -560,6 +560,7 @@ class RestGateway:
                 lifecycle=self.impl.lifecycle_stats(),
                 pipeline=self.impl.pipeline_stats(),
                 recovery=self.impl.recovery_stats(),
+                kernels=self.impl.kernels_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -591,6 +592,7 @@ class RestGateway:
             "quality": self.impl.quality_stats,
             "lifecycle": self.impl.lifecycle_stats,
             "recovery": self.impl.recovery_stats,
+            "kernels": self.impl.kernels_stats,
             "versions": self.impl.versions_stats,
             "pipeline": self.impl.pipeline_stats,
             "request_log": request_log,
@@ -621,7 +623,8 @@ class RestGateway:
         # Armed-plane blocks only: a disabled plane is absent, so
         # dashboards can distinguish "off" from "cold".
         for name in ("cache", "overload", "utilization", "quality",
-                     "lifecycle", "recovery", "versions", "pipeline"):
+                     "lifecycle", "recovery", "kernels", "versions",
+                     "pipeline"):
             block = builders[name]()
             if block is not None:
                 snap[name] = block

@@ -44,6 +44,7 @@ from ..utils import tracing
 from ..utils.tracing import request_trace
 from . import lifecycle as lifecycle_mod
 from . import overload as overload_mod
+from ..ops import autotune as kernels_mod
 from .batcher import DynamicBatcher
 from .service import PredictionServiceImpl, ServiceError
 
@@ -88,6 +89,22 @@ def _criticality_of(context) -> str | None:
     except Exception:  # noqa: BLE001 — a metadata quirk must not fail the RPC
         return None
     return None
+
+
+def _score_wire_of(context) -> bool:
+    """True when the request opted into the int8 score response wire
+    (x-dts-score-wire: int8) AND a kernels plane armed it — one module
+    bool read per RPC otherwise (the overload/lifecycle active()
+    precedent)."""
+    if not kernels_mod.wire_active():
+        return False
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == kernels_mod.SCORE_WIRE_KEY:
+                return str(value).strip().lower() == "int8"
+    except Exception:  # noqa: BLE001 — a metadata quirk must not fail the RPC
+        return False
+    return False
 
 
 def _stream_chunk_of(context) -> int | None:
@@ -239,10 +256,12 @@ class GrpcPredictionService(_SyncServicerBase):
     def Predict(self, request, context):
         deadline_s = _deadline_of(context)
         crit = _criticality_of(context)
+        int8_wire = _score_wire_of(context)
         return self._call(
             "Predict",
             lambda req: self.impl.predict(
-                req, deadline_s=deadline_s, criticality=crit
+                req, deadline_s=deadline_s, criticality=crit,
+                int8_wire=int8_wire,
             ),
             request, context,
         )
@@ -546,10 +565,12 @@ class AioGrpcPredictionService(_AioServicerBase):
     async def Predict(self, request, context):
         deadline_s = _deadline_of(context)
         crit = _criticality_of(context)
+        int8_wire = _score_wire_of(context)
         return await self._call(
             "Predict",
             lambda req: self.impl.predict_async(
-                req, deadline_s=deadline_s, criticality=crit
+                req, deadline_s=deadline_s, criticality=crit,
+                int8_wire=int8_wire,
             ),
             request, context,
         )
@@ -812,8 +833,11 @@ def _servable_change_hook(score_cache, quality):
     """ONE on_servable_change callable for the version watchers, fanning
     out to every armed plane that cares about registry mutations: the
     cache plane's generation invalidation (by model name) and the quality
-    plane's version-change accounting. None when nothing is armed, so the
-    watcher keeps its no-hook fast path."""
+    plane's version-change accounting. The kernel plane needs no hook:
+    its decision() is identity-guarded per tuned Servable (a hot-loaded
+    or reloaded version can never inherit another generation's
+    enablement, while the stable version keeps its measured win). None
+    when nothing is armed, so the watcher keeps its no-hook fast path."""
     hooks = []
     if score_cache is not None:
         hooks.append(score_cache.invalidate_model)
@@ -1160,6 +1184,7 @@ def build_stack(
     batching_config=None,
     transport_config=None,
     recovery_config=None,
+    kernels_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -1277,6 +1302,30 @@ def build_stack(
             quality_config.drift_threshold_psi,
             quality_config.reference_file or "<none>",
         )
+    from ..utils.config import KernelsConfig as _KernelsConfig
+
+    # build() with a disabled (or absent) section DISARMS the module-level
+    # int8 score-wire gate — a stack built without the plane must never
+    # inherit a previous stack's armed wire in the same process.
+    kernel_manager = (kernels_config or _KernelsConfig()).build()
+    if kernel_manager is not None:
+        if cfg.mesh_devices:
+            raise ValueError(
+                "[kernels] enabled requires the single-chip batcher path: "
+                "the ShardedExecutor mirrors the int8 output wire but owns "
+                "its own executables (per-bucket kernel routing over a "
+                "mesh is future work)"
+            )
+        log.info(
+            "kernel plane on: quantize=%s pallas=%s autotune=%s "
+            "measure_only=%s gates(speedup>=%.2f |dScore|<=%.4f "
+            "|dAUC|<=%.4f) int8_score_wire=%s table=%s",
+            kernels_config.quantize, kernels_config.pallas,
+            kernels_config.autotune, kernels_config.measure_only,
+            kernels_config.min_speedup, kernels_config.max_abs_delta,
+            kernels_config.auc_margin, kernels_config.int8_score_wire,
+            kernels_config.table_file or "<none>",
+        )
     overload_ctrl = (
         overload_config.build() if overload_config is not None else None
     )
@@ -1335,6 +1384,26 @@ def build_stack(
         quality=quality_monitor,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
+    if kernel_manager is not None:
+        # Attach the kernel plane: the batcher consults the per-bucket
+        # decision table at dispatch; /monitoring + Prometheus read
+        # impl.kernels. Decisions stay empty (= baseline) until the
+        # autotune below (or a persisted-table adoption) fills them.
+        batcher.kernels = kernel_manager
+        impl.kernels = kernel_manager
+
+    def _prepare_kernels(sv) -> None:
+        # Autotune at load time — the compile-storms-belong-at-warmup
+        # rule applies to variant measurement too. A persisted table for
+        # this exact (model, version, device, gates) is adopted without
+        # re-measuring; measure_only records without enabling.
+        if kernel_manager is None or sv is None:
+            return
+        try:
+            kernel_manager.prepare(batcher, sv)
+        except Exception:  # noqa: BLE001 — a failed tune means baseline
+            log.exception("kernel autotune failed; serving the baseline")
+
     if batching_config is not None:
         # Streamed sub-batch default ([batching] stream_chunk_candidates;
         # a request's x-dts-stream-chunk metadata overrides per call).
@@ -1460,6 +1529,7 @@ def build_stack(
         else:
             servable = registry.resolve(cfg.model_name)
             log.info("serving %s versions %s from %s", cfg.model_name, versions, model_base_path)
+        _prepare_kernels(servable)
         impl.warmup_complete = True
         return registry, batcher, impl, servable, mesh, watcher
     if savedmodel:
@@ -1503,6 +1573,7 @@ def build_stack(
     for label, version in cfg.version_labels:
         registry.set_label(cfg.model_name, label, version)
         log.info("label %r -> %s v%d", label, cfg.model_name, version)
+    _prepare_kernels(servable)
     impl.warmup_complete = True
     return registry, batcher, impl, servable, mesh, None
 
@@ -1618,6 +1689,18 @@ def serve(argv=None) -> None:
         "dts_tpu_recovery_* Prometheus series)",
     )
     parser.add_argument(
+        "--kernels", action="store_true", default=None,
+        help="kernel/quantization plane (ops/quantize.py + ops/autotune.py"
+        " + the fused Pallas serving kernel): post-training int8 weight "
+        "quantization and the fused gather+cross+MLP kernel, each enabled "
+        "PER BUCKET only where the warmup autotune harness measured a "
+        "speedup > 1 on this device AND the accuracy gates passed "
+        "(max |dScore| bound; AUC margin when a labeled eval is supplied)."
+        " Equivalent to [kernels] enabled=true; the [kernels] section "
+        "carries the gate/table knobs (`kernels` block in /monitoring, "
+        "dts_tpu_kernel_* Prometheus series)",
+    )
+    parser.add_argument(
         "--uds-path", dest="uds_path",
         help="also serve gRPC on this Unix-domain socket path (co-located "
         "fan-out clients dial unix:<path>, skipping the TCP/loopback "
@@ -1679,6 +1762,7 @@ def serve(argv=None) -> None:
     from ..utils.config import (
         BatchingConfig,
         CacheConfig,
+        KernelsConfig,
         LifecycleConfig,
         ObservabilityConfig,
         OverloadConfig,
@@ -1723,6 +1807,9 @@ def serve(argv=None) -> None:
     recovery_config = cfgs.get("recovery") or RecoveryConfig()
     if args.recovery:
         recovery_config = dataclasses.replace(recovery_config, enabled=True)
+    kernels_config = cfgs.get("kernels") or KernelsConfig()
+    if args.kernels:
+        kernels_config = dataclasses.replace(kernels_config, enabled=True)
     if lifecycle_config.enabled and not quality_config.enabled:
         # --lifecycle implies the quality plane it reads: arming the
         # actuator without its signal would fail build_stack's check, and
@@ -1788,6 +1875,7 @@ def serve(argv=None) -> None:
         batching_config=batching_config,
         transport_config=transport_config,
         recovery_config=recovery_config,
+        kernels_config=kernels_config,
     )
     if impl.lifecycle is not None:
         # The CLI server drives the controller with its background thread

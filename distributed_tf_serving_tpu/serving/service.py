@@ -114,6 +114,15 @@ class PredictionServiceImpl:
         # GET /recoveryz serves its snapshot. None (default) costs one
         # attribute read where consulted.
         self.recovery = None
+        # Kernel/quantization plane (ops/autotune.py, ISSUE 12): when a
+        # KernelManager is set (build_stack attaches the same object to
+        # the batcher), /monitoring's `kernels` block and the
+        # dts_tpu_kernel_* Prometheus series read it, and — with its
+        # int8_score_wire knob on — Predict responses for clients that
+        # sent x-dts-score-wire: int8 carry the score tensor as DT_INT8
+        # plus (scale, min) sidecar outputs. None (default) costs one
+        # attribute read where consulted.
+        self.kernels = None
         # Streamed sub-batch results (ISSUE 9): default server-side split
         # size (candidates per sub-batch) for PredictStream. 0 = no split
         # (one chunk per request — streaming stays wire-available but the
@@ -249,6 +258,15 @@ class PredictionServiceImpl:
         armed ([recovery] enabled=false)."""
         rec = self.recovery
         return rec.snapshot() if rec is not None else None
+
+    def kernels_stats(self) -> dict | None:
+        """Kernel-plane snapshot (per-bucket decision table, measured
+        speedups + accuracy-gate outcomes, quantized/pallas batch
+        counters) — the `kernels` block in /monitoring and the
+        dts_tpu_kernel_* Prometheus series. None when no manager is
+        armed ([kernels] enabled=false)."""
+        kern = self.kernels
+        return kern.snapshot() if kern is not None else None
 
     def versions_stats(self) -> dict | None:
         """Version-watcher snapshot (loaded versions, last reconcile
@@ -640,7 +658,7 @@ class PredictionServiceImpl:
 
     def predict(
         self, request: apis.PredictRequest, deadline_s: float | None = None,
-        criticality: str | None = None,
+        criticality: str | None = None, int8_wire: bool = False,
     ) -> apis.PredictResponse:
         self._refuse_if_draining()
         deadline_t = self._clock_deadline(deadline_s)
@@ -653,7 +671,9 @@ class PredictionServiceImpl:
                 deadline_s=self._budget_left(deadline_t),
                 criticality=criticality,
             )
-        resp = self._predict_finish(request, servable, out_names, outputs)
+        resp = self._predict_finish(
+            request, servable, out_names, outputs, int8_wire=int8_wire
+        )
         # Log only SUCCEEDED requests: the file's contract is direct
         # usability as a warmup file, and one malformed client request
         # must never poison a future version rollout (review finding).
@@ -662,7 +682,7 @@ class PredictionServiceImpl:
 
     async def predict_async(
         self, request: apis.PredictRequest, deadline_s: float | None = None,
-        criticality: str | None = None,
+        criticality: str | None = None, int8_wire: bool = False,
     ) -> apis.PredictResponse:
         """Predict for coroutine servers: identical semantics, awaits the
         batch instead of blocking a handler thread on it."""
@@ -677,7 +697,9 @@ class PredictionServiceImpl:
                 deadline_s=self._budget_left(deadline_t),
                 criticality=criticality,
             )
-        resp = self._predict_finish(request, servable, out_names, outputs)
+        resp = self._predict_finish(
+            request, servable, out_names, outputs, int8_wire=int8_wire
+        )
         self._log_request("predict", request)
         return resp
 
@@ -751,7 +773,8 @@ class PredictionServiceImpl:
             )
 
     def _predict_finish(
-        self, request: apis.PredictRequest, servable: Servable, out_names, outputs
+        self, request: apis.PredictRequest, servable: Servable, out_names,
+        outputs, int8_wire: bool = False,
     ) -> apis.PredictResponse:
         self._check_produced(out_names, outputs)
         with request_trace.span("predict.encode"):
@@ -759,9 +782,38 @@ class PredictionServiceImpl:
             resp.model_spec.CopyFrom(
                 self._echo_spec(servable, request.model_spec.signature_name or "serving_default")
             )
+            mirror = self._mirror_content(request)
+            names = out_names
+            score_key = servable.model.score_output
+            if (
+                int8_wire
+                and score_key in out_names
+                and getattr(outputs.get(score_key), "dtype", None)
+                == np.float32
+            ):
+                # int8 score response wire (ISSUE 12): the opted-in
+                # client receives the score tensor as DT_INT8 plus the
+                # (scale, min) sidecar outputs codec.dequantize_response_
+                # output inverts — 4x fewer response bytes per score.
+                # Non-f32 score outputs (imported-graph dtypes) fall
+                # through to the normal encode: the wire must never
+                # guess a quantization for a dtype it does not own.
+                names = [n for n in out_names if n != score_key]
+                q, scale, mn = codec.quantize_scores(outputs[score_key])
+                codec.from_ndarray(
+                    q, dtype_enum=fw.DataType.DT_INT8,
+                    use_tensor_content=mirror, out=resp.outputs[score_key],
+                )
+                codec.from_ndarray(
+                    np.asarray([scale], np.float32), use_tensor_content=mirror,
+                    out=resp.outputs[score_key + codec.Q8_WIRE_SCALE_SUFFIX],
+                )
+                codec.from_ndarray(
+                    np.asarray([mn], np.float32), use_tensor_content=mirror,
+                    out=resp.outputs[score_key + codec.Q8_WIRE_MIN_SUFFIX],
+                )
             self._encode_outputs(
-                request, servable, out_names, outputs, resp.outputs,
-                self._mirror_content(request),
+                request, servable, names, outputs, resp.outputs, mirror,
             )
         return resp
 

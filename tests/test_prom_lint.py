@@ -28,7 +28,9 @@ def _fully_armed_text() -> str:
     """Every plane emitting at once — the worst-case assembly the lint
     exists to guard: batcher gauges, cache, overload, utilization,
     quality, and lifecycle series next to the TF-Serving-named families,
-    with adversarial model names exercising the escaping path."""
+    with adversarial model names exercising the escaping path (now ten
+    planes: the ISSUE 12 kernel plane rides the same one-lint-covers-all
+    invariant)."""
     from distributed_tf_serving_tpu.cache import ScoreCache
     from distributed_tf_serving_tpu.models import ServableRegistry
     from distributed_tf_serving_tpu.serving import lifecycle as lifecycle_mod
@@ -87,6 +89,42 @@ def _fully_armed_text() -> str:
         RecoveryConfig(enabled=True), _BatcherSlot(), clock=lambda: 12.0
     )
     recovery.auto_cycle = False
+    # Kernel plane (ISSUE 12, the tenth plane): a KernelManager snapshot
+    # with per-bucket decisions + a measured table, adversarial
+    # model_version label included.
+    from distributed_tf_serving_tpu.ops.autotune import KernelManager
+    from distributed_tf_serving_tpu.utils.config import KernelsConfig
+
+    kern = KernelManager(KernelsConfig(enabled=True, table_file=""))
+
+    class _Tuned:  # decisions are (weakref-to-tuned-servable, {bucket: dec})
+        pass
+
+    tuned = _Tuned()
+    _fully_armed_text._keepalive = tuned  # outlive the weakrefs below
+    import weakref as _weakref
+
+    with kern._lock:
+        kern._decisions = {
+            ("DCN", 3): (_weakref.ref(tuned),
+                         {256: (True, False), 1024: (True, True)}),
+            ('we"ird\\mo\ndel', 1): (_weakref.ref(tuned),
+                                     {32: (False, True)}),
+        }
+        kern._tables = {
+            ("DCN", 3): {
+                "buckets": {
+                    "256": {
+                        "xla_f32": {"step_us": 120.0},
+                        "xla_int8": {"step_us": 90.0, "speedup": 1.33,
+                                     "max_abs_delta": 0.001,
+                                     "enabled": True},
+                        "decision": "xla_int8",
+                    },
+                },
+            },
+        }
+    kern.quantized_batches = 7
     return m.prometheus_text(
         stats,
         cache=cache.snapshot(),
@@ -96,6 +134,7 @@ def _fully_armed_text() -> str:
         lifecycle=lifecycle.snapshot(),
         pipeline=pipeline,
         recovery=recovery.snapshot(),
+        kernels=kern.snapshot(),
     )
 
 
@@ -108,7 +147,8 @@ def test_fully_armed_snapshot_passes_lint():
         "dts_tpu_cache_", "dts_tpu_overload_", "dts_tpu_utilization_",
         "dts_tpu_quality_", "dts_tpu_lifecycle_", "dts_tpu_pipeline_",
         "dts_tpu_pipeline_bucket_in_flight", "buffer_ring",
-        "dts_tpu_recovery_",
+        "dts_tpu_recovery_", "dts_tpu_kernel_",
+        "dts_tpu_kernel_variant_speedup",
     ):
         assert marker in text
 

@@ -727,3 +727,56 @@ def test_recoveryz_monitoring_and_prometheus(servable):
         asyncio.run(go())
     finally:
         batcher.stop()
+
+
+# --------------------------------------------- MTTR history ring (ISSUE 12)
+
+
+def test_mttr_history_ring_and_summary():
+    """Every completed cycle lands one record in the /recoveryz MTTR ring
+    (trigger + duration + replayed count), with summary stats over the
+    retained window — the longitudinal 'is recovery getting slower'
+    evidence next to the instantaneous last_cycle."""
+    rec, fb = make_controller()
+    for k in range(3):
+        items = [make_item() for _ in range(k + 1)]
+        assert rec.take_group(list(items), _DEV_LOST)
+        assert rec.run_cycle("device_fatal")
+    snap = rec.snapshot()
+    mttr = snap["mttr"]
+    assert mttr["cycles"] == 3 and len(mttr["history"]) == 3
+    assert mttr["history"][0]["replayed_items"] == 1
+    assert mttr["history"][2]["replayed_items"] == 3
+    for h in mttr["history"]:
+        assert h["mttr_s"] > 0 and h["trigger"] == "device_fatal"
+    assert mttr["last_s"] == mttr["history"][-1]["mttr_s"]
+    assert mttr["max_s"] >= mttr["mean_s"] > 0
+    # The ring is bounded by the same history_events knob as events.
+    assert rec._mttr_ring.maxlen == rec._events.maxlen
+
+
+def test_mttr_ring_bounded():
+    rec, fb = make_controller(history_events=8)
+    for _ in range(12):
+        assert rec.take_group([make_item()], _DEV_LOST)
+        assert rec.run_cycle("device_fatal")
+    mttr = rec.snapshot()["mttr"]
+    assert mttr["cycles"] == 8  # ring bound, not lifetime count
+    assert rec.snapshot()["counters"]["cycles_completed"] == 12
+
+
+def test_mttr_mean_rides_prometheus():
+    from distributed_tf_serving_tpu.utils.metrics import (
+        _recovery_prometheus_lines,
+    )
+
+    rec, fb = make_controller()
+    assert rec.take_group([make_item()], _DEV_LOST)
+    assert rec.run_cycle("device_fatal")
+    lines = "\n".join(_recovery_prometheus_lines(rec.snapshot()))
+    assert "dts_tpu_recovery_mttr_mean_seconds" in lines
+    val = [
+        ln for ln in lines.splitlines()
+        if ln.startswith("dts_tpu_recovery_mttr_mean_seconds ")
+    ][0].split()[1]
+    assert float(val) > 0

@@ -729,3 +729,145 @@ def test_watcher_poll_survives_fs_transients(tmp_path, monkeypatch):
     assert vw._version_ready(base / "1") is False  # no manifest anyway
     monkeypatch.setattr(vw, "is_native_checkpoint", broken_ready)
     assert vw._version_ready(base / "1") is False
+
+
+# --------------------------------- rebuilding hint (ISSUE 12 satellite)
+
+
+def test_scoreboard_rebuilding_steers_without_ejecting():
+    """kind="rebuilding" (a quarantined replica's own announcement):
+    steer around for rebuilding_busy_s, never touch the ejection budget —
+    the PR-5 pushback-is-not-death pattern below the RPC layer. A
+    SUCCESS between hints resets the streak, so a host that keeps
+    genuinely recovering keeps the hint forever."""
+    clock = [0.0]
+    sb = BackendScoreboard(
+        ["a", "b"],
+        ScoreboardConfig(failure_threshold=3, rebuilding_busy_s=2.0),
+        clock=lambda: clock[0],
+    )
+    for _ in range(5):  # past the ejection threshold; streak reset between
+        sb.record_failure(0, kind="rebuilding")
+        sb.record_failure(0, kind="rebuilding")
+        sb.record_success(0)
+    assert sb.state(0) == HEALTHY and sb.ejections == 0
+    assert sb.rebuilds == 10
+    sb.record_failure(0, kind="rebuilding")
+    # Steering prefers the non-busy peer while the rebuild bias holds...
+    assert sb.pick(0) == 1
+    # ...and returns home as soon as it lapses (no ejection window).
+    clock[0] = 2.1
+    assert sb.pick(0) == 0
+    snap = sb.snapshot()
+    assert snap["rebuilds"] == 11
+    assert snap["backends"]["a"]["rebuilds"] == 11
+
+
+def test_scoreboard_rebuilding_streak_limit_ejects_draining_host():
+    """A host that answers NOTHING BUT rebuilding hints (a draining
+    replica's health also reads NOT_SERVING, and drain never ends in
+    recovery) must not cycle healthy-busy forever: past the consecutive
+    streak limit the hints count as ordinary failures and the normal
+    eject-with-doubling machinery bounds further probing."""
+    clock = [0.0]
+    sb = BackendScoreboard(
+        ["a", "b"],
+        ScoreboardConfig(
+            failure_threshold=3, rebuilding_streak_limit=3, ejection_s=5.0,
+        ),
+        clock=lambda: clock[0],
+    )
+    for _ in range(6):
+        sb.record_failure(0, kind="rebuilding")
+    assert sb.rebuilds == 3  # only the in-streak hints counted as rebuilds
+    assert sb.state(0) == EJECTED and sb.ejections == 1
+
+
+def test_scoreboard_rebuilding_clears_failure_streak_and_recovers():
+    """A rebuild announcement PROVES the host answers: the consecutive-
+    failure streak resets, and an already-ejected host recovers to
+    healthy-but-busy instead of re-ejecting with a doubled interval."""
+    clock = [0.0]
+    sb = BackendScoreboard(
+        ["a", "b"], ScoreboardConfig(failure_threshold=2, ejection_s=5.0),
+        clock=lambda: clock[0],
+    )
+    sb.record_failure(0)
+    sb.record_failure(0)
+    assert sb.state(0) == EJECTED
+    sb.record_failure(0, kind="rebuilding")
+    assert sb.state(0) == HEALTHY and sb.recoveries == 1
+    # Streak cleared: one later transient failure must not insta-eject.
+    sb.record_failure(0)
+    assert sb.state(0) == HEALTHY
+
+
+def test_quarantine_refusal_marks_rebuilding_in_client():
+    """End to end over the wire: a server whose recovery plane is
+    refusing (DeviceQuarantinedError -> UNAVAILABLE with the 'replica
+    quarantined' marker) must be recorded as rebuilding by the fan-out
+    client — zero ejection-budget burn — while the request fails over to
+    the healthy peer."""
+    import asyncio
+
+    from distributed_tf_serving_tpu.serving.recovery import RecoveryController
+    from distributed_tf_serving_tpu.utils.config import RecoveryConfig
+
+    cfg = ModelConfig(
+        num_fields=8, vocab_size=1009, embed_dim=4, mlp_dims=(16,),
+        num_cross_layers=1, compute_dtype="float32",
+    )
+    model = build_model("dcn", cfg)
+    servable = Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(cfg.num_fields),
+    )
+
+    def start_one(quarantined: bool):
+        registry = ServableRegistry()
+        registry.load(servable)
+        batcher = DynamicBatcher(buckets=(32,), max_wait_us=0).start()
+        impl = PredictionServiceImpl(registry, batcher)
+        if quarantined:
+            rec = RecoveryController(
+                RecoveryConfig(enabled=True), batcher, registry=registry,
+                impl=impl,
+            )
+            rec.auto_cycle = False
+            rec._enter("quarantined")  # pin the refusing state
+        server, port = create_server(impl, "127.0.0.1:0")
+        server.start()
+        return server, batcher, port
+
+    s1, b1, p1 = start_one(quarantined=True)
+    s2, b2, p2 = start_one(quarantined=False)
+
+    async def run():
+        async with ShardedPredictClient(
+            [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"], "DCN",
+            scoreboard=True, failover_attempts=1,
+        ) as client:
+            rng = np.random.RandomState(0)
+            payload = {
+                "feat_ids": rng.randint(0, 1000, size=(8, 8)).astype(np.int64),
+                "feat_wts": rng.rand(8, 8).astype(np.float32),
+            }
+            scores = await client.predict(payload)
+            assert scores.shape == (8,)
+            return client.resilience_counters()
+
+    try:
+        counters = asyncio.get_event_loop_policy().new_event_loop() \
+            .run_until_complete(run())
+        assert counters["rebuilding_hints"] >= 1
+        sb = counters["scoreboard"]
+        assert sb["rebuilds"] >= 1
+        assert sb["ejections"] == 0
+        host1 = sb["backends"][f"127.0.0.1:{p1}"]
+        assert host1["consecutive_failures"] == 0
+    finally:
+        s1.stop(0)
+        s2.stop(0)
+        b1.stop()
+        b2.stop()

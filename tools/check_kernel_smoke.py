@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Kernel-plane CI smoke (ISSUE 12, TIER1_KERNEL_SMOKE): runs the
+ops/autotune.py harness end to end on CPU in measure-only mode and gates
+the plane's safety contract:
+
+1. the harness MEASURES every candidate variant per bucket on a trained
+   model — real step times, max |dScore| vs the f32 baseline, and the AUC
+   gate evaluated against a labeled held-out block;
+2. the decision table is WELL-FORMED (every bucket present, gates
+   recorded, persisted JSON parseable and keyed by model:version);
+3. measure-only ENABLES NOTHING — every per-bucket decision is the
+   baseline and live submits never route to a variant;
+4. with the plane off entirely ([kernels] enabled=false -> batcher.kernels
+   None), served scores are BIT-IDENTICAL to a batcher that never heard
+   of the plane.
+
+Exits nonzero with a reason on any violation; prints one JSON line
+(`kernel_smoke` block) for the CI log either way.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str, block: dict) -> None:
+    print(json.dumps({"kernel_smoke": block, "ok": False, "error": msg}))
+    print(f"kernel smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    import jax
+    import optax
+
+    from distributed_tf_serving_tpu.models import (
+        ModelConfig,
+        Servable,
+        build_model,
+        ctr_signatures,
+    )
+    from distributed_tf_serving_tpu.ops.autotune import BASELINE, KernelManager
+    from distributed_tf_serving_tpu.serving.batcher import DynamicBatcher
+    from distributed_tf_serving_tpu.train.data import (
+        SyntheticCTRConfig,
+        SyntheticCTRStream,
+    )
+    from distributed_tf_serving_tpu.train.trainer import Trainer
+    from distributed_tf_serving_tpu.utils.config import KernelsConfig
+
+    block: dict = {}
+    # Small dcn_v2 trained on a DENSE id catalog (the quality-soak CPU
+    # finding: a full-size vocab stays at coin flip) so the AUC gate has
+    # signal to protect.
+    cfg = ModelConfig(
+        name="DCN", num_fields=6, vocab_size=4096, embed_dim=8,
+        mlp_dims=(32,), num_cross_layers=2, cross_full_matrix=True,
+        compute_dtype="float32",
+    )
+    model = build_model("dcn_v2", cfg)
+    stream_cfg = SyntheticCTRConfig(num_fields=6, id_space=1 << 10, seed=0)
+    trainer = Trainer(
+        model, learning_rate=optax.cosine_decay_schedule(3e-2, 200),
+        seed=0, stream_config=stream_cfg,
+    )
+    trainer.fit(200, batch_size=256)
+    servable = Servable(
+        name="DCN", version=1, model=model, params=trainer.state.params,
+        signatures=ctr_signatures(6),
+    )
+    held = SyntheticCTRStream(stream_cfg).batch(256, 999_983)
+    eval_data = (
+        {"feat_ids": held["feat_ids"], "feat_wts": held["feat_wts"]},
+        held["labels"],
+    )
+
+    buckets = (16, 32)
+    table_file = os.path.join(tempfile.mkdtemp(), "kernel_autotune.json")
+    batcher = DynamicBatcher(buckets=buckets, max_wait_us=0).start()
+    plain = DynamicBatcher(buckets=buckets, max_wait_us=0).start()
+    try:
+        batcher.warmup(servable)
+        manager = KernelManager(KernelsConfig(
+            enabled=True, measure_only=True, table_file=table_file,
+            measure_iters=2,
+        ))
+        batcher.kernels = manager
+        table = manager.autotune(
+            batcher, servable, buckets=buckets, eval_data=eval_data
+        )
+        block["table"] = table
+
+        # 1+2: well-formed, gates evaluated.
+        if not table.get("measure_only"):
+            fail("table does not record measure_only", block)
+        if not table["gates"]["auc_evaluated"]:
+            fail("AUC gate was not evaluated despite eval data", block)
+        if table["auc"].get(BASELINE) is None:
+            fail(f"baseline AUC missing: {table.get('auc_errors')}", block)
+        if table["auc"][BASELINE] <= 0.6:
+            fail(f"trained baseline AUC {table['auc'][BASELINE]} at coin "
+                 "flip — the gate protects nothing", block)
+        for b in buckets:
+            row = table["buckets"].get(str(b))
+            if row is None:
+                fail(f"bucket {b} missing from the table", block)
+            if row[BASELINE]["step_us"] <= 0:
+                fail(f"bucket {b}: baseline was not timed", block)
+            v = row.get("xla_int8")
+            if v is None or "step_us" not in v:
+                fail(f"bucket {b}: xla_int8 was not measured: {v}", block)
+            if "max_abs_delta" not in v:
+                fail(f"bucket {b}: accuracy gate not evaluated", block)
+            if v.get("auc_gate") not in ("pass", "fail"):
+                fail(f"bucket {b}: auc_gate not evaluated: {v}", block)
+            # 3: measure-only must never enable.
+            if v.get("enabled") or row.get("decision") != BASELINE:
+                fail(f"bucket {b}: measure-only enabled a variant", block)
+        for b in buckets:
+            if manager.decision(servable, b) is not None:
+                fail(f"bucket {b}: live decision despite measure-only", block)
+
+        # Persistence well-formed.
+        data = json.load(open(table_file))
+        if "DCN:1" not in (data.get("entries") or {}):
+            fail("persisted table missing the DCN:1 entry", block)
+        if data.get("fingerprint") is None or data.get("device") is None:
+            fail("persisted table missing device/fingerprint keys", block)
+        block["table_file_ok"] = True
+
+        # 4: off-by-default bit-identity — the measure-only manager is
+        # ATTACHED to `batcher` (worst case: the plane is present but must
+        # route nothing), `plain` never heard of the plane.
+        rng = np.random.RandomState(3)
+        arrays = {
+            "feat_ids": rng.randint(0, 1 << 40, size=(24, 6)).astype(np.int64),
+            "feat_wts": rng.rand(24, 6).astype(np.float32),
+        }
+        a = batcher.submit(servable, dict(arrays)).result(30)["prediction_node"]
+        b = plain.submit(servable, dict(arrays)).result(30)["prediction_node"]
+        if not np.array_equal(a, b):
+            fail("measure-only plane changed served scores", block)
+        block["off_bit_identical"] = True
+    finally:
+        batcher.stop()
+        plain.stop()
+
+    block_out = {
+        "auc_baseline": table["auc"][BASELINE],
+        "buckets": {
+            str(b): {
+                "baseline_us": table["buckets"][str(b)][BASELINE]["step_us"],
+                "int8_us": table["buckets"][str(b)]["xla_int8"].get("step_us"),
+                "int8_speedup": table["buckets"][str(b)]["xla_int8"].get("speedup"),
+                "int8_max_abs_delta":
+                    table["buckets"][str(b)]["xla_int8"].get("max_abs_delta"),
+                "int8_auc_gate": table["buckets"][str(b)]["xla_int8"].get("auc_gate"),
+                "decision": table["buckets"][str(b)]["decision"],
+            }
+            for b in buckets
+        },
+        "table_file_ok": True,
+        "off_bit_identical": True,
+    }
+    print(json.dumps({"kernel_smoke": block_out, "ok": True}))
+    print("kernel smoke OK", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

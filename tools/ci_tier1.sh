@@ -138,6 +138,21 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_RECOVERY_SMOKE:-0}" = "1" ]; then
     python tools/check_recovery_smoke.py "$RECOVERY_LINE" || rc=1
 fi
 
+# Kernel smoke (TIER1_KERNEL_SMOKE=1): the ISSUE-12 safety gate — the
+# autotune harness runs end to end on CPU in MEASURE-ONLY mode against a
+# trained model: every variant measured per bucket with the max-|dScore|
+# and AUC accuracy gates evaluated, the persisted decision table
+# well-formed, NOTHING enabled (measure-only's contract), and with the
+# plane off served scores bit-identical to a plane-less batcher
+# (tools/check_kernel_smoke.py — CPU-safe: Pallas variants are recorded
+# as ineligible on the interpret backend, never timed as if real).
+if [ "$rc" -eq 0 ] && [ "${TIER1_KERNEL_SMOKE:-0}" = "1" ]; then
+    KERNEL_LINE="${TIER1_KERNEL_LINE:-/tmp/tier1_kernel_smoke.json}"
+    echo "tier1: kernel smoke (line $KERNEL_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python tools/check_kernel_smoke.py | tee "$KERNEL_LINE" || rc=1
+fi
+
 # Lifecycle smoke (TIER1_LIFECYCLE_SMOKE=1): a SOAK_LIFECYCLE=1 soak —
 # trained model behind a real version watcher + lifecycle controller;
 # the driver publishes a fine-tuned GOOD canary (must auto-promote) and
