@@ -799,6 +799,81 @@ def kernel_ab_block(batcher, servable, scale: Scale, config) -> dict:
         batcher.kernels = None
 
 
+def mesh_ab_block(device: str) -> dict:
+    """Mesh serving A/B (ISSUE 13, opt-in via DTS_BENCH_MESH=1): run
+    tools/mesh_ab.py in a SUBPROCESS — single-chip vs data-parallel
+    ({N,1}) vs data×model ({N/2,2}) serving throughput of one process,
+    with a bit-identity gate across all three modes.
+
+    The subprocess is the point: the mesh needs >= MESH_AB_DEVICES chips,
+    and this child may be running on a 1-device CPU host — the block then
+    forces an EMULATED 8-device CPU mesh in the child's env and records
+    `emulated: true` (the standing-debt field: emulated numbers are
+    functional trajectory points, never throughput claims; the next
+    live-TPU round overwrites them with emulated: false ones the same
+    block shape)."""
+    need = int(os.environ.get("MESH_AB_DEVICES", "8"))
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "mesh_ab.py"
+    )
+    live = False
+    try:
+        import jax as _jax
+
+        live = (
+            _jax.default_backend() != "cpu"
+            and len(_jax.devices()) >= need
+        )
+    except Exception:  # noqa: BLE001 — substrate probe only
+        pass
+    if live:
+        # LIVE slice: run IN-PROCESS. This bench child already owns the
+        # TPU backend (libtpu is single-process-exclusive), so a
+        # subprocess could never initialize it — importing the module
+        # here reuses the live devices this process holds.
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location("mesh_ab", script)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            block = mod.main()
+        except Exception as exc:  # noqa: BLE001 — diagnostic block only
+            return {"error": f"mesh A/B in-process failed: {exc}",
+                    "emulated": False}
+        block["emulated"] = False
+        block["parent_device"] = device
+        return block
+    # No live slice: an EMULATED 8-device CPU mesh in a SUBPROCESS (the
+    # forced device count must land before that process imports jax;
+    # MESH_AB_FORCE_CPU is the child's pre-import emulation switch).
+    env = dict(os.environ)
+    env["MESH_AB_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={need}"
+    ).strip()
+    try:
+        r = subprocess.run(
+            [sys.executable, script],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, timeout=600,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "mesh A/B child timed out", "emulated": True}
+    block = _last_json(r.stdout)
+    if block is None:
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        return {
+            "error": f"mesh A/B child rc={r.returncode}, no JSON line",
+            "stderr_tail": tail, "emulated": True,
+        }
+    block["emulated"] = True
+    block["parent_device"] = device
+    return block
+
+
 def device_decomposition(batcher, servable, scale: Scale, rtt_floor_ms, device: str) -> dict:
     """VERDICT r2 task 2: the denominator every tuning argument needs —
     pure device step time per bucket (through the SAME jitted entry the
@@ -2471,6 +2546,17 @@ def child_main() -> None:
                 "decisions": res["kernels"]["decisions"],
                 "any_enabled": res["kernels"]["any_enabled"],
             }))
+        if os.environ.get("DTS_BENCH_MESH", "0") == "1":
+            stage = "mesh"
+            res["mesh"] = mesh_ab_block(device)
+            log(stage, json.dumps({
+                "emulated": res["mesh"].get("emulated"),
+                "bit_identical": res["mesh"].get("bit_identical"),
+                "qps": {
+                    m: b.get("qps")
+                    for m, b in (res["mesh"].get("modes") or {}).items()
+                },
+            }))
         batcher.stop()
 
         asyncio.run(measure_host_ceiling())
@@ -2538,6 +2624,12 @@ def child_main() -> None:
             # decision table also lands in artifacts/kernel_autotune.json
             # for serving processes on this device to adopt.
             "kernels": res.get("kernels"),
+            # Mesh serving A/B (ISSUE 13, DTS_BENCH_MESH=1): single-chip
+            # vs {N,1} vs {N/2,2} serving throughput with a cross-mode
+            # bit-identity gate; `emulated` records whether the modes
+            # ran on forced CPU devices (functional trajectory point) or
+            # a live slice (real throughput). Absent when off (default).
+            "mesh": res.get("mesh"),
             # Output-transfer pipeline attribution (ISSUE 1): wire bytes
             # fetched vs. the full-fp32 all-outputs baseline, and the
             # fraction of the in-flight D2H window the completers never

@@ -38,6 +38,8 @@ from ..proto.service_grpc import (  # noqa: F401
 from .health import HALF_OPEN, BackendScoreboard
 from .partition import (
     StreamingMerger,
+    affinity_groups,
+    index_runs,
     merge_host_order,
     partition_bounds,
     shard_candidates,
@@ -324,9 +326,14 @@ class ShardedPredictClient:
         stream_chunk_candidates: int = 0,
         max_attempts_total: int = 0,
         score_wire_int8: bool = False,
+        placement: str = "contiguous",
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
+        if placement not in ("contiguous", "affinity"):
+            raise ValueError(
+                f"placement must be 'contiguous' or 'affinity', got {placement!r}"
+            )
         self.hosts = list(hosts)
         self.model_name = model_name
         self.signature_name = signature_name
@@ -413,6 +420,22 @@ class ShardedPredictClient:
         # first attempt is always allowed; the budget bounds the rest.
         # 0 = unlimited (historical behavior).
         self.max_attempts_total = max(int(max_attempts_total or 0), 0)
+        # Candidate placement policy (ROADMAP 4a seed, ISSUE 13
+        # satellite). "contiguous" = the reference's positional split.
+        # "affinity": each candidate ROW routes to the backend its
+        # canonical row digest jump-hashes to (cache/digest.py row
+        # identity), so a hot row always lands on the same replica's
+        # warm score cache instead of re-scoring on every replica. The
+        # affine backend is the group's HOME in the existing failover
+        # machinery, so the scoreboard still steers a group away while
+        # its home is ejected/busy/rebuilding, and results scatter back
+        # into the original candidate order (bit-identical to the
+        # contiguous split's merge). SEED SCOPE (ROADMAP 4a): predict()
+        # routes by affinity; predict_streamed()/prepare() keep the
+        # contiguous split (their chunk/offset machinery assumes
+        # contiguous shard ranges — row-granular caching, 4a(b), is the
+        # follow-up that makes affinity pay there).
+        self.placement = placement
         # int8 score response wire (ISSUE 12): opt into DT_INT8 score
         # tensors (+ scale/min sidecar outputs, dequantized locally) via
         # x-dts-score-wire metadata — 4x fewer response bytes per score
@@ -980,43 +1003,55 @@ class ShardedPredictClient:
                 merged = np.sort(merged)
         return merged
 
+    @staticmethod
+    def _screen_shard_failures(results: list) -> list[int]:
+        """Shared failure bookkeeping for the degraded-merge fan-outs
+        (contiguous partial + affinity): re-raise anything that is not a
+        per-shard RPC failure (a client bug or a cancellation must never
+        be laundered into a degraded merge), raise the first error when
+        EVERY shard failed (an empty result would read as 'zero
+        candidates scored well'), and return the failed indices."""
+        for r in results:
+            if isinstance(r, BaseException) and not isinstance(r, PredictClientError):
+                raise r
+        failed = [k for k, r in enumerate(results) if isinstance(r, BaseException)]
+        if failed and len(failed) == len(results):
+            raise results[0]  # total outage: degraded mode has nothing to merge
+        return failed
+
+    def _note_degraded_merge(self, missing_ranges) -> None:
+        """Shared degraded-merge accounting: the partial-response counter
+        plus the root-span annotation (degraded merges are tail-kept by
+        the recorder, so /tracez shows WHICH candidate ranges went
+        missing)."""
+        self.counters.partial_responses += 1
+        root = tracing.current_span()
+        if root is not None:
+            root.attrs["degraded"] = True
+            root.annotate(
+                "degraded_merge",
+                missing_ranges=[list(r) for r in missing_ranges],
+            )
+
     async def _fan_out_partial(
         self, shard_coros: list, sort_scores: bool, bounds: list[tuple[int, int]]
     ) -> PredictResult:
         """Degraded-merge fan-out: failed shards become missing_ranges.
         Shards are awaited concurrently regardless of full_async — the
         sequential mode's early-abort semantics make no sense when failures
-        are survivable. Every shard failing still raises (an empty result
-        would read as 'zero candidates scored well')."""
+        are survivable."""
         results = await asyncio.gather(*shard_coros, return_exceptions=True)
-        for r in results:
-            # Anything but a per-shard RPC failure is a client bug (or a
-            # cancellation) and must not be laundered into a degraded merge.
-            if isinstance(r, BaseException) and not isinstance(r, PredictClientError):
-                raise r
-        failed = [k for k, r in enumerate(results) if isinstance(r, BaseException)]
-        if len(failed) == len(results):
-            raise results[0]  # total outage: degraded mode has nothing to merge
+        failed = self._screen_shard_failures(results)
         if not failed:
             return PredictResult(scores=self._merge(list(results), sort_scores))
-        self.counters.partial_responses += 1
+        missing = tuple(bounds[k] for k in failed)
+        self._note_degraded_merge(missing)
         merged = self._merge(
             [r for r in results if not isinstance(r, BaseException)],
             sort_scores, degraded=True,
         )
-        root = tracing.current_span()
-        if root is not None:
-            # Degraded merges are tail-kept by the recorder: annotate the
-            # root so /tracez shows WHICH candidate ranges went missing.
-            root.attrs["degraded"] = True
-            root.annotate(
-                "degraded_merge",
-                missing_ranges=[list(bounds[k]) for k in failed],
-            )
         return PredictResult(
-            scores=merged,
-            missing_ranges=tuple(bounds[k] for k in failed),
-            degraded=True,
+            scores=merged, missing_ranges=missing, degraded=True,
         )
 
     def _cache_key(self, arrays: dict[str, np.ndarray], sort_scores: bool) -> tuple:
@@ -1072,6 +1107,8 @@ class ShardedPredictClient:
     async def _predict_uncached(
         self, arrays: dict[str, np.ndarray], sort_scores: bool
     ) -> "np.ndarray | PredictResult":
+        if self.placement == "affinity" and len(self.hosts) > 1:
+            return await self._predict_affinity(arrays, sort_scores)
         shards = shard_candidates(arrays, len(self.hosts))
         self._rr += 1
         rr = self._rr
@@ -1092,6 +1129,77 @@ class ShardedPredictClient:
                 ],
                 sort_scores,
                 bounds=bounds,
+            )
+
+    async def _predict_affinity(
+        self, arrays: dict[str, np.ndarray], sort_scores: bool
+    ) -> "np.ndarray | PredictResult":
+        """Key-affinity fan-out (placement="affinity"): rows grouped by
+        the jump hash of their canonical row digest, each group sent to
+        its affine backend as that group's HOME — the existing
+        steering/failover machinery then applies unchanged (the
+        scoreboard routes a group elsewhere while its home is ejected/
+        busy/rebuilding; hedges/retry budget/backoff all compose).
+        Results scatter back by original row index, so the merged vector
+        is bit-identical to the contiguous split's. Groups are always
+        awaited concurrently (the partial-merge precedent: sequential
+        host-order issue has no meaning for content-addressed groups).
+
+        In partial-results mode a group whose failover chain exhausts
+        degrades the merge: the surviving rows come back in candidate
+        order and the lost group's rows become missing_ranges (scattered
+        rows encode as several small [start, end) runs)."""
+        groups = affinity_groups(arrays, len(self.hosts))
+        self._rr += 1
+        rr = self._rr
+        n = next(iter(arrays.values())).shape[0]
+        with tracing.start_root(
+            "client.predict",
+            attrs={"model": self.model_name, "candidates": n,
+                   "shards": len(groups), "placement": "affinity"},
+        ):
+            budget = self._new_budget(len(groups))
+            results = await asyncio.gather(
+                *(
+                    self._predict_shard(host, sub, rr, budget)
+                    for host, _idx, sub in groups
+                ),
+                return_exceptions=True,
+            )
+            if not self.partial_results:
+                for r in results:
+                    if isinstance(r, BaseException):
+                        raise r
+            failed = set(self._screen_shard_failures(results))
+            ok = [
+                (groups[k][1], np.asarray(results[k]))
+                for k in range(len(results)) if k not in failed
+            ]
+            with tracing.start_span(
+                "client.merge",
+                attrs={"degraded": True} if failed else None,
+            ):
+                idx = np.concatenate([i for i, _v in ok])
+                vals = np.concatenate([v for _i, v in ok])
+                if failed:
+                    # Surviving rows in candidate order (the degraded-
+                    # merge contract: a shorter vector + missing_ranges).
+                    merged = vals[np.argsort(idx, kind="stable")]
+                else:
+                    merged = np.empty((n,) + vals.shape[1:], vals.dtype)
+                    merged[idx] = vals
+                if sort_scores:
+                    merged = np.sort(merged)
+            if not failed:
+                if self.partial_results:
+                    return PredictResult(scores=merged)
+                return merged
+            missing = index_runs(
+                np.concatenate([groups[k][1] for k in sorted(failed)])
+            )
+            self._note_degraded_merge(missing)
+            return PredictResult(
+                scores=merged, missing_ranges=missing, degraded=True,
             )
 
     # ------------------------------------------------- streamed Predict
@@ -1305,6 +1413,7 @@ def client_from_config(cfg) -> ShardedPredictClient:
         keepalive_timeout_ms=cfg.keepalive_timeout_ms,
         criticality=cfg.criticality,
         max_attempts_total=cfg.max_attempts_total,
+        placement=cfg.placement,
     )
 
 

@@ -69,6 +69,84 @@ def partition_flat(flat: Sequence, parts: int, num_fields: int) -> list[Sequence
     return shards
 
 
+def jump_hash(key: int, buckets: int) -> int:
+    """Lamping–Veach jump consistent hash: key -> [0, buckets). Cheap
+    integer math per key (no per-bucket hashing), and consistent: growing
+    the backend list from n to n+1 remaps only ~1/(n+1) of the keys, so a
+    fleet resize does not cold-start every warm cache at once."""
+    if buckets <= 0:
+        raise ValueError(f"buckets must be positive, got {buckets}")
+    key &= (1 << 64) - 1
+    b, j = -1, 0
+    while j < buckets:
+        b = j
+        key = (key * 2862933555777941757 + 1) & ((1 << 64) - 1)
+        j = int((b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+    return b
+
+
+def affinity_groups(
+    arrays: dict[str, np.ndarray], parts: int
+) -> list[tuple[int, np.ndarray, dict[str, np.ndarray]]]:
+    """Key-affinity candidate placement (ROADMAP 4a seed): assign each
+    candidate row to a backend by jump-hashing its canonical row digest
+    (cache/digest.py row identity — the SAME bytes the server's dedup and
+    label-join planes key on), then gather per-backend row groups.
+
+    Returns [(home_backend_idx, original_row_indices, sub_arrays), ...]
+    for the non-empty groups only. Every row appears in exactly one
+    group; scattering each group's scores back by its indices
+    reconstructs the original candidate order exactly (so results are
+    bit-identical to the contiguous split — the same rows score the same
+    on whichever replica, and order is restored by construction).
+
+    The row digest is cache/digest.row_label_keys — the ONE per-row
+    identity the server's dedup and label-join planes already key on
+    (never a second implementation that could drift); its first 64 bits
+    feed the jump hash. Cost is one blake2b per row on the predict path
+    (~µs/row) — acceptable for the seed; a batched native digest is the
+    follow-up if affinity graduates to the hot default.
+    """
+    from ..cache.digest import row_label_keys
+
+    if parts <= 0:
+        raise ValueError(f"parts must be positive, got {parts}")
+    n = next(iter(arrays.values())).shape[0]
+    if n == 0:
+        raise ValueError("cannot place an empty candidate set")
+    for key, arr in arrays.items():
+        if arr.shape[0] != n:
+            raise ValueError(
+                f"inconsistent candidate counts: {key!r} has {arr.shape[0]}, expected {n}"
+            )
+    keys = row_label_keys(arrays)
+    assign = np.empty(n, np.int64)
+    for i in range(n):
+        assign[i] = jump_hash(int(keys[i][:16], 16), parts)
+    out = []
+    for host in range(parts):
+        idx = np.nonzero(assign == host)[0]
+        if idx.size == 0:
+            continue
+        out.append((host, idx, {k: v[idx] for k, v in arrays.items()}))
+    return out
+
+
+def index_runs(indices: np.ndarray) -> tuple[tuple[int, int], ...]:
+    """Sorted row indices -> contiguous [start, end) runs — the
+    missing_ranges encoding for an affinity group's failure (its rows are
+    scattered, so one group degrades into several small ranges)."""
+    idx = np.sort(np.asarray(indices, np.int64))
+    if idx.size == 0:
+        return ()
+    breaks = np.nonzero(np.diff(idx) > 1)[0]
+    starts = np.concatenate(([0], breaks + 1))
+    ends = np.concatenate((breaks, [idx.size - 1]))
+    return tuple(
+        (int(idx[s]), int(idx[e]) + 1) for s, e in zip(starts, ends)
+    )
+
+
 class StreamingMerger:
     """Incremental merge of out-of-order PredictStream chunks (ISSUE 9).
 

@@ -99,6 +99,12 @@ class Model:
     # default 32-bit canonicalization would silently corrupt; the batcher
     # traces AND calls such models inside jax.enable_x64().
     needs_x64: bool = False
+    # Zoo family this model was built as (build_model stamps it); "" for
+    # directly-constructed models (imported graphs, tests). The mesh
+    # serving mode keys its named partition rules on it
+    # (parallel/embedding_sharding.MODEL_PARTITION_RULES) — unknown kinds
+    # fall back to the generic path-name layout.
+    kind: str = ""
 
 
 # ---------------------------------------------------------------------------
@@ -185,7 +191,12 @@ def build_model(kind: str, config: ModelConfig | None = None, **overrides) -> Mo
         config = ModelConfig(**overrides)
     elif overrides:
         config = dataclasses.replace(config, **overrides)
-    return _BUILDERS[kind](config)
+    model = _BUILDERS[kind](config)
+    if not model.kind:
+        # Stamp the family so downstream layout policy (mesh partition
+        # rules) can key on it without re-plumbing the kind string.
+        model = dataclasses.replace(model, kind=kind)
+    return model
 
 
 def model_kinds() -> list[str]:

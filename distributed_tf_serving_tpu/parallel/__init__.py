@@ -1,6 +1,12 @@
 """Parallel layer: device mesh, shardings, sharded executor, EP lookups."""
 
-from .embedding_sharding import sharded_field_embed
+from .embedding_sharding import (
+    MODEL_PARTITION_RULES,
+    match_partition_rules,
+    partition_rules_for,
+    sharded_field_embed,
+    tree_path_str,
+)
 from .executor import ShardedExecutor, shard_map_score
 from .mesh import (
     DATA_AXIS,
@@ -29,4 +35,8 @@ __all__ = [
     "ShardedExecutor",
     "shard_map_score",
     "sharded_field_embed",
+    "MODEL_PARTITION_RULES",
+    "match_partition_rules",
+    "partition_rules_for",
+    "tree_path_str",
 ]

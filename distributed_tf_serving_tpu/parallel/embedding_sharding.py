@@ -18,12 +18,113 @@ Two equivalent paths are provided:
 
 from __future__ import annotations
 
+import re
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.compat import shard_map
 
 from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+# ---------------------------------------------------------------------------
+# Named partition rules (the serving-mode layout contract, ISSUE 13).
+#
+# The generic layout walker (sharding.param_shardings) infers "vocab table"
+# from path-name heuristics; the serving mode wants the layout to be an
+# explicit, reviewable CONTRACT per model family — the match_partition_rules
+# idiom (SNIPPETS.md): ordered (regex, PartitionSpec) pairs matched against
+# the "/"-joined param path, first match wins. A rule that would place a
+# mesh axis on a missing dim (spec rank > leaf rank) is a config error; an
+# unmatched leaf returns None so the caller can fall back to the generic
+# dense policy (replicated, or tensor-parallel splits) — the rules pin the
+# memory-heavy EP decisions, the generic walker keeps handling the long
+# tail of small dense params identically on both paths.
+
+
+def tree_path_str(path) -> str:
+    """jax key-path -> "/"-joined name ("cross/0/w") for rule matching."""
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        parts.append(str(key) if key is not None else str(p))
+    return "/".join(parts)
+
+
+# Per-family rules. Only the vocab-major tables are pinned here: they are
+# the DLRM-scale memory (the 300M-qps paper's CTR models are embedding-
+# dominated) and the one layout decision that MUST NOT silently change
+# with a param rename. Dense MLP/cross weights fall through (None) to the
+# generic policy so tensor_parallel keeps working identically.
+MODEL_PARTITION_RULES: dict[str, tuple[tuple[str, P], ...]] = {
+    "dcn": (("^embedding$", P(MODEL_AXIS, None)),),
+    "dcn_v2": (("^embedding$", P(MODEL_AXIS, None)),),
+    "dlrm": (("^embedding$", P(MODEL_AXIS, None)),),
+    "two_tower": (
+        ("^embedding$", P(MODEL_AXIS, None)),
+        ("^temperature$", P()),  # scalar: explicit, never sharded
+    ),
+    "wide_deep": (
+        ("^embedding$", P(MODEL_AXIS, None)),
+        ("^wide$", P(MODEL_AXIS)),  # per-vocab-row scalar table (EP too)
+        ("^wide_bias$", P()),
+    ),
+    "deepfm": (
+        ("^embedding$", P(MODEL_AXIS, None)),
+        ("^linear$", P(MODEL_AXIS)),
+    ),
+    "generic_mlp": (("^embedding$", P(MODEL_AXIS, None)),),
+}
+
+
+def partition_rules_for(model_kind: str) -> tuple[tuple[str, P], ...] | None:
+    """The family's ordered (regex, PartitionSpec) rules, or None for an
+    unknown/imported family (graph executors, custom servables) — callers
+    then use the generic path-name layout unchanged."""
+    return MODEL_PARTITION_RULES.get(model_kind)
+
+
+def rule_matcher(rules, strict: bool = False):
+    """(path, leaf) -> PartitionSpec-or-None resolver for an ordered rule
+    list — the per-leaf core match_partition_rules and the generic layout
+    walker (sharding.param_shardings) share.
+
+    Scalars are never partitioned (the SNIPPETS idiom). A matched spec
+    whose rank exceeds the leaf's is a layout bug — the table the rule
+    was written for changed shape — and raises rather than silently
+    serving a wrong layout. Unmatched leaves yield None (generic-policy
+    fallback); strict=True turns them into errors for tests that want
+    the rule set proven exhaustive."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def resolve(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or all(d == 1 for d in shape):
+            return P()  # scalars/degenerate leaves are never partitioned
+        name = tree_path_str(path)
+        for pat, spec in compiled:
+            if pat.search(name) is not None:
+                if len(spec) > len(shape):
+                    raise ValueError(
+                        f"partition rule {pat.pattern!r} places "
+                        f"{len(spec)} dims but param {name!r} has shape "
+                        f"{shape} — the rule no longer matches the model"
+                    )
+                return spec
+        if strict:
+            raise ValueError(f"no partition rule matched param {name!r}")
+        return None
+
+    return resolve
+
+
+def match_partition_rules(rules, params, strict: bool = False):
+    """PartitionSpec-or-None tree for `params` per the ordered rules (see
+    rule_matcher for the matching semantics)."""
+    return jax.tree_util.tree_map_with_path(rule_matcher(rules, strict), params)
 
 
 def sharded_field_embed(

@@ -27,10 +27,22 @@ from .mesh import DATA_AXIS, MODEL_AXIS
 VOCAB_MAJOR_KEYS = ("embedding", "wide", "linear")
 
 
-def param_shardings(params: Any, mesh: Mesh, tensor_parallel: bool = False) -> Any:
+def param_shardings(
+    params: Any,
+    mesh: Mesh,
+    tensor_parallel: bool = False,
+    model_kind: str | None = None,
+) -> Any:
     """NamedSharding tree matching `params`: vocab tables split over the
     model axis; dense weights replicated, or model-axis split when
     tensor_parallel (divisible dims only).
+
+    model_kind (when the family has named rules in
+    embedding_sharding.MODEL_PARTITION_RULES) resolves the vocab-table
+    placements through the explicit match_partition_rules contract
+    instead of the path-name heuristic; unmatched leaves fall through to
+    the generic dense policy below, so tensor_parallel behaves
+    identically on both paths.
 
     A 1-D param (bias) is split over the model axis only when a sibling 2-D
     weight in the same subtree is column-split with a matching output dim —
@@ -41,6 +53,17 @@ def param_shardings(params: Any, mesh: Mesh, tensor_parallel: bool = False) -> A
     extra all-gather per layer (round-1 advisor finding)."""
     tp = mesh.shape[MODEL_AXIS]
     vocab_keys = set(VOCAB_MAJOR_KEYS)
+
+    pin = None
+    if model_kind is not None:
+        from .embedding_sharding import partition_rules_for, rule_matcher
+
+        rules = partition_rules_for(model_kind)
+        if rules is not None:
+            # (path, leaf) -> pinned spec or None; the rules pin the EP
+            # tables, None falls through to the generic dense policy in
+            # rule() below.
+            pin = rule_matcher(rules)
 
     def is_vocab(path) -> bool:
         return bool({getattr(p, "key", None) for p in path} & vocab_keys)
@@ -62,6 +85,10 @@ def param_shardings(params: Any, mesh: Mesh, tensor_parallel: bool = False) -> A
 
     def rule(path, leaf):
         ndim = getattr(leaf, "ndim", 0)
+        if pin is not None:
+            spec = pin(path, leaf)
+            if spec is not None:
+                return NamedSharding(mesh, spec)
         if is_vocab(path) and ndim >= 1:
             return NamedSharding(mesh, P(MODEL_AXIS, *(None,) * (ndim - 1)))
         if tensor_parallel and tp > 1:
@@ -86,6 +113,14 @@ def batch_shardings(batch: dict, mesh: Mesh) -> dict:
     }
 
 
-def place_params(params: Any, mesh: Mesh, tensor_parallel: bool = False) -> Any:
-    """Device-put a param tree according to param_shardings."""
-    return jax.device_put(params, param_shardings(params, mesh, tensor_parallel))
+def place_params(
+    params: Any,
+    mesh: Mesh,
+    tensor_parallel: bool = False,
+    model_kind: str | None = None,
+) -> Any:
+    """Device-put a param tree according to param_shardings (model_kind
+    routes the vocab tables through the named partition rules)."""
+    return jax.device_put(
+        params, param_shardings(params, mesh, tensor_parallel, model_kind)
+    )

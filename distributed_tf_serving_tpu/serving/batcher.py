@@ -1199,12 +1199,19 @@ class DynamicBatcher:
         combinatorial space is not worth the load-time."""
         model = servable.model
         if self._run_fn is not None:
-            # Custom executors (the sharded mesh path) ignore out_keys/
-            # donate/topk — one execution per bucket warms everything there
-            # is; the variant loop below would just repeat identical device
-            # work 2-4x per bucket.
+            # Custom executors ignore donate/topk — but an executor that
+            # honors output selection (the mesh path's supports_out_keys)
+            # compiles a distinct executable per out_keys, so both
+            # variants live traffic predictably hits (all-outputs +
+            # score-only) warm here; other executors get the historical
+            # one execution per bucket.
+            out_variants: tuple = (None,)
+            if getattr(self._run_fn, "supports_out_keys", False):
+                out_variants = (None, (model.score_output,))
             for b in buckets or self.buckets:
-                self._execute(servable, prepare_inputs(model, self.warmup_arrays(servable, b)))
+                arrays = prepare_inputs(model, self.warmup_arrays(servable, b))
+                for out_keys in out_variants:
+                    self._execute(servable, arrays, out_keys=out_keys)
             return
         score_only = (model.score_output,)
         _, _, combined = self._jit_for(servable)
@@ -1788,6 +1795,12 @@ class DynamicBatcher:
             arrays = dict(arrays)
             arrays["feat_ids"] = fold_ids_host(ids, servable.model.config.vocab_size)
         if self._run_fn is not None:
+            if getattr(self._run_fn, "supports_out_keys", False):
+                # Mesh executor (parallel/executor.py): the group's
+                # output-selection union rides through so unwanted outputs
+                # are DCE'd on-mesh and never cross the gathered D2H link
+                # — the same PR-1 compaction the single-chip entries get.
+                return self._run_fn(servable, arrays, out_keys=out_keys)
             return self._run_fn(servable, arrays)
         k_params, k_apply = self._kernel_variant(
             servable, next(iter(arrays.values())).shape[0], _kernel_override

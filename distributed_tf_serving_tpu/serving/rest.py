@@ -551,16 +551,21 @@ class RestGateway:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         stats = getattr(self.impl.batcher, "stats", None)
+        # Computed once and shared with the mesh block: mesh_stats lifts
+        # its per-device attribution from this snapshot instead of
+        # re-running the ledger's waterfall merge per scrape.
+        utilization = self.impl.utilization_stats()
         return web.Response(
             body=self.metrics.prometheus_text(
                 stats, cache=self.impl.cache_stats(),
                 overload=self.impl.overload_stats(),
-                utilization=self.impl.utilization_stats(),
+                utilization=utilization,
                 quality=self.impl.quality_stats(),
                 lifecycle=self.impl.lifecycle_stats(),
                 pipeline=self.impl.pipeline_stats(),
                 recovery=self.impl.recovery_stats(),
                 kernels=self.impl.kernels_stats(),
+                mesh=self.impl.mesh_stats(utilization=utilization),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -593,6 +598,7 @@ class RestGateway:
             "lifecycle": self.impl.lifecycle_stats,
             "recovery": self.impl.recovery_stats,
             "kernels": self.impl.kernels_stats,
+            "mesh": self.impl.mesh_stats,
             "versions": self.impl.versions_stats,
             "pipeline": self.impl.pipeline_stats,
             "request_log": request_log,
@@ -621,11 +627,17 @@ class RestGateway:
         snap["phases"] = builders["phases"]()
         snap["tracing"] = builders["tracing"]()
         # Armed-plane blocks only: a disabled plane is absent, so
-        # dashboards can distinguish "off" from "cold".
+        # dashboards can distinguish "off" from "cold". The mesh block
+        # reuses the utilization snapshot computed earlier in this same
+        # pass (its per-device attribution lifts from it — no second
+        # waterfall merge).
         for name in ("cache", "overload", "utilization", "quality",
-                     "lifecycle", "recovery", "kernels", "versions",
-                     "pipeline"):
-            block = builders[name]()
+                     "lifecycle", "recovery", "kernels", "mesh",
+                     "versions", "pipeline"):
+            block = (
+                self.impl.mesh_stats(utilization=snap.get("utilization"))
+                if name == "mesh" else builders[name]()
+            )
             if block is not None:
                 snap[name] = block
         snap["draining"] = builders["draining"]()

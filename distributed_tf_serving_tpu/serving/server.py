@@ -881,7 +881,8 @@ class ModelLifecycle:
     down, signalling all watchers before joining so drain time is the
     max, not the sum)."""
 
-    def __init__(self, cfg, registry, batcher, model_config, mesh):
+    def __init__(self, cfg, registry, batcher, model_config, mesh,
+                 tensor_parallel: bool | None = None):
         import threading
 
         self._cfg = cfg
@@ -889,6 +890,12 @@ class ModelLifecycle:
         self._batcher = batcher
         self._model_config = model_config
         self._mesh = mesh
+        # The EFFECTIVE layout knob: the [mesh] section's value when that
+        # mode armed the mesh, cfg.tensor_parallel otherwise — watcher
+        # loads must pre-place params in the layout the executor serves.
+        self._tensor_parallel = (
+            cfg.tensor_parallel if tensor_parallel is None else tensor_parallel
+        )
         self._watchers: dict[str, object] = {}
         self._sources: dict[str, tuple[str, str]] = {}  # name -> (path, platform)
         self._lock = threading.Lock()  # reloads arrive on RPC threads
@@ -933,7 +940,7 @@ class ModelLifecycle:
             ),
             model_config=self._model_config,
             mesh=self._mesh,
-            tensor_parallel=cfg.tensor_parallel,
+            tensor_parallel=self._tensor_parallel,
             # Version swaps drop the swapped model's cached scores the
             # moment the registry flips (cache-plane generation hook) and
             # tick the quality plane's version-change counter (ISSUE 7 —
@@ -1016,7 +1023,10 @@ def _parse_model_server_config(path):
     return validate_model_config_entries(msc.model_config_list.config, str(path))
 
 
-def _start_model_config_watchers(cfg, model_configs, registry, batcher, model_config, mesh):
+def _start_model_config_watchers(
+    cfg, model_configs, registry, batcher, model_config, mesh,
+    tensor_parallel: bool | None = None,
+):
     """tensorflow_model_server's --model_config_file: one version watcher
     per model_config_list entry — multi-model serving over ONE registry/
     batcher/impl (the registry keys servables by name, the batcher jit
@@ -1030,7 +1040,10 @@ def _start_model_config_watchers(cfg, model_configs, registry, batcher, model_co
     manifest; SavedModel dirs infer or use the global [model] section), so
     heterogeneous models need self-describing artifacts.
     """
-    lifecycle = ModelLifecycle(cfg, registry, batcher, model_config, mesh)
+    lifecycle = ModelLifecycle(
+        cfg, registry, batcher, model_config, mesh,
+        tensor_parallel=tensor_parallel,
+    )
     lifecycle.apply(model_configs)
     return lifecycle
 
@@ -1185,6 +1198,7 @@ def build_stack(
     transport_config=None,
     recovery_config=None,
     kernels_config=None,
+    mesh_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -1220,9 +1234,47 @@ def build_stack(
     publisher, GET /lifecyclez, a `lifecycle` block in /monitoring, and
     dts_tpu_lifecycle_* Prometheus series — requires model_base_path
     (the watched dir IS the rollout mechanism) and an armed quality
-    plane (the rollback signal)."""
+    plane (the rollback signal).
+    mesh_config (the TOML [mesh] section, a utils.config.MeshConfig)
+    arms the MESH SERVING MODE (ISSUE 13): a ("data", "model") device
+    mesh over the slice's chips with a hardened ShardedExecutor as the
+    batcher's run_fn — candidate rows scattered over the data axis,
+    embedding vocab over the model axis per the family's named partition
+    rules, same wire protocol, one process spanning N chips. Mode
+    conflicts are EXPLICIT build-time refusals, never runtime surprises:
+    [kernels] (per-bucket kernel routing owns the single-chip
+    executables), [recovery] (REINIT rebuilds the batcher's executors,
+    not the mesh executor's), output_top_k (a single-chip jitted-entry
+    variant), and the legacy [server] mesh_devices knob (pick one
+    surface)."""
     # Validate plane prerequisites BEFORE any threads exist — a typo'd
     # config must leave nothing to tear down.
+    mesh_armed = mesh_config is not None and mesh_config.enabled
+    if mesh_armed:
+        if cfg.mesh_devices or cfg.model_parallel != 1 or cfg.tensor_parallel:
+            raise ValueError(
+                "[mesh] enabled conflicts with the legacy [server] mesh "
+                "knobs (mesh_devices/model_parallel/tensor_parallel): "
+                "configure the mesh in ONE place — the [mesh] section is "
+                "the serving mode; drop the [server] copies"
+            )
+        if cfg.output_top_k:
+            raise ValueError(
+                "[mesh] enabled conflicts with output_top_k: top-k "
+                "output compaction is a single-chip jitted-entry "
+                "variant the sharded executor does not provide — "
+                "disable one of them"
+            )
+        if recovery_config is not None and recovery_config.enabled:
+            raise ValueError(
+                "[mesh] enabled conflicts with [recovery]: the recovery "
+                "plane's REINIT rebuilds the single-chip batcher "
+                "executors, not the mesh executor's placed params and "
+                "sharded executables — quarantining a mesh replica would "
+                "replay onto a stale executor. Mesh replicas fail whole "
+                "and clients reroute via the scoreboard (the multihost "
+                "fail-fast contract); per-mesh recovery is future work"
+            )
     lifecycle_armed = lifecycle_config is not None and lifecycle_config.enabled
     if lifecycle_armed:
         if not model_base_path:
@@ -1256,7 +1308,33 @@ def build_stack(
     registry = ServableRegistry()
     run_fn = None
     mesh = None
-    if cfg.mesh_devices:
+    tensor_parallel = cfg.tensor_parallel
+    if mesh_armed:
+        # First-class mesh serving mode (ISSUE 13): [mesh] / --mesh.
+        from ..parallel import ShardedExecutor, make_mesh
+
+        n_devices = mesh_config.devices or len(jax.devices())
+        # The [mesh] section is AUTHORITATIVE for the layout (the legacy
+        # [server] knobs were refused above, so no silent OR-merge).
+        tensor_parallel = mesh_config.tensor_parallel
+        # make_mesh validates device availability and the
+        # devices/model_parallel factorization (explicit refusals).
+        mesh = make_mesh(n_devices, model_parallel=mesh_config.model_parallel)
+        run_fn = ShardedExecutor(
+            mesh,
+            compress_transfer=cfg.compress_transfer,
+            tensor_parallel=tensor_parallel,
+            output_wire_dtype=cfg.output_wire_dtype,
+        )
+        log.info(
+            "mesh serving mode on: %d devices as %s tensor_parallel=%s "
+            "wire=%s — `mesh` block in /monitoring, dts_tpu_mesh_* series",
+            n_devices, dict(mesh.shape), tensor_parallel,
+            cfg.output_wire_dtype,
+        )
+    elif cfg.mesh_devices:
+        # Legacy [server] mesh knobs (the dryrun/bench surface) — kept
+        # working unchanged; production deployments use [mesh].
         from ..parallel import ShardedExecutor, make_mesh
 
         mesh = make_mesh(cfg.mesh_devices, model_parallel=cfg.model_parallel)
@@ -1279,9 +1357,21 @@ def build_stack(
     )
     if utilization_ledger is not None:
         # Name the ledger's track after the real device (jax is already
-        # initialized by this point on every build_stack path).
+        # initialized by this point on every build_stack path). Over a
+        # mesh the ledger additionally attributes occupancy PER DEVICE:
+        # SPMD batches occupy every chip of the mesh simultaneously, so
+        # each device carries the busy timeline (snapshot per_device +
+        # one Perfetto counter track per chip).
         try:
-            utilization_ledger.device = str(jax.devices()[0])
+            if mesh is not None:
+                utilization_ledger.devices = [
+                    str(d) for d in mesh.devices.flat
+                ]
+                utilization_ledger.device = (
+                    f"mesh{dict(mesh.shape)}"
+                )
+            else:
+                utilization_ledger.device = str(jax.devices()[0])
         except Exception:  # noqa: BLE001 — a label, never a dependency
             pass
         log.info(
@@ -1309,12 +1399,12 @@ def build_stack(
     # inherit a previous stack's armed wire in the same process.
     kernel_manager = (kernels_config or _KernelsConfig()).build()
     if kernel_manager is not None:
-        if cfg.mesh_devices:
+        if cfg.mesh_devices or mesh_armed:
             raise ValueError(
                 "[kernels] enabled requires the single-chip batcher path: "
                 "the ShardedExecutor mirrors the int8 output wire but owns "
                 "its own executables (per-bucket kernel routing over a "
-                "mesh is future work)"
+                "mesh is future work) — disable [kernels] or [mesh]"
             )
         log.info(
             "kernel plane on: quantize=%s pallas=%s autotune=%s "
@@ -1384,6 +1474,13 @@ def build_stack(
         quality=quality_monitor,
     ).start()
     impl = PredictionServiceImpl(registry, batcher)
+    if run_fn is not None and hasattr(run_fn, "snapshot"):
+        # Mesh serving surface: /monitoring's `mesh` block and the
+        # dts_tpu_mesh_* Prometheus series read the executor's snapshot
+        # (geometry, per-device list, pad/batch counters, layout source)
+        # — wired for the legacy mesh knobs too, so the dryrun/bench
+        # surface reports identically.
+        impl.mesh_executor = run_fn
     if kernel_manager is not None:
         # Attach the kernel plane: the batcher consults the per-bucket
         # decision table at dispatch; /monitoring + Prometheus read
@@ -1436,7 +1533,8 @@ def build_stack(
 
     if model_configs is not None:
         watchers = _start_model_config_watchers(
-            cfg, model_configs, registry, batcher, model_config, mesh
+            cfg, model_configs, registry, batcher, model_config, mesh,
+            tensor_parallel=tensor_parallel,
         )
         # Runtime model-list reloads (HandleReloadConfigRequest) reconcile
         # through the same lifecycle object.
@@ -1487,7 +1585,7 @@ def build_stack(
             model_config=model_config
             or ModelConfig(name=cfg.model_name, num_fields=cfg.num_fields),
             mesh=mesh,
-            tensor_parallel=cfg.tensor_parallel,
+            tensor_parallel=tensor_parallel,
             on_servable_change=_servable_change_hook(
                 score_cache, quality_monitor
             ),
@@ -1552,7 +1650,7 @@ def build_stack(
     elif checkpoint:
         from ..train.checkpoint import load_servable
 
-        servable = load_servable(checkpoint, mesh=mesh, tensor_parallel=cfg.tensor_parallel)
+        servable = load_servable(checkpoint, mesh=mesh, tensor_parallel=tensor_parallel)
         registry.load(servable)
         log.info("loaded checkpoint %s: %s v%d", checkpoint, servable.name, servable.version)
     else:
@@ -1606,6 +1704,17 @@ def serve(argv=None) -> None:
     parser.add_argument("--num-fields", dest="num_fields", type=int)
     parser.add_argument("--max-workers", dest="max_workers", type=int)
     parser.add_argument("--max-wait-us", dest="max_wait_us", type=int)
+    parser.add_argument(
+        "--mesh", action="store_true", default=None,
+        help="mesh serving mode (ISSUE 13): shard serving over a "
+        "('data', 'model') device mesh — candidate rows over the data "
+        "axis, embedding vocab over the model axis, one process "
+        "spanning N chips behind the same wire protocol. Equivalent to "
+        "[mesh] enabled=true; with --mesh, --mesh-devices / "
+        "--model-parallel / --tensor-parallel configure the MESH "
+        "section (`mesh` block in /monitoring, dts_tpu_mesh_* series). "
+        "Refuses [kernels], [recovery], and output_top_k at build time",
+    )
     parser.add_argument("--mesh-devices", dest="mesh_devices", type=int)
     parser.add_argument("--model-parallel", dest="model_parallel", type=int)
     parser.add_argument(
@@ -1764,6 +1873,7 @@ def serve(argv=None) -> None:
         CacheConfig,
         KernelsConfig,
         LifecycleConfig,
+        MeshConfig,
         ObservabilityConfig,
         OverloadConfig,
         QualityConfig,
@@ -1810,6 +1920,26 @@ def serve(argv=None) -> None:
     kernels_config = cfgs.get("kernels") or KernelsConfig()
     if args.kernels:
         kernels_config = dataclasses.replace(kernels_config, enabled=True)
+    mesh_config = cfgs.get("mesh") or MeshConfig()
+    if args.mesh:
+        mesh_config = dataclasses.replace(mesh_config, enabled=True)
+    if mesh_config.enabled:
+        # With the mesh MODE armed, the CLI mesh-geometry flags configure
+        # the [mesh] section (and are withheld from the legacy [server]
+        # knobs below, which would otherwise trip the pick-one-surface
+        # refusal in build_stack).
+        mesh_overrides = {
+            k: v for k, v in {
+                "devices": args.mesh_devices,
+                "model_parallel": args.model_parallel,
+                "tensor_parallel": args.tensor_parallel,
+            }.items() if v is not None
+        }
+        if mesh_overrides:
+            mesh_config = dataclasses.replace(mesh_config, **mesh_overrides)
+        args.mesh_devices = None
+        args.model_parallel = None
+        args.tensor_parallel = None
     if lifecycle_config.enabled and not quality_config.enabled:
         # --lifecycle implies the quality plane it reads: arming the
         # actuator without its signal would fail build_stack's check, and
@@ -1876,6 +2006,7 @@ def serve(argv=None) -> None:
         transport_config=transport_config,
         recovery_config=recovery_config,
         kernels_config=kernels_config,
+        mesh_config=mesh_config,
     )
     if impl.lifecycle is not None:
         # The CLI server drives the controller with its background thread

@@ -123,6 +123,11 @@ class PredictionServiceImpl:
         # plus (scale, min) sidecar outputs. None (default) costs one
         # attribute read where consulted.
         self.kernels = None
+        # Mesh serving mode (ISSUE 13): the ShardedExecutor installed as
+        # the batcher's run_fn, when serving spans a device mesh.
+        # /monitoring's `mesh` block and the dts_tpu_mesh_* Prometheus
+        # series read its snapshot; None (default) = single-chip.
+        self.mesh_executor = None
         # Streamed sub-batch results (ISSUE 9): default server-side split
         # size (candidates per sub-batch) for PredictStream. 0 = no split
         # (one chunk per request — streaming stays wire-available but the
@@ -267,6 +272,46 @@ class PredictionServiceImpl:
         armed ([kernels] enabled=false)."""
         kern = self.kernels
         return kern.snapshot() if kern is not None else None
+
+    def mesh_stats(self, utilization: dict | None = None) -> dict | None:
+        """Mesh-mode snapshot (mesh geometry + device list, executor
+        batch/pad counters, layout source per served model, per-device
+        occupancy attribution when the utilization plane rides along) —
+        the `mesh` block in /monitoring and the dts_tpu_mesh_*
+        Prometheus series. None when serving is single-chip.
+
+        `utilization` (an already-computed utilization_stats() snapshot)
+        avoids recomputing the ledger's O(ring log ring) waterfall merge
+        when the caller renders both blocks in one pass (the Prometheus
+        scrape and the full /monitoring snapshot do)."""
+        ex = self.mesh_executor
+        if ex is None:
+            return None
+        snap = ex.snapshot()
+        ledger = getattr(self.batcher, "utilization", None)
+        if ledger is not None:
+            # The per-device attribution has ONE implementation — the
+            # ledger's own snapshot (OccupancyLedger.devices +
+            # per_device) — lifted here, never rebuilt: two copies of
+            # the spmd_uniform math would drift. An embedded ledger that
+            # was never device-labeled (build_stack labels it; direct
+            # construction may not) adopts the mesh's device list first
+            # (idempotent), which forces one fresh snapshot.
+            try:
+                usnap = utilization
+                if getattr(ledger, "devices", None) is None:
+                    ledger.devices = list(snap["devices"])
+                    usnap = None  # pre-label snapshot lacks per_device
+                if usnap is None:
+                    usnap = ledger.snapshot()
+                if usnap.get("per_device") is not None:
+                    snap["per_device"] = usnap["per_device"]
+                    snap["occupancy_attribution"] = usnap.get(
+                        "occupancy_attribution", "spmd_uniform"
+                    )
+            except Exception:  # noqa: BLE001 — telemetry, never a dependency
+                pass
+        return snap
 
     def versions_stats(self) -> dict | None:
         """Version-watcher snapshot (loaded versions, last reconcile

@@ -198,6 +198,13 @@ class OccupancyLedger:
         window_s: float = 60.0,
     ):
         self.device = device or "device:0"
+        # Mesh serving mode (ISSUE 13): the per-chip device list when
+        # the ledger attributes a MESH's occupancy. SPMD batches occupy
+        # every chip simultaneously, so each listed device carries the
+        # same busy timeline — snapshot() adds a per_device block and
+        # the Perfetto export emits one counter track per chip. None =
+        # single-device (the historical surface, unchanged).
+        self.devices: list[str] | None = None
         self.window_s = float(window_s)
         self._clock = clock
         self._lock = threading.Lock()
@@ -441,6 +448,13 @@ class OccupancyLedger:
 
     def snapshot(self, window_s: float | None = None) -> dict:
         wf = self.waterfall(window_s)
+        per_device = (
+            {
+                d: {"busy_fraction": wf["busy_fraction"]}
+                for d in self.devices
+            }
+            if self.devices else None
+        )
         with self._lock:
             gaps = {
                 c: {
@@ -453,7 +467,7 @@ class OccupancyLedger:
                 }
                 for c in GAP_CAUSES
             }
-            return {
+            out = {
                 "enabled": True,
                 "device": self.device,
                 "in_flight": self.in_flight,
@@ -466,6 +480,11 @@ class OccupancyLedger:
                 "idle_gaps": gaps,
                 "waterfall": wf,
             }
+        if per_device is not None:
+            out["devices"] = list(self.devices)
+            out["per_device"] = per_device
+            out["occupancy_attribution"] = "spmd_uniform"
+        return out
 
     def chrome_counter_events(self, t_base: float, pid: int) -> list[dict]:
         """Per-device counter track for the Perfetto export: an
@@ -480,22 +499,30 @@ class OccupancyLedger:
             edges.append((b[0], +1))
             edges.append((b[2], -1))
         edges.sort()
+        # Mesh mode: one counter track per chip (SPMD batches occupy all
+        # of them, so every track carries the same edge stream, named
+        # after its device); single-device mode keeps the one track.
+        tracks = list(self.devices) if self.devices else [self.device]
         events: list[dict] = [
             {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
              "args": {"name": "device-utilization"}},
-            {"ph": "M", "name": "thread_name", "pid": pid, "tid": 0,
-             "args": {"name": self.device}},
         ]
+        for tid, name in enumerate(tracks):
+            events.append(
+                {"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                 "args": {"name": name}}
+            )
         depth = 0
         last_ts = 0
         for t, step in edges:
             depth += step
             ts = max(last_ts, max(0, int((t - t_base) * 1e6)))
             last_ts = ts
-            events.append({
-                "ph": "C", "name": "occupancy", "pid": pid, "tid": 0,
-                "ts": ts, "args": {"in_flight": depth},
-            })
+            for tid in range(len(tracks)):
+                events.append({
+                    "ph": "C", "name": "occupancy", "pid": pid, "tid": tid,
+                    "ts": ts, "args": {"in_flight": depth},
+                })
         return events
 
 

@@ -1,12 +1,13 @@
 """Utilities: config, metrics, tracing."""
 
-from .config import ClientConfig, ServerConfig, load_config
+from .config import ClientConfig, MeshConfig, ServerConfig, load_config
 from .metrics import LatencyHistogram, ServerMetrics
 from .tracing import PhaseTrace, profile_trace, request_trace
 
 __all__ = [
     "ServerConfig",
     "ClientConfig",
+    "MeshConfig",
     "load_config",
     "LatencyHistogram",
     "ServerMetrics",

@@ -121,6 +121,15 @@ class ClientConfig:
     # UNAVAILABLE/DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED, up to this many
     # extra attempts (0 = the reference's fail-fast behavior).
     failover_attempts: int = 0
+    # Candidate-to-backend placement (ROADMAP 4a seed, ISSUE 13
+    # satellite). "contiguous" = the reference's positional split
+    # (DCNClient.java:46-55). "affinity" = rows route to backends by a
+    # consistent (jump) hash of each row's canonical feature digest
+    # (cache/digest.py row identity), so a hot candidate row always lands
+    # on the same replica's warm score cache instead of being re-scored
+    # everywhere; the scoreboard still steers a group away from its
+    # affine backend while that backend is ejected/busy/rebuilding.
+    placement: str = "contiguous"
     # Retry budget (ISSUE 11 satellite): cap on TOTAL backend attempts
     # per logical request across every shard's failover hops, hedges,
     # and streamed reroutes — one recovering/quarantined replica must
@@ -248,6 +257,53 @@ class TransportConfig:
                     "[transport] uds_path is a filesystem path, not a "
                     f"host:port or URI: {self.uds_path!r}"
                 )
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Mesh serving mode (ISSUE 13): shard serving over a ("data",
+    "model") device mesh — candidate rows split over the data axis,
+    embedding vocab over the model axis (parallel/mesh.py axis
+    conventions; DLRM-scale CTR models are embedding-dominated, so the
+    model axis is what lets a table that does not fit one chip serve at
+    all). Off by default: with the section absent serving is single-chip
+    and bit-identical to the pre-mesh stack.
+
+    Arming it installs a hardened parallel/executor.ShardedExecutor as
+    the batcher's run_fn: same wire protocol, same client semantics, one
+    process spanning N chips. Mode conflicts ([kernels], [recovery], the
+    legacy [server] mesh_devices knob, output_top_k) are refused at
+    build time — see build_stack."""
+
+    # Master switch: construct the mesh and install the ShardedExecutor.
+    enabled: bool = False
+    # Devices in the mesh; 0 = every visible device. Must be divisible by
+    # model_parallel (the ("data", "model") factorization).
+    devices: int = 0
+    # Chips sharding the embedding vocab (the EP axis); the rest of the
+    # factorization shards candidates. 1 = pure candidate sharding.
+    model_parallel: int = 1
+    # Also shard dense MLP/cross weights over the model axis (the TP row;
+    # embedding tables are vocab-sharded regardless).
+    tensor_parallel: bool = False
+
+    def __post_init__(self):
+        for name in ("devices", "model_parallel"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                raise ValueError(
+                    f"[mesh] {name} must be a non-negative integer, got {v!r}"
+                )
+        if self.model_parallel < 1:
+            raise ValueError(
+                f"[mesh] model_parallel must be >= 1, got {self.model_parallel!r}"
+            )
+        if self.devices and self.devices % self.model_parallel != 0:
+            raise ValueError(
+                f"[mesh] devices={self.devices} is not divisible by "
+                f"model_parallel={self.model_parallel} (the mesh is the "
+                "(devices/model_parallel, model_parallel) factorization)"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -726,6 +782,7 @@ def _model_config_cls():
 _SECTIONS = {
     "server": ServerConfig,
     "client": ClientConfig,
+    "mesh": MeshConfig,
     "batching": BatchingConfig,
     "transport": TransportConfig,
     "observability": ObservabilityConfig,

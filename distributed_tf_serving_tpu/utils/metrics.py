@@ -324,6 +324,13 @@ _HELP = {
     "dts_tpu_recovery_last_cycle_seconds":
         "Duration of the last completed quarantine->reinit->replay "
         "cycle (the live MTTR evidence)",
+    "dts_tpu_mesh_data_pad_rows_total":
+        "Zero rows the sharded executor added to make batches divisible "
+        "by the mesh data axis (sliced off on readback)",
+    "dts_tpu_mesh_device_busy_fraction":
+        "Per-device busy fraction over the utilization window (SPMD "
+        "attribution: every batch occupies all mesh chips, so each "
+        "device carries the ledger's busy timeline)",
 }
 
 
@@ -492,7 +499,7 @@ class ServerMetrics:
     def prometheus_text(
         self, batcher_stats=None, cache=None, overload=None,
         utilization=None, quality=None, lifecycle=None, pipeline=None,
-        recovery=None, kernels=None,
+        recovery=None, kernels=None, mesh=None,
     ) -> str:
         """Prometheus exposition (text format 0.0.4) of the same data
         snapshot() serves as JSON. Metric names mirror tensorflow_model_
@@ -780,6 +787,8 @@ class ServerMetrics:
             lines.extend(_recovery_prometheus_lines(recovery))
         if kernels is not None:
             lines.extend(_kernel_prometheus_lines(kernels))
+        if mesh is not None:
+            lines.extend(_mesh_prometheus_lines(mesh))
         return "\n".join(lines) + "\n"
 
 
@@ -1073,6 +1082,46 @@ def _kernel_prometheus_lines(kernels: dict) -> list[str]:
     if speed_lines:
         _family_lines(lines, "dts_tpu_kernel_variant_speedup", "gauge")
         lines.extend(speed_lines)
+    return lines
+
+
+def _mesh_prometheus_lines(mesh: dict) -> list[str]:
+    """dts_tpu_mesh_* exposition from a mesh_stats() snapshot (ISSUE 13):
+    mesh geometry gauges, executor batch/row/pad counters (the data-axis
+    divisibility pad made visible as ongoing work, not a startup fact),
+    and — when the utilization ledger rides along — the per-device
+    occupancy attribution gauge. Families grouped via _family_lines, so
+    the one-lint-covers-all invariant (tools/check_prom.py) holds."""
+    esc = escape_label_value
+    lines: list[str] = []
+    shape = mesh.get("shape") or {}
+    ex = mesh.get("executor") or {}
+    for metric, kind, value in (
+        ("dts_tpu_mesh_devices", "gauge", len(mesh.get("devices") or ())),
+        ("dts_tpu_mesh_data_parallel", "gauge", shape.get("data", 0)),
+        ("dts_tpu_mesh_model_parallel", "gauge", shape.get("model", 0)),
+        ("dts_tpu_mesh_tensor_parallel", "gauge",
+         1 if mesh.get("tensor_parallel") else 0),
+        ("dts_tpu_mesh_batches_total", "counter", ex.get("batches", 0)),
+        ("dts_tpu_mesh_rows_total", "counter", ex.get("rows", 0)),
+        ("dts_tpu_mesh_pad_batches_total", "counter",
+         ex.get("pad_batches", 0)),
+        ("dts_tpu_mesh_data_pad_rows_total", "counter",
+         ex.get("data_pad_rows", 0)),
+        ("dts_tpu_mesh_placed_servables", "gauge",
+         ex.get("placed_servables", 0)),
+    ):
+        _family_lines(lines, metric, kind)
+        lines.append(f"{metric} {value}")
+    per_device = mesh.get("per_device") or {}
+    if per_device:
+        bd = "dts_tpu_mesh_device_busy_fraction"
+        _family_lines(lines, bd, "gauge")
+        for device, blk in sorted(per_device.items()):
+            lines.append(
+                f'{bd}{{device="{esc(device)}"}} '
+                f'{blk.get("busy_fraction", 0.0)}'
+            )
     return lines
 
 

@@ -28,9 +28,9 @@ def _fully_armed_text() -> str:
     """Every plane emitting at once — the worst-case assembly the lint
     exists to guard: batcher gauges, cache, overload, utilization,
     quality, and lifecycle series next to the TF-Serving-named families,
-    with adversarial model names exercising the escaping path (now ten
-    planes: the ISSUE 12 kernel plane rides the same one-lint-covers-all
-    invariant)."""
+    with adversarial model names exercising the escaping path (now
+    eleven planes: the ISSUE 13 mesh plane rides the same
+    one-lint-covers-all invariant)."""
     from distributed_tf_serving_tpu.cache import ScoreCache
     from distributed_tf_serving_tpu.models import ServableRegistry
     from distributed_tf_serving_tpu.serving import lifecycle as lifecycle_mod
@@ -125,6 +125,25 @@ def _fully_armed_text() -> str:
             },
         }
     kern.quantized_batches = 7
+    # Mesh serving mode (ISSUE 13, the eleventh plane): the shape
+    # impl.mesh_stats() emits with the utilization ledger riding along —
+    # per-device busy gauges with an adversarial device label.
+    mesh = {
+        "enabled": True,
+        "shape": {"data": 4, "model": 2},
+        "devices": ["TFRT_CPU_0", 'cpu"we\\ird\n1'],
+        "tensor_parallel": True,
+        "executor": {
+            "batches": 11, "rows": 520, "pad_batches": 3,
+            "data_pad_rows": 6, "placed_servables": 1,
+            "layout": {"DCN": "rules:dcn_v2"},
+        },
+        "per_device": {
+            "TFRT_CPU_0": {"busy_fraction": 0.41},
+            'cpu"we\\ird\n1': {"busy_fraction": 0.41},
+        },
+        "occupancy_attribution": "spmd_uniform",
+    }
     return m.prometheus_text(
         stats,
         cache=cache.snapshot(),
@@ -135,6 +154,7 @@ def _fully_armed_text() -> str:
         pipeline=pipeline,
         recovery=recovery.snapshot(),
         kernels=kern.snapshot(),
+        mesh=mesh,
     )
 
 
@@ -149,6 +169,7 @@ def test_fully_armed_snapshot_passes_lint():
         "dts_tpu_pipeline_bucket_in_flight", "buffer_ring",
         "dts_tpu_recovery_", "dts_tpu_kernel_",
         "dts_tpu_kernel_variant_speedup",
+        "dts_tpu_mesh_", "dts_tpu_mesh_device_busy_fraction",
     ):
         assert marker in text
 

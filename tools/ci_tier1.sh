@@ -153,6 +153,22 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_KERNEL_SMOKE:-0}" = "1" ]; then
         python tools/check_kernel_smoke.py | tee "$KERNEL_LINE" || rc=1
 fi
 
+# Mesh smoke (TIER1_MESH_SMOKE=1): the ISSUE-13 serving-mode gate — the
+# same trained model served single-chip and over a {data: 4, model: 2}
+# mesh on 8 emulated CPU devices (the script forces
+# XLA_FLAGS=--xla_force_host_platform_device_count=8 itself) must return
+# BIT-IDENTICAL scores over real gRPC, with a deliberately
+# non-mesh-shaped bucket ladder exercising the data-axis divisibility
+# pad, and the live `mesh` monitoring block + dts_tpu_mesh_* Prometheus
+# series (incl. per-device occupancy attribution) answering over HTTP
+# (tools/check_mesh_smoke.py).
+if [ "$rc" -eq 0 ] && [ "${TIER1_MESH_SMOKE:-0}" = "1" ]; then
+    MESH_LINE="${TIER1_MESH_LINE:-/tmp/tier1_mesh_smoke.json}"
+    echo "tier1: mesh smoke (line $MESH_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python tools/check_mesh_smoke.py | tee "$MESH_LINE" || rc=1
+fi
+
 # Lifecycle smoke (TIER1_LIFECYCLE_SMOKE=1): a SOAK_LIFECYCLE=1 soak —
 # trained model behind a real version watcher + lifecycle controller;
 # the driver publishes a fine-tuned GOOD canary (must auto-promote) and
