@@ -1995,7 +1995,10 @@ def child_main() -> None:
             try:
                 batcher.max_batch_candidates = min(8192, batcher.buckets[-1])
                 pool_n = 64 if scale.tpu else 8
-                rpw = 40 if scale.tpu else 4
+                # Enough requests per pass that the qps comparisons (and
+                # the row-granular phase's goodput-vs-baseline) measure
+                # steady state, not connection warmup noise.
+                rpw = 40 if scale.tpu else 12
                 conc = scale.unique_concurrency
                 pool = make_zipfian_payloads(
                     pool_n, CANDIDATES, NUM_FIELDS, skew=skew, seed=11
@@ -2062,7 +2065,72 @@ def child_main() -> None:
                         np.array_equal(ref, miss) and np.array_equal(ref, hit)
                     ),
                 }
-                log(stage, json.dumps(res["cache"]))
+                # Row-granular phase (ISSUE 14): the IDENTICAL stream once
+                # more with the row cache armed BEHIND the whole-request
+                # cache (the deployment shape: a full hit never reaches
+                # the row path; distinct payloads sharing hot rows execute
+                # only their cold rows). Reports rows_executed vs
+                # rows_requested, the per-row hit rate, goodput vs the
+                # PR-4 whole-request baseline measured just above, and a
+                # flush->miss->hit bit-identity probe against the DISARMED
+                # plane (ROADMAP item 4's stated gate).
+                from distributed_tf_serving_tpu.cache import RowScoreCache
+
+                stage = "rowcache_skew"
+                rowc = RowScoreCache(ttl_s=600.0)
+                r_req0 = batcher.stats.rows_requested
+                r_exec0 = batcher.stats.rows_executed
+                cache.flush()
+                batcher.score_cache, batcher.dedup = cache, True
+                batcher.row_cache = rowc
+                try:
+                    log(stage, "row-granular pass (identical stream)")
+                    rep_row = await skew_loop()
+                    probe = pool[int(sched[0])]
+                    async with ShardedPredictClient(
+                        [f"127.0.0.1:{port}"], "DCN", channels_per_host=1,
+                    ) as client:
+                        batcher.score_cache, batcher.dedup = None, False
+                        batcher.row_cache = None
+                        row_ref = await client.predict(probe, sort_scores=True)
+                        batcher.row_cache = rowc
+                        rowc.flush()
+                        row_miss = await client.predict(probe, sort_scores=True)
+                        row_hit = await client.predict(probe, sort_scores=True)
+                    rsnap = rowc.snapshot()
+                finally:
+                    batcher.score_cache, batcher.dedup = None, False
+                    batcher.row_cache = None
+                rows_req = batcher.stats.rows_requested - r_req0
+                rows_exec = batcher.stats.rows_executed - r_exec0
+                qps_row = rep_row.summary()["qps"]
+                qps_request_baseline = rep_on.summary()["qps"]
+                res["cache"]["row_cache"] = {
+                    "qps_row_on": round(qps_row, 1),
+                    "p50_ms_row_on": round(rep_row.summary()["p50_ms"], 3),
+                    "qps_vs_request_cache": round(
+                        qps_row / max(qps_request_baseline, 1e-9), 3
+                    ),
+                    "rows_requested": int(rows_req),
+                    "rows_executed": int(rows_exec),
+                    "rows_executed_fraction": round(
+                        rows_exec / max(rows_req, 1), 4
+                    ),
+                    "row_hits": rsnap["hits"],
+                    "row_coalesced": rsnap["coalesced"],
+                    "row_hit_rate": rsnap["hit_rate"],
+                    "row_full_hit_batches": (
+                        batcher.stats.row_full_hit_batches
+                    ),
+                    "scores_bit_identical": bool(
+                        np.array_equal(row_ref, row_miss)
+                        and np.array_equal(row_ref, row_hit)
+                    ),
+                }
+                log(stage, json.dumps(res["cache"]["row_cache"]))
+                log(stage, json.dumps({
+                    k: v for k, v in res["cache"].items() if k != "row_cache"
+                }))
             finally:
                 await server.stop(0)
 

@@ -6,10 +6,13 @@ local cache."""
 from .digest import canonical_rows, features_digest, rows_as_bytes
 from .dedup import collapse_rows
 from .score_cache import CacheHandle, CoalescedLeaderCancelled, ScoreCache
+from .row_cache import RowBatchPlan, RowScoreCache
 
 __all__ = [
     "CacheHandle",
     "CoalescedLeaderCancelled",
+    "RowBatchPlan",
+    "RowScoreCache",
     "ScoreCache",
     "canonical_rows",
     "collapse_rows",

@@ -364,6 +364,31 @@ class ScoreCache:
                 except InvalidStateError:
                     pass
 
+    def fail_flights(self, exc: BaseException) -> int:
+        """Pop EVERY registered in-flight map entry and fail its waiters
+        with `exc` — the quarantine-capture hook (serving/recovery.py):
+        when the device is torn down for rebuild, the leaders of these
+        flights may be stranded in wedged threads that never unwind, so
+        nothing else would ever close them; a foreign (or future) request
+        joining a zombie flight would hang to its deadline. Stranded
+        leaders that DO eventually complete resolve only their own
+        plan/handle waiter lists — already failed here, InvalidStateError
+        guarded. Returns the number of waiters failed."""
+        with self._flight_lock:
+            flights = list(self._flights.values())
+            self._flights.clear()
+        failed = 0
+        for fl in flights:
+            for w in fl.waiters:
+                if w.cancelled():
+                    continue
+                try:
+                    w.set_exception(exc)
+                    failed += 1
+                except InvalidStateError:
+                    pass
+        return failed
+
     # -------------------------------------------------------- invalidation
 
     def invalidate_model(self, model: str) -> int:

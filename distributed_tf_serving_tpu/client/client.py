@@ -222,10 +222,19 @@ class PreparedRequest:
     ~10% of the whole request budget (round-3 profile: 220 us of 2.4 ms).
     prepare() hoists it out of the loop; predict_prepared() sends the cached
     bytes through the raw-bytes stub. The wire bytes are identical to
-    predict()'s."""
+    predict()'s.
+
+    Under placement="affinity" (ISSUE 14 satellite) the blobs are the
+    per-HOME row groups instead of the contiguous split: `homes[i]` is
+    blob i's affine backend and `index_groups[i]` its original row
+    indices, so predict_prepared scatters the merged scores back into
+    candidate order exactly like predict() does. Both None = the
+    contiguous split (positional shard i -> host i)."""
 
     shard_blobs: list[bytes]
     candidates: int
+    homes: "tuple[int, ...] | None" = None
+    index_groups: "tuple | None" = None
 
 
 # Failures worth rerouting to another backend: the host is down/slow/
@@ -430,11 +439,14 @@ class ShardedPredictClient:
         # machinery, so the scoreboard still steers a group away while
         # its home is ejected/busy/rebuilding, and results scatter back
         # into the original candidate order (bit-identical to the
-        # contiguous split's merge). SEED SCOPE (ROADMAP 4a): predict()
-        # routes by affinity; predict_streamed()/prepare() keep the
-        # contiguous split (their chunk/offset machinery assumes
-        # contiguous shard ranges — row-granular caching, 4a(b), is the
-        # follow-up that makes affinity pay there).
+        # contiguous split's merge). Covers EVERY client entry point
+        # (ISSUE 14 satellite — the server's row-granular cache is what
+        # the routing warms): predict() routes groups live,
+        # predict_streamed() streams each group from its home (chunk
+        # offsets are group-relative, so the offset-scatter merge
+        # composes unchanged), and prepare()/predict_prepared() serialize
+        # per-group blobs with their homes + row indices pinned on the
+        # PreparedRequest.
         self.placement = placement
         # int8 score response wire (ISSUE 12): opt into DT_INT8 score
         # tensors (+ scale/min sidecar outputs, dequantized locally) via
@@ -1159,48 +1171,60 @@ class ShardedPredictClient:
                    "shards": len(groups), "placement": "affinity"},
         ):
             budget = self._new_budget(len(groups))
-            results = await asyncio.gather(
-                *(
+            return await self._affinity_gather(
+                [idx for _h, idx, _s in groups],
+                [
                     self._predict_shard(host, sub, rr, budget)
                     for host, _idx, sub in groups
-                ),
-                return_exceptions=True,
+                ],
+                n, sort_scores,
             )
-            if not self.partial_results:
-                for r in results:
-                    if isinstance(r, BaseException):
-                        raise r
-            failed = set(self._screen_shard_failures(results))
-            ok = [
-                (groups[k][1], np.asarray(results[k]))
-                for k in range(len(results)) if k not in failed
-            ]
-            with tracing.start_span(
-                "client.merge",
-                attrs={"degraded": True} if failed else None,
-            ):
-                idx = np.concatenate([i for i, _v in ok])
-                vals = np.concatenate([v for _i, v in ok])
-                if failed:
-                    # Surviving rows in candidate order (the degraded-
-                    # merge contract: a shorter vector + missing_ranges).
-                    merged = vals[np.argsort(idx, kind="stable")]
-                else:
-                    merged = np.empty((n,) + vals.shape[1:], vals.dtype)
-                    merged[idx] = vals
-                if sort_scores:
-                    merged = np.sort(merged)
-            if not failed:
-                if self.partial_results:
-                    return PredictResult(scores=merged)
-                return merged
-            missing = index_runs(
-                np.concatenate([groups[k][1] for k in sorted(failed)])
-            )
-            self._note_degraded_merge(missing)
-            return PredictResult(
-                scores=merged, missing_ranges=missing, degraded=True,
-            )
+
+    async def _affinity_gather(
+        self, index_groups: list, coros: list, n: int, sort_scores: bool
+    ) -> "np.ndarray | PredictResult":
+        """ONE gather+scatter implementation for every affinity entry
+        point (predict / predict_streamed / predict_prepared): await the
+        per-group coroutines concurrently, scatter each group's scores
+        back by its original row indices (bit-identical to the
+        contiguous split's merge), and in partial-results mode degrade a
+        lost group into scattered missing_ranges runs."""
+        results = await asyncio.gather(*coros, return_exceptions=True)
+        if not self.partial_results:
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
+        failed = set(self._screen_shard_failures(results))
+        ok = [
+            (index_groups[k], np.asarray(results[k]))
+            for k in range(len(results)) if k not in failed
+        ]
+        with tracing.start_span(
+            "client.merge",
+            attrs={"degraded": True} if failed else None,
+        ):
+            idx = np.concatenate([i for i, _v in ok])
+            vals = np.concatenate([v for _i, v in ok])
+            if failed:
+                # Surviving rows in candidate order (the degraded-
+                # merge contract: a shorter vector + missing_ranges).
+                merged = vals[np.argsort(idx, kind="stable")]
+            else:
+                merged = np.empty((n,) + vals.shape[1:], vals.dtype)
+                merged[idx] = vals
+            if sort_scores:
+                merged = np.sort(merged)
+        if not failed:
+            if self.partial_results:
+                return PredictResult(scores=merged)
+            return merged
+        missing = index_runs(
+            np.concatenate([index_groups[k] for k in sorted(failed)])
+        )
+        self._note_degraded_merge(missing)
+        return PredictResult(
+            scores=merged, missing_ranges=missing, degraded=True,
+        )
 
     # ------------------------------------------------- streamed Predict
 
@@ -1294,11 +1318,35 @@ class ShardedPredictClient:
         shard's failover chain exhausts. `chunk` overrides the
         per-sub-batch candidate count (None = this client's
         stream_chunk_candidates, 0 = the server's configured default).
-        First-scores latency per shard lands in stream_stats()."""
-        shards = shard_candidates(arrays, len(self.hosts))
+        First-scores latency per shard lands in stream_stats().
+
+        Under placement="affinity" each row GROUP streams from its home
+        backend (ISSUE 14 satellite — the warm-cache routing covers the
+        streamed path too): chunk offsets are relative to the group's own
+        request, so the per-shard offset-scatter merge composes
+        unchanged, and the merged groups scatter back into candidate
+        order exactly like predict()."""
         self._rr += 1
         rr = self._rr
         n = next(iter(arrays.values())).shape[0]
+        if self.placement == "affinity" and len(self.hosts) > 1:
+            groups = affinity_groups(arrays, len(self.hosts))
+            with tracing.start_root(
+                "client.predict",
+                attrs={"model": self.model_name, "candidates": n,
+                       "shards": len(groups), "streamed": True,
+                       "placement": "affinity"},
+            ):
+                budget = self._new_budget(len(groups))
+                return await self._affinity_gather(
+                    [idx for _h, idx, _s in groups],
+                    [
+                        self._predict_shard_stream(host, sub, rr, chunk, budget)
+                        for host, _idx, sub in groups
+                    ],
+                    n, sort_scores,
+                )
+        shards = shard_candidates(arrays, len(self.hosts))
         bounds = (
             partition_bounds(n, len(shards)) if self.partial_results else None
         )
@@ -1319,10 +1367,14 @@ class ShardedPredictClient:
 
     def prepare(self, arrays: dict[str, np.ndarray]) -> PreparedRequest:
         """Shard + build + serialize once; returns the reusable wire bytes
-        for predict_prepared (see PreparedRequest)."""
-        shards = shard_candidates(arrays, len(self.hosts))
-        blobs = [
-            build_predict_request(
+        for predict_prepared (see PreparedRequest). Under
+        placement="affinity" the split is the per-home row grouping
+        (ISSUE 14 satellite): each blob carries one backend's affine rows
+        with its home + original row indices pinned on the result, so the
+        prepared-bytes path routes rows to warm caches too."""
+
+        def _blob(s: dict) -> bytes:
+            return build_predict_request(
                 s,
                 self.model_name,
                 self.signature_name,
@@ -1330,10 +1382,18 @@ class ShardedPredictClient:
                 version_label=self.version_label,
                 use_tensor_content=self.use_tensor_content,
             ).SerializeToString()
-            for s in shards
-        ]
+
         n = next(iter(arrays.values())).shape[0]
-        return PreparedRequest(shard_blobs=blobs, candidates=n)
+        if self.placement == "affinity" and len(self.hosts) > 1:
+            groups = affinity_groups(arrays, len(self.hosts))
+            return PreparedRequest(
+                shard_blobs=[_blob(sub) for _h, _idx, sub in groups],
+                candidates=n,
+                homes=tuple(h for h, _idx, _s in groups),
+                index_groups=tuple(idx for _h, idx, _s in groups),
+            )
+        shards = shard_candidates(arrays, len(self.hosts))
+        return PreparedRequest(shard_blobs=[_blob(s) for s in shards], candidates=n)
 
     async def _predict_shard_raw(
         self, i: int, blob: bytes, rr: int, budget=None
@@ -1351,9 +1411,29 @@ class ShardedPredictClient:
     ) -> "np.ndarray | PredictResult":
         """predict() over pre-serialized shard bytes: identical wire traffic
         and merge/sort semantics (including partial-results degradation),
-        none of the per-call build+serialize."""
+        none of the per-call build+serialize. An affinity-prepared request
+        (prepare() under placement="affinity") sends each blob to its
+        pinned home backend and scatters the scores back by the pinned row
+        indices — the warm-cache routing covers the prepared path too."""
         self._rr += 1
         rr = self._rr
+        if prep.homes is not None:
+            with tracing.start_root(
+                "client.predict",
+                attrs={"model": self.model_name,
+                       "candidates": prep.candidates,
+                       "shards": len(prep.shard_blobs), "prepared": True,
+                       "placement": "affinity"},
+            ):
+                budget = self._new_budget(len(prep.shard_blobs))
+                return await self._affinity_gather(
+                    list(prep.index_groups),
+                    [
+                        self._predict_shard_raw(home, b, rr, budget)
+                        for home, b in zip(prep.homes, prep.shard_blobs)
+                    ],
+                    prep.candidates, sort_scores,
+                )
         bounds = (
             partition_bounds(prep.candidates, len(prep.shard_blobs))
             if self.partial_results

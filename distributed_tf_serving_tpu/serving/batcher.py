@@ -60,7 +60,8 @@ import numpy as np
 
 from .. import faults
 from . import overload as overload_mod
-from ..cache import collapse_rows
+from ..cache import CoalescedLeaderCancelled, collapse_rows
+from ..cache.digest import canonical_rows
 from ..models.base import Model
 from ..models.registry import Servable
 from ..ops.transfer import (
@@ -477,6 +478,93 @@ class _HostBufferRing:
             }
 
 
+class _RowContext:
+    """One batch's row-granular cache consultation (ISSUE 14): the
+    RowBatchPlan plus the index machinery that turns (cold device rows +
+    cached hot rows + foreign in-flight fills) back into every request's
+    original row order.
+
+    - `inverse` maps each ORIGINAL row onto its execution-planning slot
+      (identity when dedup found no duplicates); `lead_slots` are the
+      slots this batch executes, in execution order, so cold row j of the
+      device output is slot `lead_slots[j]`.
+    - `passthrough` marks the degenerate plan — every row cold, no
+      duplicates, no foreign flights to join — where execution covers the
+      original batch in original order: the normal pad/fused/delivery
+      paths serve it unchanged and only the cache fill rides along.
+    """
+
+    __slots__ = ("cache", "plan", "overload", "n_slots", "inverse",
+                 "lead_slots", "n_cold", "exec_arrays", "passthrough",
+                 "all_fresh")
+
+    def fill_from_host(self, host: dict) -> None:
+        """Close the plan's lead flights from the executed rows: fill the
+        cache (same-generation only) and resolve every foreign waiter
+        riding them. host arrays are post-readback, post-widen,
+        post-sidecar-consume — exactly what delivery slices, so a later
+        cache assembly is bit-identical to this execution."""
+        values = {}
+        for j, slot in enumerate(self.plan.lead):
+            values[slot] = {
+                k: np.array(v[j], copy=True) for k, v in host.items()
+            }
+        self.cache.complete_rows(self.plan, values)
+
+    def abort(self, exc: BaseException) -> None:
+        self.cache.abort_rows(self.plan, exc)
+
+    def assemble(self, host: dict | None):
+        """Full-batch outputs in ORIGINAL row order from the three row
+        sources (executed / cached hit / foreign fill). Returns (full,
+        failed_rows, row_errors): failed_rows is a bool mask over
+        original rows whose foreign fill failed (their requests get the
+        error, never a garbage score), row_errors maps failed slots to
+        their exceptions. host None = the zero-cold batch."""
+        plan = self.plan
+        failed: dict[int, BaseException] = {}
+        wvals: dict[int, dict] = {}
+        for slot, fut in plan.waiters.items():
+            if fut.cancelled():
+                failed[slot] = CoalescedLeaderCancelled(
+                    "row fill leader was cancelled before completing"
+                )
+                continue
+            exc = fut.exception()
+            if exc is not None:
+                failed[slot] = exc
+            else:
+                wvals[slot] = fut.result()
+        if host is not None:
+            sample = {k: v[0] for k, v in host.items()}
+        elif plan.hits:
+            sample = next(iter(plan.hits.values()))
+        elif wvals:
+            sample = next(iter(wvals.values()))
+        else:
+            # Every slot rode a foreign flight and every one failed.
+            raise next(iter(failed.values()))
+        full = {}
+        for k, v in sample.items():
+            arr = np.asarray(v)
+            # zeros, not empty: a failed slot's rows are never delivered,
+            # but uninitialized memory must not be reachable even by bug.
+            vals = np.zeros((self.n_slots,) + arr.shape, arr.dtype)
+            if host is not None and self.n_cold:
+                vals[self.lead_slots] = host[k][: self.n_cold]
+            for slot, hv in plan.hits.items():
+                vals[slot] = hv[k]
+            for slot, wv in wvals.items():
+                vals[slot] = wv[k]
+            full[k] = vals[self.inverse]
+        failed_rows = None
+        if failed:
+            failed_rows = np.isin(
+                self.inverse, np.fromiter(failed.keys(), np.int64)
+            )
+        return full, failed_rows, failed
+
+
 @dataclasses.dataclass
 class _WorkItem:
     servable: Servable
@@ -549,6 +637,15 @@ class BatcherStats:
     # padded/uploaded/executed because of it (effective-batch shrink).
     dedup_batches: int = 0
     dedup_rows_collapsed: int = 0
+    # Row-granular score cache (cache/row_cache.py, ISSUE 14): batches
+    # that went through cold-row extraction, the rows they asked for vs
+    # the rows actually dispatched to the device, and batches answered
+    # entirely from cache (zero device work). rows_executed ≪
+    # rows_requested is the plane's headline claim at zipfian skew.
+    row_batches: int = 0
+    rows_requested: int = 0
+    rows_executed: int = 0
+    row_full_hit_batches: int = 0
     # Queued items shed because their propagated client deadline expired
     # before a dispatch slot opened (deadline propagation, ISSUE 2).
     deadline_sheds: int = 0
@@ -621,6 +718,7 @@ class DynamicBatcher:
         pipelined_dispatch: bool = True,
         donate_buffers: bool = True,
         score_cache=None,
+        row_cache=None,
         dedup: bool = False,
         overload=None,
         utilization=None,
@@ -676,6 +774,15 @@ class DynamicBatcher:
         # score_cache is None / dedup False the hot path pays one attribute
         # read per submit/dispatch — the tracing/faults precedent.
         self.score_cache = score_cache
+        # Row-granular score cache (cache/row_cache.py, ISSUE 14): after
+        # collect, each batch's rows are digested and looked up per row —
+        # hot rows answer from cache, ONLY the cold rows are packed,
+        # bucketed, and dispatched (possibly a smaller bucket), and the
+        # completer scatters device + cached scores back into every
+        # request's slice. The whole-request cache above stays in front
+        # (a full hit never reaches this plane). None (default) costs one
+        # attribute read per batch.
+        self.row_cache = row_cache
         self.dedup = bool(dedup)
         # Output-transfer pipeline knobs (utils/config.py ServerConfig
         # carries the same names). wire dtype is validated HERE so a typo'd
@@ -1160,6 +1267,26 @@ class DynamicBatcher:
 
             retry.add_done_callback(chain)
             return
+        degraded = getattr(fut, "dts_degraded", None)
+        if degraded is not None:
+            # The leader's response was assembled with brownout-STALE row
+            # entries (row plane, ISSUE 14): it must never fill the
+            # whole-request cache — a fresh-TTL entry would keep serving
+            # past-TTL data unmarked long after the brownout clears — and
+            # every coalesced waiter inherits the degraded marker with
+            # the result (the service forwards it per future).
+            waiters = cache.take_waiters(handle)
+            if waiters:
+                result = fut.result()
+                for w in waiters:
+                    if w.cancelled():
+                        continue
+                    w.dts_degraded = degraded
+                    try:
+                        w.set_result(result)
+                    except InvalidStateError:
+                        pass
+            return
         with request_trace.span("cache.fill"):
             cache.complete(handle, fut)
 
@@ -1356,6 +1483,20 @@ class DynamicBatcher:
             self._dispatching_since = None
             self._dispatch_pending = 0
             self._cv.notify_all()
+        rc = self.row_cache
+        if rc is not None:
+            # Close EVERY in-flight row fill: the leaders of these flights
+            # may be stranded in wedged threads the pool replacement
+            # abandons (never unwinding through the abort paths), and a
+            # foreign — or future — batch joining such a zombie flight
+            # would hang to its deadline on a fill that can never land.
+            # Replayed batches re-plan their rows fresh; the failed
+            # waiters' clients failover on UNAVAILABLE like any
+            # quarantine refusal.
+            rc.fail_flights(DeviceQuarantinedError(
+                "replica quarantined: in-flight row fills abandoned "
+                "(the replayed batches re-plan their rows)"
+            ))
         return queued, inflight
 
     def requeue_for_replay(self, items: list) -> None:
@@ -2077,6 +2218,7 @@ class DynamicBatcher:
             ring_bufs.append(buf)
             return buf
 
+        row_ctx: _RowContext | None = None
         try:
             bucket = bucket_for(total, self.buckets)
             first = group[0]
@@ -2114,7 +2256,53 @@ class DynamicBatcher:
             # wrong bucket).
             scatter = None
             dedup_cats = None
+            # Row-granular score cache (ISSUE 14): digest + look up every
+            # row after collect, pack/dispatch only the cold ones. The
+            # plan subsumes the dedup block below (its unique-collapse
+            # runs inside _plan_rows when [cache] dedup is armed, and
+            # intra-batch duplicates additionally coalesce onto one row
+            # flight), so exactly one of the two paths runs per batch.
+            # Top-k batches are excluded (the returned indices address
+            # original rows) and warmup groups (all-zero rows would
+            # collapse and poison the cache with compile traffic).
+            rc = self.row_cache
             if (
+                rc is not None
+                and not topk
+                and not any(it.warmup for it in group)
+            ):
+                with (tracing.collect_phases(phases) if phases is not None
+                      else _NULL_CTX), request_trace.span("cache.row_lookup"):
+                    row_ctx = self._plan_rows(rc, group, total, wanted_key)
+                self.stats.row_batches += 1
+                self.stats.rows_requested += total
+                self.stats.rows_executed += row_ctx.n_cold
+                if row_ctx.n_cold == 0:
+                    # Every row answered from cache (or a foreign
+                    # in-flight fill): no device work at all. Delivery
+                    # rides a completer so the batching thread never
+                    # blocks on another batch's fill.
+                    self.stats.row_full_hit_batches += 1
+                    if phases is not None:
+                        _replay_group_phases(group, phases)
+                    self._completers.submit(
+                        self._complete_rows_only, group, row_ctx
+                    ).add_done_callback(
+                        lambda f, g=group: self._guard_worker_future(
+                            f, g, "completer"
+                        )
+                    )
+                    return
+                if row_ctx.passthrough:
+                    # Every row cold and distinct: execution covers the
+                    # original batch in original order — the normal
+                    # pad/fused paths serve it from the concat the plan
+                    # already built; only the fill rides along.
+                    dedup_cats = row_ctx.exec_arrays
+                else:
+                    bucket = bucket_for(row_ctx.n_cold, self.buckets)
+                    dedup_cats = row_ctx.exec_arrays
+            elif (
                 self.dedup
                 and not topk
                 and total > 1
@@ -2192,6 +2380,10 @@ class DynamicBatcher:
         except Exception as exc:  # assembly failed: fail the group, keep serving
             if ring is not None and ring_bufs:
                 ring.release(ring_bufs)
+            if row_ctx is not None:
+                # Close the plan's row flights: foreign batches waiting on
+                # this batch's cold rows fail now instead of hanging.
+                row_ctx.abort(exc)
             for it in group:
                 if not it.future.done():
                     it.future.set_exception(exc)
@@ -2200,6 +2392,7 @@ class DynamicBatcher:
             self._run_stage(
                 None, group, total, bucket, wanted, wanted_key,
                 topk, n_valid, fused, batched, phases, scatter, ring_bufs,
+                row_ctx,
             )
             return
         with self._cv:
@@ -2211,6 +2404,7 @@ class DynamicBatcher:
         self._dispatcher.submit(
             self._run_stage, sid, group, total, bucket, wanted, wanted_key,
             topk, n_valid, fused, batched, phases, scatter, ring_bufs,
+            row_ctx,
         ).add_done_callback(
             # Thread-death guard: _run_stage catches Exception broadly,
             # so only a BaseException (or a bug in its own finally) can
@@ -2232,6 +2426,216 @@ class DynamicBatcher:
             ):
                 self._cv.wait(0.005)
 
+    def _plan_rows(
+        self, rc, group: list[_WorkItem], total: int,
+        wanted_key: tuple | None,
+    ) -> _RowContext:
+        """Row-granular cache consultation for one collected batch: build
+        the concatenated batch, digest each row (dedup-unique rows only
+        when [cache] dedup is armed — the collapse_rows machinery
+        generalized), and classify every slot hit / foreign-flight waiter
+        / cold. The returned context carries the gathered COLD rows as
+        the batch to execute and the inverse map the completer scatters
+        through. Runs on the batcher thread (the dedup precedent); the
+        per-row blake2b digests are the plane's host cost, paid only
+        while it is armed."""
+        from ..cache.row_cache import digest_rows, row_structure_header
+
+        first = group[0]
+        # np.concatenate widens mixed dtypes exactly like the pad loop
+        # (an int64 wire request coalesced with a pre-folded int32 direct
+        # submit), so row identity is over the bytes the device would see.
+        cats = {
+            k: (np.concatenate([it.arrays[k] for it in group])
+                if len(group) > 1 else first.arrays[k])
+            for k in first.arrays
+        }
+        blob = canonical_rows(cats)
+        header = row_structure_header(cats)
+        digests_all = digest_rows(blob, header)
+        uniq_rows = None
+        inverse = None
+        if self.dedup and total > 1:
+            # Duplicate collapse by DIGEST, not by the raw 300+-byte row
+            # blob: the cache keys rows by this digest anyway (so
+            # digest-equal IS the plane's identity — collapsing by it
+            # adds no failure mode the keying doesn't already have), and
+            # np.unique over 16-byte rows is ~24x cheaper than over the
+            # full canonical bytes (1.5 ms vs 36 ms at a 1.5k x 43
+            # batch) — the row plane's collapse is CHEAPER than
+            # collapse_rows, not dearer.
+            darr = np.frombuffer(b"".join(digests_all), np.uint8)
+            _, first_idx, inv = np.unique(
+                darr.reshape(total, 16), axis=0,
+                return_index=True, return_inverse=True,
+            )
+            if first_idx.shape[0] < total:
+                uniq_rows = first_idx
+                inverse = inv.reshape(-1).astype(np.int64)
+                self.stats.dedup_batches += 1
+                self.stats.dedup_rows_collapsed += total - first_idx.shape[0]
+        if uniq_rows is None:
+            uniq_rows = np.arange(total, dtype=np.int64)
+            inverse = uniq_rows
+        digests = (
+            digests_all if uniq_rows.shape[0] == total
+            else [digests_all[i] for i in uniq_rows]
+        )
+        ov = self.overload
+        # Brownout stale-serve extends to row entries: while pressure is
+        # past NOMINAL, an expired row still answers (marked degraded at
+        # delivery, never re-filled) — the whole-request stale-serve
+        # contract at row granularity.
+        stale_s = (
+            ov.stale_window_s
+            if ov is not None and ov.stale_serve_active()
+            else 0.0
+        )
+        servable = first.servable
+        plan = rc.begin_rows(
+            servable.name, servable.version, wanted_key, digests,
+            stale_s=stale_s,
+        )
+        try:
+            ctx = _RowContext()
+            ctx.cache = rc
+            ctx.plan = plan
+            ctx.overload = ov
+            ctx.n_slots = len(digests)
+            ctx.inverse = inverse
+            ctx.lead_slots = np.asarray(plan.lead, dtype=np.int64)
+            ctx.n_cold = len(plan.lead)
+            ctx.passthrough = ctx.n_cold == ctx.n_slots == total
+            # All slots executed fresh by THIS batch (no cached rows, no
+            # foreign flights): delivery can ride the normal completer
+            # tail — including the quality feed — via a plain inverse
+            # scatter, exactly like the dedup path it subsumes.
+            ctx.all_fresh = not plan.hits and not plan.waiters
+            if ctx.passthrough:
+                # Execution == the original batch: pad/fuse straight from
+                # the concat this plan already built (never a second
+                # concat).
+                ctx.exec_arrays = cats
+            elif ctx.n_cold:
+                rows = uniq_rows[ctx.lead_slots]
+                ctx.exec_arrays = {
+                    k: np.ascontiguousarray(v[rows]) for k, v in cats.items()
+                }
+            else:
+                ctx.exec_arrays = None
+            rc.note_rows(servable.name, total, ctx.n_cold)
+        except BaseException as exc:
+            # The flights begin_rows registered must not outlive a failed
+            # plan — a foreign batch joining them later would hang on a
+            # fill that can never land (begin_rows' own atomicity guard
+            # covers only its internal loop).
+            rc.abort_rows(plan, exc)
+            raise
+        return ctx
+
+    def _complete_rows_only(self, group: list[_WorkItem], row_ctx) -> None:
+        """Completer task for a batch with ZERO cold rows: assemble every
+        request's outputs from cached hits and foreign in-flight fills —
+        the device, the bucket ladder, and the dispatch pipeline are
+        never touched."""
+        self._finish_row_batch(group, row_ctx, None)
+
+    def _finish_row_batch(
+        self, group: list[_WorkItem], row_ctx, host: dict | None
+    ) -> None:
+        """Deliver a row-cache batch once every foreign fill it joined has
+        resolved. Never blocks a completer thread: when foreign waiters
+        are still in flight, delivery re-enters from the LAST waiter's
+        done-callback (on the resolving leader's thread) — deadlock-free
+        by construction, whatever the completer pool's size."""
+        pending = [f for f in row_ctx.plan.waiters.values() if not f.done()]
+        if not pending:
+            self._deliver_row_batch(group, row_ctx, host)
+            return
+        lock = threading.Lock()
+        state = {"left": len(pending)}
+
+        def _on_done(_f):
+            with lock:
+                state["left"] -= 1
+                if state["left"]:
+                    return
+            try:
+                self._deliver_row_batch(group, row_ctx, host)
+            except Exception as exc:  # noqa: BLE001 — waiters must resolve
+                for it in group:
+                    if not it.future.done():
+                        try:
+                            it.future.set_exception(exc)
+                        except InvalidStateError:
+                            pass
+
+        for f in pending:
+            f.add_done_callback(_on_done)
+
+    def _deliver_row_batch(
+        self, group: list[_WorkItem], row_ctx, host: dict | None
+    ) -> None:
+        """Scatter (device + cached + foreign-filled) rows back into every
+        request's original slice and resolve the futures. A request any
+        of whose rows rode a FAILED foreign fill gets that error (its
+        batchmates still deliver); a request served any stale (brownout)
+        row is marked degraded via the future side-channel the service
+        reads after the wait. Cache-assembled batches are deliberately
+        NOT fed to the quality plane: like whole-request cache hits,
+        their non-cold rows are served — not freshly predicted — scores
+        (the passthrough case rides the normal completer tail and is
+        sketched there)."""
+        try:
+            full, failed_rows, row_errors = row_ctx.assemble(host)
+        except Exception as exc:  # noqa: BLE001 — every waiter must resolve
+            for it in group:
+                if not it.future.done():
+                    try:
+                        it.future.set_exception(exc)
+                    except InvalidStateError:
+                        pass
+            return
+        stale = row_ctx.plan.stale_slots
+        stale_rows = (
+            np.isin(row_ctx.inverse, np.fromiter(stale, np.int64))
+            if stale else None
+        )
+        ov = row_ctx.overload
+        off = 0
+        for it in group:
+            sl = slice(off, off + it.n)
+            off += it.n
+            if failed_rows is not None and failed_rows[sl].any():
+                bad = int(row_ctx.inverse[sl][failed_rows[sl]][0])
+                exc = row_errors.get(bad) or next(iter(row_errors.values()))
+                if not it.future.done():
+                    try:
+                        it.future.set_exception(exc)
+                    except InvalidStateError:
+                        pass
+                continue
+            if stale_rows is not None and stale_rows[sl].any():
+                # Degraded marker: the service thread reads this after
+                # the future resolves (it cannot be set from here — the
+                # contextvar lives in the RPC's context) and forwards it
+                # as the x-dts-degraded trailing metadata / header.
+                it.future.dts_degraded = "stale"
+                if ov is not None:
+                    ov.note_brownout_serve()
+                if it.span is not None:
+                    it.span.attrs["brownout_stale_rows"] = True
+                    it.span.annotate(
+                        "overload.stale_serve",
+                        rows=int(stale_rows[sl].sum()),
+                    )
+            sliced = {k: v[sl] for k, v in full.items()}
+            try:
+                if not it.future.cancelled():
+                    it.future.set_result(sliced)
+            except InvalidStateError:
+                pass
+
     def _run_stage(
         self,
         sid: int | None,
@@ -2247,6 +2651,7 @@ class DynamicBatcher:
         phases: list | None = None,
         scatter: "np.ndarray | None" = None,
         ring_bufs: list | None = None,
+        row_ctx: "_RowContext | None" = None,
     ) -> None:
         """Device stage for one assembled batch: execute, issue the async
         D2H readback, register in flight, hand off to a completer. Runs on
@@ -2281,10 +2686,20 @@ class DynamicBatcher:
                 with self._cv:
                     if self._staged_groups.pop(sid, None) is None:
                         release_bufs()
+                        if row_ctx is not None:
+                            # Shed while staged: foreign batches waiting
+                            # on this batch's cold rows must fail now.
+                            row_ctx.abort(DeviceWedgedError(
+                                "batch shed while staged for dispatch"
+                            ))
                         return  # shed by the circuit breaker while queued
                     self._staged_candidates -= total
             if all(it.future.cancelled() for it in group):
                 release_bufs()
+                if row_ctx is not None:
+                    row_ctx.abort(CoalescedLeaderCancelled(
+                        "row fill leader batch was cancelled before dispatch"
+                    ))
                 return  # every waiter gave up; skip the device work
             all_warm = all(it.warmup for it in group)
             window = self.inflight_window
@@ -2474,6 +2889,7 @@ class DynamicBatcher:
             self._completers.submit(
                 self._complete, batch_id, group, fetch, issue_t0, meta, scatter,
                 stage_t0, util=util, bucket=bucket, ring_bufs=ring_bufs,
+                row_ctx=row_ctx,
             ).add_done_callback(
                 lambda f, g=group: self._guard_worker_future(f, g, "completer")
             )
@@ -2487,6 +2903,12 @@ class DynamicBatcher:
                 # annotation) that led to the failure BEFORE the waiters
                 # unblock and finish their root spans.
                 _replay_group_phases(group, phases)
+            if row_ctx is not None:
+                # Close the row flights whatever happens next: even when
+                # the recovery plane replays this group (re-planning its
+                # rows fresh), foreign batches riding the OLD flights
+                # must not hang on a fill that will never land.
+                row_ctx.abort(exc)
             rec = self.recovery  # capture: detachable mid-flight
             if rec is not None and rec.take_group(group, exc):
                 # Device-fatal failure with the recovery plane armed: the
@@ -2518,6 +2940,7 @@ class DynamicBatcher:
         stage_t0: float | None = None,
         util=None, bucket: int = 0,
         ring_bufs: list | None = None,
+        row_ctx: "_RowContext | None" = None,
     ) -> None:
         phases: list | None = (
             [] if tracing.enabled() and any(it.span is not None for it in group)
@@ -2600,12 +3023,41 @@ class DynamicBatcher:
                 # position, so the per-request slices below are exactly
                 # what an uncollapsed execution would have produced.
                 host = {k: v[scatter] for k, v in host.items()}
+            if row_ctx is not None:
+                # Row-cache fill: close the plan's lead flights from the
+                # executed rows (post-widen, post-sidecar-consume — the
+                # exact bytes delivery slices) and wake every foreign
+                # batch waiting on them.
+                with (
+                    tracing.collect_phases(phases) if phases is not None
+                    else _NULL_CTX
+                ), request_trace.span("cache.row_fill"):
+                    row_ctx.fill_from_host(host)
             if phases is not None:
                 # Attach the readback phases before the waiters unblock —
                 # a root span must already hold its full tree when the RPC
                 # handler finishes (and records) it.
                 _replay_group_phases(group, phases)
                 phases = None  # a set_result failure must not re-replay
+            if row_ctx is not None and not row_ctx.passthrough:
+                if row_ctx.all_fresh:
+                    # Every delivered score came from THIS execution (the
+                    # batch merely held intra-batch duplicates): scatter
+                    # through the inverse map and ride the normal tail —
+                    # including the quality feed — exactly like the dedup
+                    # path this plan subsumes.
+                    host = {k: v[row_ctx.inverse] for k, v in host.items()}
+                else:
+                    # Mixed fresh/cached batch: delivery scatters device +
+                    # cached + foreign-filled rows back into each
+                    # request's slice (and may defer on still-in-flight
+                    # foreign fills). The quality plane is deliberately
+                    # skipped — the assembled vector mixes fresh and
+                    # cache-served scores, and the plane's contract
+                    # sketches only fresh ones (cache hits are excluded
+                    # the same way).
+                    self._finish_row_batch(group, row_ctx, host)
+                    return
             q = self.quality  # capture: detachable mid-flight (bench A/B)
             if q is not None and meta is None:
                 # Quality-plane feed, BEFORE the waiters unblock so a
@@ -2637,6 +3089,11 @@ class DynamicBatcher:
         except Exception as exc:
             if phases is not None:
                 _replay_group_phases(group, phases)
+            if row_ctx is not None:
+                # Idempotent after a successful fill (the flights are
+                # already popped); on a readback failure it fails the
+                # foreign batches waiting on this batch's rows.
+                row_ctx.abort(exc)
             rec = self.recovery  # capture: detachable mid-flight
             if rec is not None and rec.take_group(group, exc):
                 # Device-fatal readback failure: the recovery plane owns

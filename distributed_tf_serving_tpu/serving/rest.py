@@ -558,6 +558,7 @@ class RestGateway:
         return web.Response(
             body=self.metrics.prometheus_text(
                 stats, cache=self.impl.cache_stats(),
+                row_cache=self.impl.row_cache_stats(),
                 overload=self.impl.overload_stats(),
                 utilization=utilization,
                 quality=self.impl.quality_stats(),
@@ -592,6 +593,7 @@ class RestGateway:
                 "recorded": tracing.recorder().recorded,
             },
             "cache": self.impl.cache_stats,
+            "row_cache": self.impl.row_cache_stats,
             "overload": self.impl.overload_stats,
             "utilization": self.impl.utilization_stats,
             "quality": self.impl.quality_stats,
@@ -631,8 +633,8 @@ class RestGateway:
         # reuses the utilization snapshot computed earlier in this same
         # pass (its per-device attribution lifts from it — no second
         # waterfall merge).
-        for name in ("cache", "overload", "utilization", "quality",
-                     "lifecycle", "recovery", "kernels", "mesh",
+        for name in ("cache", "row_cache", "overload", "utilization",
+                     "quality", "lifecycle", "recovery", "kernels", "mesh",
                      "versions", "pipeline"):
             block = (
                 self.impl.mesh_stats(utilization=snap.get("utilization"))
@@ -799,10 +801,16 @@ class RestGateway:
     async def cachez(self, request: web.Request) -> web.Response:
         """GET /cachez: the score-cache introspection surface — aggregate +
         per-model hit/miss/coalesced/eviction/expiration counters, hit
-        rate, entry/byte occupancy, and the active config. `{"enabled":
+        rate, entry/byte occupancy, and the active config, plus a
+        `row_cache` block (per-row counters, rows_executed vs
+        rows_requested) when the row-granular tier is armed. `{"enabled":
         false}` when no cache is armed (the route always answers, so
         probes need no config knowledge)."""
         stats = self.impl.cache_stats()
+        row = self.impl.row_cache_stats()
+        if row is not None:
+            stats = dict(stats) if stats is not None else {"enabled": False}
+            stats["row_cache"] = row
         return web.json_response(stats if stats is not None else {"enabled": False})
 
     async def cachez_flush(self, request: web.Request) -> web.Response:

@@ -829,10 +829,11 @@ def _replay_warmup(warmup_file, servable, batcher) -> int:
     return replay_warmup_file(warmup_file, servable, batcher)
 
 
-def _servable_change_hook(score_cache, quality):
+def _servable_change_hook(score_cache, quality, row_cache=None):
     """ONE on_servable_change callable for the version watchers, fanning
     out to every armed plane that cares about registry mutations: the
-    cache plane's generation invalidation (by model name) and the quality
+    cache plane's generation invalidation (by model name) — BOTH tiers,
+    the whole-request store and the row-granular store — and the quality
     plane's version-change accounting. The kernel plane needs no hook:
     its decision() is identity-guarded per tuned Servable (a hot-loaded
     or reloaded version can never inherit another generation's
@@ -841,6 +842,8 @@ def _servable_change_hook(score_cache, quality):
     hooks = []
     if score_cache is not None:
         hooks.append(score_cache.invalidate_model)
+    if row_cache is not None:
+        hooks.append(row_cache.invalidate_model)
     if quality is not None:
         hooks.append(quality.note_servable_change)
     if not hooks:
@@ -917,6 +920,7 @@ class ModelLifecycle:
 
         cfg, batcher = self._cfg, self._batcher
         score_cache = getattr(batcher, "score_cache", None)
+        row_cache = getattr(batcher, "row_cache", None)
         quality = getattr(batcher, "quality", None)
         kind = mc.model_platform or cfg.model_kind
         if kind == "tensorflow":  # upstream's only platform string
@@ -945,7 +949,9 @@ class ModelLifecycle:
             # moment the registry flips (cache-plane generation hook) and
             # tick the quality plane's version-change counter (ISSUE 7 —
             # version-pair drift reads the per-version sketches directly).
-            on_servable_change=_servable_change_hook(score_cache, quality),
+            on_servable_change=_servable_change_hook(
+                score_cache, quality, row_cache=row_cache
+            ),
         ).start()
 
     @staticmethod
@@ -1352,6 +1358,15 @@ def build_stack(
             cache_config.max_entries, cache_config.max_bytes,
             cache_config.ttl_s, cache_config.coalesce, cache_config.dedup,
         )
+    row_cache = cache_config.build_row() if cache_config is not None else None
+    if row_cache is not None:
+        log.info(
+            "row-granular score cache on: max_entries=%d max_bytes=%d "
+            "ttl_s=%.1f coalesce=%s — only cold rows execute; `row_cache` "
+            "block in /cachez and /monitoring",
+            cache_config.row_max_entries, cache_config.row_max_bytes,
+            cache_config.row_ttl_s, cache_config.row_coalesce,
+        )
     utilization_ledger = (
         utilization_config.build() if utilization_config is not None else None
     )
@@ -1463,6 +1478,7 @@ def build_stack(
         pipelined_dispatch=cfg.pipelined_dispatch,
         donate_buffers=cfg.donate_buffers,
         score_cache=score_cache,
+        row_cache=row_cache,
         # `enabled` is the MASTER switch for the whole cache plane: a
         # config with enabled=false and dedup=true must arm nothing.
         dedup=(
@@ -1587,7 +1603,7 @@ def build_stack(
             mesh=mesh,
             tensor_parallel=tensor_parallel,
             on_servable_change=_servable_change_hook(
-                score_cache, quality_monitor
+                score_cache, quality_monitor, row_cache=row_cache
             ),
         ).start()
         # Label-only reloads may re-state this source verbatim (deploy
@@ -1738,7 +1754,8 @@ def serve(argv=None) -> None:
         help="exact-match score cache + single-flight coalescing at the "
         "batcher (cache/score_cache.py; GET /cachez on the REST surface). "
         "Equivalent to [cache] enabled=true; the [cache] section carries "
-        "the capacity/ttl/coalesce/dedup knobs",
+        "the capacity/ttl/coalesce/dedup knobs and the row-granular tier "
+        "(row_granular: per-row score caching — only cold rows execute)",
     )
     parser.add_argument(
         "--overload", action="store_true", default=None,

@@ -175,17 +175,43 @@ class PredictionServiceImpl:
         cache = getattr(self.batcher, "score_cache", None)
         return cache.snapshot() if cache is not None else None
 
+    def row_cache_stats(self) -> dict | None:
+        """Row-granular cache snapshot (per-row hit/miss/coalesced
+        counters, rows_executed vs rows_requested, occupancy) — the
+        `row_cache` block in GET /cachez and /monitoring and the
+        dts_tpu_cache_row_* Prometheus series. None when no row cache is
+        armed ([cache] row_granular=false)."""
+        rc = getattr(self.batcher, "row_cache", None)
+        if rc is None:
+            return None
+        snap = rc.snapshot()
+        stats = getattr(self.batcher, "stats", None)
+        if stats is not None:
+            snap["batcher"] = {
+                "row_batches": stats.row_batches,
+                "rows_requested": stats.rows_requested,
+                "rows_executed": stats.rows_executed,
+                "row_full_hit_batches": stats.row_full_hit_batches,
+            }
+        return snap
+
     def cache_flush(self, model: str | None = None) -> int:
         """Operator flush control: drop every cached score (or one
         model's), generation-bumped so in-flight fills of the flushed
-        entries die too. Returns the number of entries dropped."""
+        entries die too — the row-granular tier flushes with the request
+        tier (one operator surface, both stores). Returns the total
+        number of entries dropped."""
         cache = getattr(self.batcher, "score_cache", None)
-        if cache is None:
+        row_cache = getattr(self.batcher, "row_cache", None)
+        if cache is None and row_cache is None:
             raise ServiceError(
                 "FAILED_PRECONDITION",
                 "no score cache is configured ([cache] enabled=false)",
             )
-        return cache.flush(model)
+        dropped = cache.flush(model) if cache is not None else 0
+        if row_cache is not None:
+            dropped += row_cache.flush(model)
+        return dropped
 
     def overload_stats(self) -> dict | None:
         """Overload-plane snapshot (adaptive limit, pressure state, shed /
@@ -620,7 +646,9 @@ class PredictionServiceImpl:
                 deadline_s=deadline_s, span=tracing.current_span(),
                 criticality=criticality,
             )
-            return fut.result(timeout=timeout)
+            out = fut.result(timeout=timeout)
+            self._consume_future_degraded(fut)
+            return out
         except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
             raise self._translate_batcher_error(e, fut) from e
 
@@ -648,11 +676,27 @@ class PredictionServiceImpl:
                 deadline_s=deadline_s, span=tracing.current_span(),
                 criticality=criticality,
             )
-            return await asyncio.wait_for(
+            out = await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=timeout
             )
+            self._consume_future_degraded(fut)
+            return out
         except Exception as e:  # noqa: BLE001 — translator re-raises non-batcher
             raise self._translate_batcher_error(e, fut) from e
+
+    @staticmethod
+    def _consume_future_degraded(fut) -> None:
+        """Row-granular brownout stale-serve (ISSUE 14): the batcher's
+        completer runs on its own threads, so it cannot set this request's
+        degraded contextvar — it leaves the marker on the Future instead,
+        and THIS thread (the RPC's context) forwards it so the transport
+        adapters emit x-dts-degraded exactly like a whole-request stale
+        serve. One getattr per request when nothing is marked."""
+        degraded = getattr(fut, "dts_degraded", None)
+        if degraded is not None:
+            from . import overload as overload_mod
+
+            overload_mod.mark_degraded(degraded)
 
     def _predict_prepare(
         self, request: apis.PredictRequest, criticality: str | None = None
@@ -998,6 +1042,12 @@ class PredictionServiceImpl:
                         outputs = fut.result()
                     except Exception as e:  # noqa: BLE001 — translator re-raises
                         raise self._translate_batcher_error(e, fut) from e
+                    # A stale-row brownout serve on any sub-batch marks
+                    # the WHOLE stream degraded — the same trailer a
+                    # whole-request stale serve emits (the generator runs
+                    # in the RPC's context, so the contextvar reaches the
+                    # transport adapter).
+                    self._consume_future_degraded(fut)
                     off, cnt = futs[fut]
                     emitted += 1
                     yield self._encode_stream_chunk(
@@ -1055,6 +1105,8 @@ class PredictionServiceImpl:
                         raise self._translate_batcher_error(
                             e, wrapped[task]
                         ) from e
+                    # Stale-row marker forwarding, as in the sync stream.
+                    self._consume_future_degraded(wrapped[task])
                     off, cnt = futs[wrapped[task]]
                     emitted += 1
                     yield self._encode_stream_chunk(

@@ -367,6 +367,25 @@ class CacheConfig:
     # Intra-batch duplicate collapse: exact-duplicate rows within a
     # combined batch execute once, scores scattered back per requester.
     dedup: bool = False
+    # Row-granular score caching (cache/row_cache.py, ISSUE 14): cache
+    # scores PER CANDIDATE ROW so a request with 90% hot rows executes
+    # only the cold 10% — the batcher consults the row cache after
+    # collect, dispatches only the cold rows (possibly a smaller bucket),
+    # and scatters device + cached scores back per request. Master-gated
+    # by `enabled` like dedup (enabled=false arms nothing). The
+    # whole-request cache stays in front: a full hit never reaches the
+    # row path.
+    row_granular: bool = False
+    # Row-tier LRU capacity (entries are single rows — small values, so
+    # the entry bound usually binds first) and shelf life. Row entries
+    # ride the same generation invalidation (version swaps drop them
+    # eagerly) and the same brownout stale window as request entries.
+    row_max_entries: int = 131072
+    row_max_bytes: int = 32 << 20
+    row_ttl_s: float = 30.0
+    # Per-row single-flight: two co-resident batches sharing a cold row
+    # execute it once (the second assembles from the first's fill).
+    row_coalesce: bool = True
 
     def build(self):
         """ScoreCache per this config, or None when disabled."""
@@ -379,6 +398,21 @@ class CacheConfig:
             max_bytes=self.max_bytes,
             ttl_s=self.ttl_s,
             coalesce=self.coalesce,
+        )
+
+    def build_row(self):
+        """RowScoreCache per this config, or None when the plane (or the
+        [cache] master switch) is off — enabled=false with
+        row_granular=true must arm nothing, the dedup precedent."""
+        if not (self.enabled and self.row_granular):
+            return None
+        from ..cache import RowScoreCache
+
+        return RowScoreCache(
+            max_entries=self.row_max_entries,
+            max_bytes=self.row_max_bytes,
+            ttl_s=self.row_ttl_s,
+            coalesce=self.row_coalesce,
         )
 
 

@@ -266,6 +266,43 @@ _HELP = {
     ":tensorflow:serving:request_latency":
         "Request latency in microseconds (TF-Serving-compatible name)",
     "dts_tpu_qps_window": "Rolling-window overall request rate",
+    "dts_tpu_cache_row_hits_total":
+        "Candidate rows answered from the row-granular score cache "
+        "instead of executing on device",
+    "dts_tpu_cache_row_misses_total":
+        "Candidate rows not in the row cache (cold — this batch executes "
+        "them and fills on completion)",
+    "dts_tpu_cache_row_coalesced_total":
+        "Cold rows that joined another in-flight batch's fill instead of "
+        "executing again (per-row single-flight)",
+    "dts_tpu_cache_row_stale_serves_total":
+        "Rows served past TTL inside the brownout stale window "
+        "(responses touching them are marked degraded, never re-filled)",
+    "dts_tpu_cache_row_evictions_total":
+        "Row entries evicted by the LRU entry/byte bounds",
+    "dts_tpu_cache_row_expirations_total":
+        "Row entries dropped on sight past their TTL (and any stale "
+        "window)",
+    "dts_tpu_cache_row_invalidations_total":
+        "Row entries dropped by generation invalidation (version swaps, "
+        "operator flushes)",
+    "dts_tpu_cache_row_fills_total":
+        "Executed rows stored into the row cache",
+    "dts_tpu_cache_row_hit_rate":
+        "row hits / (row hits + row misses) over the process lifetime",
+    "dts_tpu_cache_row_entries":
+        "Live row entries in the row-granular store",
+    "dts_tpu_cache_row_value_bytes":
+        "Bytes of cached per-row output values in the row-granular store",
+    "dts_tpu_cache_rows_requested_total":
+        "Rows that entered cold-row extraction (the denominator of the "
+        "row plane's executed-vs-requested ratio)",
+    "dts_tpu_cache_rows_executed_total":
+        "Rows actually packed, bucketed, and dispatched to the device "
+        "after row-cache extraction",
+    "dts_tpu_cache_rows_executed_fraction":
+        "rows_executed / rows_requested — the row-granular cache's "
+        "headline: well below 1.0 at zipfian skew",
     "dts_tpu_quality_score":
         "Predicted-score distribution per model and version",
     "dts_tpu_quality_drift_psi":
@@ -493,11 +530,19 @@ class ServerMetrics:
                 "dedup_rows_collapsed": getattr(
                     batcher_stats, "dedup_rows_collapsed", 0
                 ),
+                # Row-granular cache tier (ISSUE 14): rows dispatched to
+                # the device vs rows requested across row-planned batches.
+                "row_batches": getattr(batcher_stats, "row_batches", 0),
+                "rows_requested": getattr(batcher_stats, "rows_requested", 0),
+                "rows_executed": getattr(batcher_stats, "rows_executed", 0),
+                "row_full_hit_batches": getattr(
+                    batcher_stats, "row_full_hit_batches", 0
+                ),
             }
         return out
 
     def prometheus_text(
-        self, batcher_stats=None, cache=None, overload=None,
+        self, batcher_stats=None, cache=None, row_cache=None, overload=None,
         utilization=None, quality=None, lifecycle=None, pipeline=None,
         recovery=None, kernels=None, mesh=None,
     ) -> str:
@@ -683,6 +728,42 @@ class ServerMetrics:
                             f'{mc}{{{base},event="{event}"}} '
                             f'{counters.get(event, 0)}'
                         )
+        if row_cache is not None:
+            # Row-granular cache tier (ISSUE 14): per-ROW hit/miss/
+            # coalesce counters plus the plane's headline ratio — rows
+            # actually executed on device vs rows requested.
+            for metric, kind, value in (
+                ("dts_tpu_cache_row_hits_total", "counter",
+                 row_cache.get("hits", 0)),
+                ("dts_tpu_cache_row_misses_total", "counter",
+                 row_cache.get("misses", 0)),
+                ("dts_tpu_cache_row_coalesced_total", "counter",
+                 row_cache.get("coalesced", 0)),
+                ("dts_tpu_cache_row_stale_serves_total", "counter",
+                 row_cache.get("stale_serves", 0)),
+                ("dts_tpu_cache_row_evictions_total", "counter",
+                 row_cache.get("evictions", 0)),
+                ("dts_tpu_cache_row_expirations_total", "counter",
+                 row_cache.get("expirations", 0)),
+                ("dts_tpu_cache_row_invalidations_total", "counter",
+                 row_cache.get("invalidations", 0)),
+                ("dts_tpu_cache_row_fills_total", "counter",
+                 row_cache.get("fills", 0)),
+                ("dts_tpu_cache_row_hit_rate", "gauge",
+                 row_cache.get("hit_rate", 0.0)),
+                ("dts_tpu_cache_row_entries", "gauge",
+                 row_cache.get("entries", 0)),
+                ("dts_tpu_cache_row_value_bytes", "gauge",
+                 row_cache.get("value_bytes", 0)),
+                ("dts_tpu_cache_rows_requested_total", "counter",
+                 row_cache.get("rows_requested", 0)),
+                ("dts_tpu_cache_rows_executed_total", "counter",
+                 row_cache.get("rows_executed", 0)),
+                ("dts_tpu_cache_rows_executed_fraction", "gauge",
+                 row_cache.get("rows_executed_fraction", 0.0)),
+            ):
+                _family_lines(lines, metric, kind)
+                lines.append(f"{metric} {value}")
         if overload is not None:
             # Overload plane (ISSUE 5): the AdmissionController snapshot
             # dict as dts_tpu_overload_* series — the adaptive limit +

@@ -64,6 +64,14 @@ def _fully_armed_text() -> str:
         "buffer_ring": {"reuses": 7, "allocs": 3, "free_buffers": 2},
     }
     cache = ScoreCache()
+    # Row-granular tier (ISSUE 14, the twelfth plane): a RowScoreCache
+    # snapshot with per-row counters + the rows-executed ratio.
+    from distributed_tf_serving_tpu.cache import RowScoreCache
+
+    row_cache = RowScoreCache()
+    row_cache.note_rows('we"ird\\mo\ndel', requested=100, executed=37)
+    row_cache._count('we"ird\\mo\ndel', "hits", 63)
+    row_cache._count('we"ird\\mo\ndel', "misses", 37)
     ctrl = OverloadConfig(enabled=True).build()
     ctrl.bind(4096, 65536)
     ctrl.admit(5, 0, lane="sheddable")
@@ -147,6 +155,7 @@ def _fully_armed_text() -> str:
     return m.prometheus_text(
         stats,
         cache=cache.snapshot(),
+        row_cache=row_cache.snapshot(),
         overload=ctrl.snapshot(),
         utilization=ledger.snapshot(),
         quality=quality.snapshot(),
@@ -164,7 +173,10 @@ def test_fully_armed_snapshot_passes_lint():
     # The assembly really did include every plane.
     for marker in (
         ":tensorflow:serving:request_count", "dts_tpu_batcher_",
-        "dts_tpu_cache_", "dts_tpu_overload_", "dts_tpu_utilization_",
+        "dts_tpu_cache_", "dts_tpu_cache_row_hits_total",
+        "dts_tpu_cache_rows_executed_total",
+        "dts_tpu_cache_rows_executed_fraction",
+        "dts_tpu_overload_", "dts_tpu_utilization_",
         "dts_tpu_quality_", "dts_tpu_lifecycle_", "dts_tpu_pipeline_",
         "dts_tpu_pipeline_bucket_in_flight", "buffer_ring",
         "dts_tpu_recovery_", "dts_tpu_kernel_",

@@ -1,0 +1,719 @@
+"""Row-granular score cache (cache/row_cache.py + the batcher's cold-row
+extraction, ISSUE 14): per-row LRU/TTL/generation invalidation, per-row
+single-flight under real concurrency, cold-row extraction + completer
+scatter bit-identity (within and across co-batched requests, including
+bucket shrink), dedup x row-cache composition, version-swap invalidation
+through a real VersionWatcher, disabled-mode inertness, the [cache]
+row_granular knobs + build_stack gate, and the affinity streamed/prepared
+client routing satellite."""
+
+import asyncio
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_tf_serving_tpu.cache import RowScoreCache
+from distributed_tf_serving_tpu.cache.row_cache import (
+    digest_rows,
+    row_structure_header,
+)
+from distributed_tf_serving_tpu.cache.digest import canonical_rows
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.serving import DynamicBatcher
+
+F = 6
+VOCAB = 1 << 10
+CFG = ModelConfig(
+    name="DCN", num_fields=F, vocab_size=VOCAB, embed_dim=4,
+    mlp_dims=(8,), num_cross_layers=1, compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def servable():
+    model = build_model("dcn", CFG)
+    return Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(F),
+    )
+
+
+def make_arrays(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, 1 << 40, size=(n, F)).astype(np.int64),
+        "feat_wts": rng.rand(n, F).astype(np.float32),
+    }
+
+
+def row_keys_of(arrays):
+    blob = canonical_rows(arrays)
+    return digest_rows(blob, row_structure_header(arrays))
+
+
+def _val(x=0.5):
+    return {"prediction_node": np.asarray(np.float32(x))}
+
+
+# ------------------------------------------------------------- store unit
+
+
+def test_row_lru_ttl_and_byte_bounds():
+    clock = [0.0]
+    rc = RowScoreCache(
+        max_entries=4, ttl_s=10.0, shards=1, clock=lambda: clock[0]
+    )
+    digs = row_keys_of(make_arrays(6, seed=1))
+    keys = [rc.row_key("DCN", 1, None, d) for d in digs]
+    for i, k in enumerate(keys[:4]):
+        assert rc.fill(k, _val(i))
+    assert rc.entry_count() == 4
+    # LRU: filling two more evicts the two oldest.
+    rc.fill(keys[4], _val())
+    rc.fill(keys[5], _val())
+    assert rc.entry_count() == 4
+    assert rc.lookup(keys[0]) is None and rc.lookup(keys[1]) is None
+    assert rc.lookup(keys[5]) is not None
+    # TTL: everything expires past the shelf life.
+    clock[0] = 11.0
+    assert rc.lookup(keys[5]) is None
+    assert rc.snapshot()["expirations"] >= 1
+
+
+def test_row_generation_invalidation_drops_entries_and_kills_fills():
+    rc = RowScoreCache(shards=1)
+    d = row_keys_of(make_arrays(1, seed=2))[0]
+    key = rc.row_key("DCN", 1, None, d)
+    gen = rc._gen_of("DCN")
+    assert rc.fill(key, _val())
+    assert rc.lookup(key) is not None
+    dropped = rc.invalidate_model("DCN")
+    assert dropped == 1
+    assert rc.lookup(key) is None
+    # A fill minted under the old generation is refused after the bump.
+    assert rc.fill(key, _val(), gen=gen) is False
+    assert rc.fill(key, _val()) is True  # current-gen fill lands
+
+
+def test_begin_rows_classifies_hits_waiters_leads():
+    rc = RowScoreCache(shards=1)
+    digs = row_keys_of(make_arrays(3, seed=3))
+    rc.fill(rc.row_key("DCN", 1, None, digs[0]), _val(0.7))
+    plan_a = rc.begin_rows("DCN", 1, None, digs)
+    assert set(plan_a.hits) == {0}
+    assert plan_a.lead == [1, 2]
+    # A second batch sharing row 1 joins A's flight instead of leading.
+    plan_b = rc.begin_rows("DCN", 1, None, [digs[1]])
+    assert plan_b.lead == [] and set(plan_b.waiters) == {0}
+    rc.complete_rows(plan_a, {1: _val(0.1), 2: _val(0.2)})
+    got = plan_b.waiters[0].result(timeout=5)
+    assert float(got["prediction_node"]) == np.float32(0.1)
+    # The fill landed: a third batch hits all three rows.
+    plan_c = rc.begin_rows("DCN", 1, None, digs)
+    assert len(plan_c.hits) == 3 and not plan_c.lead
+    # Duplicate digests inside ONE batch: first leads, second waits.
+    plan_d = rc.begin_rows("DCN", 1, None, [digs[0]] * 2 + row_keys_of(
+        make_arrays(1, seed=33)
+    ))
+    assert len(plan_d.hits) == 2  # both copies of the cached row hit
+
+
+def test_stale_window_serves_expired_rows_marked():
+    """Brownout stale-serve at row granularity: an entry past TTL but
+    inside the stale window answers as a hit with its slot marked stale
+    (it is neither dropped nor LRU-promoted), and past the window it is
+    gone."""
+    clock = [0.0]
+    rc = RowScoreCache(ttl_s=1.0, shards=1, clock=lambda: clock[0])
+    d = row_keys_of(make_arrays(1, seed=6))[0]
+    rc.fill(rc.row_key("DCN", 1, None, d), _val())
+    clock[0] = 2.0  # past TTL, inside a 5s stale window
+    plan = rc.begin_rows("DCN", 1, None, [d], stale_s=5.0)
+    assert set(plan.hits) == {0} and plan.stale_slots == {0}
+    assert rc.snapshot()["stale_serves"] == 1
+    clock[0] = 7.5  # past the stale window too
+    plan2 = rc.begin_rows("DCN", 1, None, [d], stale_s=5.0)
+    assert plan2.lead == [0] and not plan2.hits
+    rc.abort_rows(plan2, RuntimeError("cleanup"))
+
+
+def test_service_forwards_future_degraded_marker():
+    """The batcher's completer cannot reach the RPC's contextvar, so a
+    stale-row delivery leaves the marker on the Future; the service
+    thread forwards it into the transport's degraded plumbing."""
+    from concurrent.futures import Future
+
+    from distributed_tf_serving_tpu.serving import overload as overload_mod
+    from distributed_tf_serving_tpu.serving.service import (
+        PredictionServiceImpl,
+    )
+
+    overload_mod.consume_degraded()  # clear any leftover marker
+    fut = Future()
+    PredictionServiceImpl._consume_future_degraded(fut)
+    assert overload_mod.consume_degraded() is None
+    fut.dts_degraded = "stale"
+    PredictionServiceImpl._consume_future_degraded(fut)
+    assert overload_mod.consume_degraded() == "stale"
+
+
+def test_abort_rows_fails_waiters():
+    rc = RowScoreCache(shards=1)
+    digs = row_keys_of(make_arrays(1, seed=4))
+    plan_a = rc.begin_rows("DCN", 1, None, digs)
+    plan_b = rc.begin_rows("DCN", 1, None, digs)
+    assert set(plan_b.waiters) == {0}
+    rc.abort_rows(plan_a, RuntimeError("device died"))
+    with pytest.raises(RuntimeError, match="device died"):
+        plan_b.waiters[0].result(timeout=5)
+
+
+def test_output_selection_keys_entries_apart():
+    rc = RowScoreCache(shards=1)
+    d = row_keys_of(make_arrays(1, seed=5))[0]
+    rc.fill(rc.row_key("DCN", 1, ("prediction_node",), d), _val())
+    plan = rc.begin_rows("DCN", 1, None, [d])
+    assert plan.lead == [0]  # the score-only entry must not answer all-outputs
+    rc.abort_rows(plan, RuntimeError("cleanup"))
+
+
+def test_structure_header_separates_identical_bytes():
+    a = {"feat_ids": np.arange(F, dtype=np.int64).reshape(1, F)}
+    b = {"feat_ids": np.arange(F, dtype=np.int64).reshape(1, F).view(np.uint8)
+         .reshape(1, -1)}
+    assert row_keys_of(a)[0] != row_keys_of(b)[0]
+
+
+# ------------------------------------------------- batcher: bit-identity
+
+
+@pytest.fixture()
+def plain_batcher(servable):
+    b = DynamicBatcher(buckets=(8, 16, 32, 64), max_wait_us=0).start()
+    b.warmup(servable, buckets=(8, 16, 32, 64))
+    yield b
+    b.stop()
+
+
+def _ref(plain_batcher, servable, arrays):
+    return plain_batcher.submit(
+        servable, arrays, output_keys=("prediction_node",)
+    ).result(timeout=60)["prediction_node"]
+
+
+def test_cold_extraction_scatter_bit_identity(plain_batcher, servable):
+    """All-cold, partial-hot, and full-hot answers are bit-identical to
+    the disarmed plane, the partial batch executes only its cold rows in
+    a SMALLER bucket, and the full repeat touches no device at all."""
+    rc = RowScoreCache(ttl_s=600.0)
+    b = DynamicBatcher(
+        buckets=(8, 16, 32, 64), max_wait_us=0, row_cache=rc,
+    ).start()
+    b.warmup(servable, buckets=(8, 16, 32, 64))
+    try:
+        a1 = make_arrays(20, seed=11)
+        r1 = b.submit(
+            servable, a1, output_keys=("prediction_node",)
+        ).result(timeout=60)["prediction_node"]
+        np.testing.assert_array_equal(r1, _ref(plain_batcher, servable, a1))
+        assert b.stats.rows_executed == 20  # all cold
+
+        padded0 = b.stats.padded_candidates
+        a2 = {k: np.concatenate([a1[k][:16], make_arrays(4, seed=12)[k]])
+              for k in a1}
+        r2 = b.submit(
+            servable, a2, output_keys=("prediction_node",)
+        ).result(timeout=60)["prediction_node"]
+        np.testing.assert_array_equal(r2, _ref(plain_batcher, servable, a2))
+        assert b.stats.rows_executed == 24  # only the 4 cold rows ran
+        # Bucket shrink: 20-row request executed 4 cold rows -> bucket 8.
+        assert b.stats.padded_candidates - padded0 == 8
+
+        batches0 = b.stats.batches
+        r3 = b.submit(
+            servable, a1, output_keys=("prediction_node",)
+        ).result(timeout=60)["prediction_node"]
+        np.testing.assert_array_equal(r3, r1)
+        assert b.stats.batches == batches0  # zero device batches
+        assert b.stats.row_full_hit_batches == 1
+        assert b.stats.rows_requested == 60
+        snap = rc.snapshot()
+        assert snap["hits"] >= 36 and snap["rows_executed"] == 24
+    finally:
+        b.stop()
+
+
+def test_scatter_across_coalesced_requests(plain_batcher, servable):
+    """Two requests coalesced into ONE combined batch each get their own
+    correct slice back when some rows are hot and some cold."""
+    rc = RowScoreCache(ttl_s=600.0)
+    warm = make_arrays(6, seed=21)
+    b = DynamicBatcher(
+        buckets=(8, 16, 32, 64), max_wait_us=200_000, row_cache=rc,
+        pipelined_dispatch=False,
+    ).start()
+    b.warmup(servable, buckets=(8, 16, 32, 64))
+    try:
+        b.submit(servable, warm, output_keys=("prediction_node",)).result(60)
+        a = {k: np.concatenate([warm[k][:3], make_arrays(5, seed=22)[k]])
+             for k in warm}
+        c = {k: np.concatenate([make_arrays(4, seed=23)[k], warm[k][3:]])
+             for k in warm}
+        fa = b.submit(servable, a, output_keys=("prediction_node",))
+        fc = b.submit(servable, c, output_keys=("prediction_node",))
+        ra = fa.result(timeout=60)["prediction_node"]
+        rcv = fc.result(timeout=60)["prediction_node"]
+        np.testing.assert_array_equal(ra, _ref(plain_batcher, servable, a))
+        np.testing.assert_array_equal(rcv, _ref(plain_batcher, servable, c))
+        # The combined batch held 16 rows, 6 of them warm.
+        assert rc.snapshot()["rows_executed"] <= 6 + 9
+    finally:
+        b.stop()
+
+
+def test_dedup_row_cache_composition(plain_batcher, servable):
+    """[cache] dedup + row_granular compose: intra-batch duplicates
+    collapse through the plan's unique step (dedup counters move), the
+    cache sees each distinct row once, and the scattered result is
+    bit-identical."""
+    rc = RowScoreCache(ttl_s=600.0)
+    base = make_arrays(6, seed=31)
+    sel = np.array([0, 1, 2, 0, 1, 2, 3, 0, 4, 5, 3, 2,
+                    1, 4, 0, 5, 2, 3, 1, 0])  # 20 rows, 6 distinct
+    arrays = {k: np.ascontiguousarray(v[sel]) for k, v in base.items()}
+    b = DynamicBatcher(
+        buckets=(8, 16, 32), max_wait_us=0, row_cache=rc, dedup=True,
+    ).start()
+    b.warmup(servable, buckets=(8, 16, 32))
+    try:
+        got = b.submit(
+            servable, arrays, output_keys=("prediction_node",)
+        ).result(timeout=60)["prediction_node"]
+        np.testing.assert_array_equal(
+            got, _ref(plain_batcher, servable, arrays)
+        )
+        assert b.stats.dedup_batches == 1
+        assert b.stats.dedup_rows_collapsed == len(sel) - 6
+        assert b.stats.rows_executed == 6  # distinct rows only
+        # Repeat: all 6 distinct rows hot -> zero device work.
+        got2 = b.submit(
+            servable, arrays, output_keys=("prediction_node",)
+        ).result(timeout=60)["prediction_node"]
+        np.testing.assert_array_equal(got2, got)
+        assert b.stats.row_full_hit_batches == 1
+    finally:
+        b.stop()
+
+
+# -------------------------------------------- per-row single-flight
+
+
+def test_row_single_flight_across_batches(servable):
+    """Two batches sharing a cold row execute it ONCE under real
+    concurrency: the second batch joins the first's per-row flight and
+    assembles from its fill."""
+    executions = []
+    release = threading.Event()
+
+    def slow_run(sv, batch):
+        executions.append(next(iter(batch.values())).shape[0])
+        release.wait(timeout=30)
+        folded = {
+            "feat_ids": batch["feat_ids"] % VOCAB,
+            "feat_wts": batch["feat_wts"],
+        }
+        return {
+            k: np.asarray(v)
+            for k, v in sv.model.apply(sv.params, folded).items()
+        }
+
+    rc = RowScoreCache(ttl_s=600.0)
+    b = DynamicBatcher(
+        buckets=(8, 16), max_wait_us=0, row_cache=rc, run_fn=slow_run,
+    ).start()
+    try:
+        shared = make_arrays(4, seed=41)
+        a = {k: np.concatenate([shared[k], make_arrays(2, seed=42)[k]])
+             for k in shared}
+        c = {k: np.concatenate([shared[k], make_arrays(2, seed=43)[k]])
+             for k in shared}
+        # _solo prevents coalescing into one batch: the point is two
+        # DISTINCT batches racing on the same rows.
+        fa = b.submit(servable, a, output_keys=("prediction_node",),
+                      _solo=True)
+        deadline = time.time() + 10
+        while not executions and time.time() < deadline:
+            time.sleep(0.005)
+        assert executions, "first batch never reached the device stage"
+        fc = b.submit(servable, c, output_keys=("prediction_node",),
+                      _solo=True)
+        # Wait until batch 2's plan is made (it joins batch 1's flights).
+        deadline = time.time() + 10
+        while rc.snapshot()["coalesced"] < 4 and time.time() < deadline:
+            time.sleep(0.005)
+        release.set()
+        ra = fa.result(timeout=30)["prediction_node"]
+        rcv = fc.result(timeout=30)["prediction_node"]
+        np.testing.assert_array_equal(ra[:4], rcv[:4])  # the shared rows
+        assert rc.snapshot()["coalesced"] == 4
+        # Batch 2 executed ONLY its 2 private cold rows.
+        assert rc.snapshot()["rows_executed"] == 6 + 2
+    finally:
+        release.set()
+        b.stop()
+
+
+def test_row_leader_failure_fails_dependent_requests_only(servable):
+    """A batch whose device stage dies aborts its row flights: a foreign
+    batch waiting on those rows gets the error for the requests touching
+    them, while the rest of the system keeps serving."""
+    fail_next = threading.Event()
+
+    def flaky_run(sv, batch):
+        if fail_next.is_set():
+            fail_next.clear()
+            raise RuntimeError("injected device failure")
+        folded = {
+            "feat_ids": batch["feat_ids"] % VOCAB,
+            "feat_wts": batch["feat_wts"],
+        }
+        return {
+            k: np.asarray(v)
+            for k, v in sv.model.apply(sv.params, folded).items()
+        }
+
+    rc = RowScoreCache(ttl_s=600.0)
+    b = DynamicBatcher(
+        buckets=(8, 16), max_wait_us=0, row_cache=rc, run_fn=flaky_run,
+        pipelined_dispatch=False,
+    ).start()
+    try:
+        arrays = make_arrays(4, seed=51)
+        fail_next.set()
+        with pytest.raises(RuntimeError, match="injected device failure"):
+            b.submit(
+                servable, arrays, output_keys=("prediction_node",)
+            ).result(timeout=30)
+        # The flights were aborted: a fresh submit re-plans and succeeds.
+        out = b.submit(
+            servable, arrays, output_keys=("prediction_node",)
+        ).result(timeout=30)["prediction_node"]
+        assert out.shape == (4,)
+    finally:
+        b.stop()
+
+
+def test_all_fresh_dup_batch_still_feeds_quality(servable):
+    """Review finding: a batch whose rows are ALL freshly executed (it
+    merely held intra-batch duplicates) must still feed the quality
+    plane — only mixed fresh/cached assemblies are excluded (like cache
+    hits)."""
+    observed = []
+
+    class _Q:
+        def observe(self, name, version, scores, **kw):
+            observed.append(int(np.asarray(scores).shape[0]))
+
+    rc = RowScoreCache(ttl_s=600.0)
+    b = DynamicBatcher(
+        buckets=(8, 16, 32), max_wait_us=0, row_cache=rc, dedup=True,
+        quality=_Q(),
+    ).start()
+    b.warmup(servable, buckets=(8, 16, 32))
+    try:
+        base = make_arrays(4, seed=55)
+        sel = np.array([0, 1, 2, 3, 0, 1, 2, 3, 0, 1])  # 10 rows, 4 distinct
+        arrays = {k: np.ascontiguousarray(v[sel]) for k, v in base.items()}
+        b.submit(servable, arrays, output_keys=("prediction_node",)).result(60)
+        assert observed == [10]  # all-fresh dup batch sketched, full length
+        # Full repeat: zero-cold assembly — cache-served, never sketched.
+        b.submit(servable, arrays, output_keys=("prediction_node",)).result(60)
+        assert observed == [10]
+        # Mixed fresh/cached batch: excluded like a cache hit.
+        mixed = {k: np.concatenate([base[k][:2], make_arrays(2, seed=56)[k]])
+                 for k in base}
+        b.submit(servable, mixed, output_keys=("prediction_node",)).result(60)
+        assert observed == [10]
+    finally:
+        b.stop()
+
+
+def test_quarantine_capture_fails_zombie_row_flights(servable):
+    """Review finding: a recovery quarantine capture must close EVERY
+    in-flight row fill — the leaders may be stranded in wedged threads
+    that never unwind, so a foreign (or future) batch joining such a
+    flight would hang to its deadline."""
+    from distributed_tf_serving_tpu.serving.batcher import (
+        DeviceQuarantinedError,
+    )
+
+    rc = RowScoreCache(ttl_s=600.0)
+    b = DynamicBatcher(buckets=(8,), max_wait_us=0, row_cache=rc).start()
+    try:
+        digs = row_keys_of(make_arrays(2, seed=65))
+        leader_plan = rc.begin_rows("DCN", 1, None, digs)
+        waiter_plan = rc.begin_rows("DCN", 1, None, digs)
+        assert len(waiter_plan.waiters) == 2
+        b.capture_for_recovery()
+        for w in waiter_plan.waiters.values():
+            with pytest.raises(DeviceQuarantinedError):
+                w.result(timeout=5)
+        # A fresh miss after the capture LEADS again (no zombie flight).
+        fresh = rc.begin_rows("DCN", 1, None, digs)
+        assert fresh.lead == [0, 1]
+        rc.abort_rows(fresh, RuntimeError("cleanup"))
+        rc.abort_rows(leader_plan, RuntimeError("cleanup"))
+    finally:
+        b.stop()
+
+
+def test_degraded_leader_never_fills_request_cache(servable):
+    """Review finding: a whole-request single-flight leader whose
+    response was assembled with brownout-STALE row entries must not fill
+    the whole-request cache (a fresh-TTL entry would serve past-TTL data
+    unmarked after the brownout clears), and its coalesced waiters must
+    inherit the degraded marker with the result."""
+    from concurrent.futures import Future
+
+    from distributed_tf_serving_tpu.cache import ScoreCache
+
+    cache = ScoreCache()
+    b = DynamicBatcher(buckets=(8,), max_wait_us=0, score_cache=cache).start()
+    try:
+        arrays = make_arrays(2, seed=66)
+        leader = cache.begin("DCN", 1, None, arrays)
+        assert leader.leader
+        joined = cache.begin("DCN", 1, None, arrays)
+        assert joined.waiter is not None
+        fut = Future()
+        fut.dts_degraded = "stale"
+        value = {"prediction_node": np.zeros(2, np.float32)}
+        fut.set_result(value)
+        b._cache_complete(cache, leader, fut, servable, arrays, None)
+        assert cache.lookup(leader.key) is None  # never filled
+        got = joined.waiter.result(timeout=5)
+        np.testing.assert_array_equal(got["prediction_node"], np.zeros(2))
+        assert getattr(joined.waiter, "dts_degraded", None) == "stale"
+        # A clean leader (no marker) still fills as before.
+        leader2 = cache.begin("DCN", 1, None, arrays)
+        fut2 = Future()
+        fut2.set_result(value)
+        b._cache_complete(cache, leader2, fut2, servable, arrays, None)
+        assert cache.lookup(leader2.key) is not None
+    finally:
+        b.stop()
+
+
+# ------------------------------------------------- watcher + inertness
+
+
+def test_watcher_swap_invalidates_row_cache(tmp_path, servable):
+    """A version swap through the REAL watcher drops the model's row
+    entries via the fanned-out on_servable_change hook."""
+    from distributed_tf_serving_tpu.serving.server import (
+        _servable_change_hook,
+    )
+    from distributed_tf_serving_tpu.serving.version_watcher import (
+        VersionWatcher,
+        VersionWatcherConfig,
+    )
+    from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+    rc = RowScoreCache()
+    registry = ServableRegistry()
+    save_servable(tmp_path / "1", servable, kind="dcn")
+    watcher = VersionWatcher(
+        tmp_path, registry,
+        VersionWatcherConfig(poll_interval_s=3600, model_name="DCN"),
+        on_servable_change=_servable_change_hook(None, None, row_cache=rc),
+    )
+    watcher.poll_once()
+    sv1 = registry.resolve("DCN")
+    d = row_keys_of(make_arrays(1, seed=61))[0]
+    key = rc.row_key(sv1.name, sv1.version, None, d)
+    rc.fill(key, _val())
+    assert rc.lookup(key) is not None
+    save_servable(
+        tmp_path / "2", dataclasses.replace(servable, version=2), kind="dcn"
+    )
+    watcher.poll_once()
+    assert 2 in registry.models()["DCN"]
+    assert rc.lookup(key) is None
+    assert rc.snapshot()["invalidations"] >= 1
+
+
+def test_disabled_mode_is_inert(servable):
+    b = DynamicBatcher(buckets=(8, 16), max_wait_us=0).start()
+    try:
+        arrays = make_arrays(4, seed=71)
+        b.submit(servable, arrays).result(timeout=60)
+        b.submit(servable, arrays).result(timeout=60)
+        assert b.row_cache is None
+        assert b.stats.row_batches == 0
+        assert b.stats.rows_requested == 0
+        assert b.stats.rows_executed == 0
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------- config + build gate
+
+
+def test_cache_config_row_parsing(tmp_path):
+    from distributed_tf_serving_tpu.utils.config import (
+        CacheConfig,
+        load_config,
+    )
+
+    path = tmp_path / "c.toml"
+    path.write_text(
+        "[cache]\nenabled = true\nrow_granular = true\n"
+        "row_max_entries = 512\nrow_ttl_s = 7.5\nrow_coalesce = false\n"
+    )
+    cfg = load_config(path)["cache"]
+    assert cfg == CacheConfig(
+        enabled=True, row_granular=True, row_max_entries=512,
+        row_ttl_s=7.5, row_coalesce=False,
+    )
+    built = cfg.build_row()
+    assert isinstance(built, RowScoreCache)
+    assert built.max_entries == 512 and built.coalesce is False
+    # Master gate: enabled=false arms nothing even with row_granular=true.
+    assert CacheConfig(enabled=False, row_granular=True).build_row() is None
+    assert CacheConfig(enabled=True, row_granular=False).build_row() is None
+
+
+def test_build_stack_row_master_switch():
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import (
+        CacheConfig,
+        ServerConfig,
+    )
+
+    cfg = ServerConfig(warmup=False, buckets=(32,), num_fields=F)
+    for enabled, row, want in ((True, True, True), (True, False, False),
+                               (False, True, False)):
+        _r, batcher, _i, _s, _m, _w = build_stack(
+            cfg, model_config=CFG,
+            cache_config=CacheConfig(enabled=enabled, row_granular=row),
+        )
+        try:
+            assert (batcher.row_cache is not None) == want
+        finally:
+            batcher.stop()
+
+
+# ------------------------------------- affinity streamed/prepared routing
+
+
+def test_affinity_streamed_routes_groups_and_scatters():
+    """predict_streamed under placement="affinity": each group streams
+    from its affine home and the merged vector comes back in original
+    candidate order (the client.py:434 TODO satellite)."""
+    from distributed_tf_serving_tpu.client import (
+        affinity_groups,
+        client_from_config,
+    )
+    from distributed_tf_serving_tpu.utils import ClientConfig
+
+    arrays = make_arrays(24, seed=81)
+    groups = affinity_groups(arrays, 2)
+    homes = {}
+
+    async def go():
+        cfg = ClientConfig(hosts=("h1", "h2"), placement="affinity")
+        client = client_from_config(cfg)
+
+        async def fake_stream(i, shard, rr, chunk, budget=None):
+            homes[i] = homes.get(i, 0) + 1
+            return shard["feat_wts"][:, 0].astype(np.float32)
+
+        client._predict_shard_stream = fake_stream
+        merged = await client.predict_streamed(arrays)
+        await client.close()
+        return merged
+
+    merged = asyncio.run(go())
+    np.testing.assert_array_equal(
+        merged, arrays["feat_wts"][:, 0].astype(np.float32)
+    )
+    assert sorted(homes) == sorted({h for h, _i, _s in groups})
+
+
+def test_affinity_prepare_pins_homes_and_prepared_scatters():
+    """prepare() under affinity serializes per-HOME group blobs (homes +
+    row indices pinned on the PreparedRequest) and predict_prepared
+    scatters the scores back into candidate order."""
+    from distributed_tf_serving_tpu.client import (
+        affinity_groups,
+        client_from_config,
+    )
+    from distributed_tf_serving_tpu.proto import serving_apis_pb2 as apis
+    from distributed_tf_serving_tpu import codec
+    from distributed_tf_serving_tpu.utils import ClientConfig
+
+    arrays = make_arrays(24, seed=91)
+    groups = affinity_groups(arrays, 2)
+
+    async def go():
+        cfg = ClientConfig(hosts=("h1", "h2"), placement="affinity")
+        client = client_from_config(cfg)
+        prep = client.prepare(arrays)
+        assert prep.homes == tuple(h for h, _i, _s in groups)
+        assert len(prep.shard_blobs) == len(groups)
+        for k, (_h, idx, sub) in enumerate(groups):
+            req = apis.PredictRequest()
+            req.ParseFromString(prep.shard_blobs[k])
+            got = codec.to_ndarray(req.inputs["feat_wts"])
+            np.testing.assert_array_equal(got, sub["feat_wts"])
+            np.testing.assert_array_equal(prep.index_groups[k], idx)
+        sent = {}
+
+        async def fake_raw(i, blob, rr, budget=None):
+            sent[i] = blob
+            req = apis.PredictRequest()
+            req.ParseFromString(blob)
+            wts = codec.to_ndarray(req.inputs["feat_wts"])
+            return wts[:, 0].astype(np.float32)
+
+        client._predict_shard_raw = fake_raw
+        merged = await client.predict_prepared(prep)
+        await client.close()
+        return merged, sent
+
+    merged, sent = asyncio.run(go())
+    np.testing.assert_array_equal(
+        merged, arrays["feat_wts"][:, 0].astype(np.float32)
+    )
+    assert sorted(sent) == sorted({h for h, _i, _s in groups})
+
+
+def test_contiguous_prepare_keeps_positional_contract():
+    """placement="contiguous" (the default) must keep the historical
+    PreparedRequest shape: no homes, positional blob -> host mapping."""
+    from distributed_tf_serving_tpu.client import client_from_config
+    from distributed_tf_serving_tpu.utils import ClientConfig
+
+    arrays = make_arrays(24, seed=95)
+
+    async def go():
+        client = client_from_config(ClientConfig(hosts=("h1", "h2")))
+        prep = client.prepare(arrays)
+        await client.close()
+        return prep
+
+    prep = asyncio.run(go())
+    assert prep.homes is None and prep.index_groups is None
+    assert len(prep.shard_blobs) == 2

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Gate for the tier-1 row-cache smoke (tools/ci_tier1.sh
+TIER1_ROWCACHE_SMOKE=1).
+
+Reads the SOAK_ROWCACHE=1 soak's JSON line and asserts the row-granular
+cache plane's acceptance conditions (ISSUE 14): a NONZERO per-row hit
+rate on the skewed workload (workload counters — probe hits subtracted),
+rows_executed strictly BELOW rows_requested (the plane's whole point:
+only cold rows execute), the row-path bit-identity probe reporting a
+match against the disarmed plane, and zero gRPC errors. Exits nonzero
+with a reason otherwise, so CI fails with evidence instead of a silent
+green.
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tier1_rowcache_soak.json"
+    lines = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    continue
+    if not lines:
+        print(f"row-cache smoke: no JSON line in {path}", file=sys.stderr)
+        return 1
+    line = lines[-1]
+    row = line.get("row_cache") or {}
+    problems = []
+    if row.get("workload_hits", 0) <= 0:
+        problems.append(
+            f"zero workload row hits (row_cache block: {row})"
+        )
+    req = row.get("workload_rows_requested", 0)
+    execd = row.get("workload_rows_executed", 0)
+    if req <= 0:
+        problems.append("zero rows entered cold-row extraction")
+    elif execd >= req:
+        problems.append(
+            f"rows_executed ({execd}) >= rows_requested ({req}): the row "
+            "cache saved no device work"
+        )
+    if row.get("scores_match") is not True:
+        problems.append(
+            f"row scores_match != True (got {row.get('scores_match')!r}): "
+            "row-assembled scores are not bit-identical to the disarmed "
+            "plane"
+        )
+    if line.get("grpc_err", 0):
+        problems.append(
+            f"gRPC errors during the row-cache soak: {line.get('grpc_err')}"
+        )
+    if problems:
+        for p in problems:
+            print(f"row-cache smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print(
+        "row-cache smoke ok: rows_executed/rows_requested={}/{} ({:.3f}) "
+        "workload_row_hits={} coalesced={} full_hit_batches={} "
+        "scores_match={}".format(
+            execd, req, execd / req if req else 0.0,
+            row.get("workload_hits"), row.get("workload_coalesced"),
+            row.get("row_full_hit_batches"), row.get("scores_match"),
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

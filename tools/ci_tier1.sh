@@ -53,6 +53,21 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_CACHE_SMOKE:-0}" = "1" ]; then
     python tools/check_cache_smoke.py "$CACHE_LINE" || rc=1
 fi
 
+# Row-cache smoke (TIER1_ROWCACHE_SMOKE=1): a short SOAK_ROWCACHE=1 zipfian
+# soak — the row-granular cache (ISSUE 14) next to the request cache — must
+# report a NONZERO per-row hit rate, rows_executed < rows_requested (only
+# cold rows reached the device), bit-identical scores vs the disarmed
+# plane, and zero gRPC errors (tools/check_rowcache_smoke.py).
+if [ "$rc" -eq 0 ] && [ "${TIER1_ROWCACHE_SMOKE:-0}" = "1" ]; then
+    ROWCACHE_LINE="${TIER1_ROWCACHE_LINE:-/tmp/tier1_rowcache_soak.json}"
+    echo "tier1: row-cache smoke (SOAK_ROWCACHE=1, line $ROWCACHE_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_SMOKE_SECONDS:-8}" SOAK_ROWCACHE=1 \
+        SOAK_GRPC_WORKERS=4 SOAK_REST_WORKERS=1 SOAK_CANDIDATES=64 \
+        python tools/soak.py | tee "$ROWCACHE_LINE" || rc=1
+    python tools/check_rowcache_smoke.py "$ROWCACHE_LINE" || rc=1
+fi
+
 # Overload smoke (TIER1_OVERLOAD_SMOKE=1): a short SOAK_OVERLOAD=1 soak —
 # ~3x sustainable load with a mid-run burst against the adaptive admission
 # plane — must show nonzero sheds, nonzero brownout stale-serves, client

@@ -31,6 +31,10 @@ the batcher, gRPC workers on a seeded zipfian workload —
 SOAK_CACHE_SKEW/SOAK_CACHE_SEED — plus a pre-flight bit-identity probe;
 the JSON line gains a `cache` block with hit/miss/coalesced/dedup
 counters and `scores_match`),
+SOAK_ROWCACHE=1 (cache mode plus the ROW-GRANULAR cache, ISSUE 14: only
+cold rows execute; adds a `row_cache` block with per-row hit/miss
+counters, rows_executed vs rows_requested, and a row-path bit-identity
+probe — the TIER1_ROWCACHE_SMOKE gate reads it),
 SOAK_REQUEST_LOG_SAMPLING (default 0 = logging off; >0 stresses the
 bounded-queue request logger under the mixed load — note it adds a
 SerializeToString per sampled request, so A/Bs against logging-off soaks
@@ -227,6 +231,16 @@ def main() -> None:
     # pins correctness: the same payload scored uncached (the filling
     # miss) and cached (the hit) must be bit-identical.
     cache_mode = os.environ.get("SOAK_CACHE", "0") == "1"
+    # Row-cache mode (SOAK_ROWCACHE=1, ISSUE 14): the cache-mode zipfian
+    # workload with the ROW-GRANULAR cache armed next to the request
+    # cache + dedup — distinct payloads sharing hot catalog rows execute
+    # only their cold rows. The probe additionally pins row-path
+    # bit-identity (disarmed reference vs row-filling miss vs
+    # row-assembled hit), and the JSON line gains a `row_cache` block
+    # (per-row hit/miss counters, rows_executed vs rows_requested) the
+    # TIER1_ROWCACHE_SMOKE gate reads.
+    rowcache_mode = os.environ.get("SOAK_ROWCACHE", "0") == "1"
+    cache_mode = cache_mode or rowcache_mode
     cache_skew = float(os.environ.get("SOAK_CACHE_SKEW", "1.1"))
     util_mode = os.environ.get("SOAK_UTIL", "0") == "1"
     # Quality mode (SOAK_QUALITY=1): trained model, teacher-labeled
@@ -378,6 +392,7 @@ def main() -> None:
         # VersionWatcher loads (and queue-warms) it like production.
         registry.load(servable)
     score_cache = None
+    row_cache = None
     if cache_mode:
         from distributed_tf_serving_tpu.cache import ScoreCache
 
@@ -385,6 +400,10 @@ def main() -> None:
         # cache plane's behavior under load, not TTL churn (TTL/eviction
         # correctness is tests/test_cache.py's job).
         score_cache = ScoreCache(ttl_s=max(seconds * 2, 600.0))
+        if rowcache_mode:
+            from distributed_tf_serving_tpu.cache import RowScoreCache
+
+            row_cache = RowScoreCache(ttl_s=max(seconds * 2, 600.0))
     elif overload_mode:
         from distributed_tf_serving_tpu.cache import ScoreCache
 
@@ -457,7 +476,8 @@ def main() -> None:
         )
     batcher = DynamicBatcher(
         buckets=buckets, max_wait_us=2000, completion_workers=12,
-        score_cache=score_cache, dedup=cache_mode, overload=overload_ctrl,
+        score_cache=score_cache, row_cache=row_cache, dedup=cache_mode,
+        overload=overload_ctrl,
         utilization=ledger, quality=quality_monitor, **batcher_kw,
     ).start()
     batcher.max_batch_candidates = buckets[-1]
@@ -650,10 +670,12 @@ def main() -> None:
         # must both be bit-identical to the disarmed reference.
         probe = zipf_pool[0]
         batcher.score_cache, batcher.dedup = None, False
+        batcher.row_cache = None
         ref = batcher.submit(
             servable, probe, output_keys=("prediction_node",)
         ).result(timeout=600)["prediction_node"]
         batcher.score_cache, batcher.dedup = score_cache, True
+        batcher.row_cache = row_cache
         miss = batcher.submit(
             servable, probe, output_keys=("prediction_node",)
         ).result(timeout=600)["prediction_node"]
@@ -663,6 +685,29 @@ def main() -> None:
         cache_block["scores_match"] = bool(
             np.array_equal(ref, miss) and np.array_equal(ref, hit)
         )
+        if rowcache_mode:
+            # Row-path bit-identity: with the REQUEST cache detached, the
+            # same payload must answer identically from a flushed row
+            # cache (the filling miss — every row cold) and from the
+            # fully-warm row cache (zero device work, pure assembly).
+            batcher.score_cache, batcher.dedup = None, False
+            row_cache.flush()
+            row_miss = batcher.submit(
+                servable, probe, output_keys=("prediction_node",)
+            ).result(timeout=600)["prediction_node"]
+            row_hit = batcher.submit(
+                servable, probe, output_keys=("prediction_node",)
+            ).result(timeout=600)["prediction_node"]
+            batcher.score_cache, batcher.dedup = score_cache, True
+            cache_block["row_scores_match"] = bool(
+                np.array_equal(ref, row_miss)
+                and np.array_equal(ref, row_hit)
+            )
+            cache_block["row_probe_snapshot"] = {
+                k: row_cache.snapshot()[k]
+                for k in ("hits", "misses", "coalesced",
+                          "rows_requested", "rows_executed")
+            }
         # Counter baseline AFTER the probe: the reported hit/miss/coalesced
         # workload numbers (and the CI gate) must come from worker traffic,
         # not from the probe's guaranteed hit.
@@ -1455,6 +1500,29 @@ def main() -> None:
                 },
             }
             if cache_mode else None
+        ),
+        "row_cache": (
+            {
+                **{k: v for k, v in row_cache.snapshot().items()
+                   if k != "models"},
+                "scores_match": cache_block.get("row_scores_match"),
+                "row_batches": batcher.stats.row_batches,
+                "row_full_hit_batches": batcher.stats.row_full_hit_batches,
+                "batcher_rows_requested": batcher.stats.rows_requested,
+                "batcher_rows_executed": batcher.stats.rows_executed,
+                # Workload-only deltas (probe counts subtracted): the CI
+                # gate reads these, so the probe's guaranteed row hits
+                # can never green-wash a row cache idle under load.
+                **{
+                    f"workload_{k}": (
+                        row_cache.snapshot()[k]
+                        - cache_block.get("row_probe_snapshot", {}).get(k, 0)
+                    )
+                    for k in ("hits", "misses", "coalesced",
+                              "rows_requested", "rows_executed")
+                },
+            }
+            if rowcache_mode else None
         ),
         "resilience": resilience or None,
         "overload": (
