@@ -799,22 +799,25 @@ def kernel_ab_block(batcher, servable, scale: Scale, config) -> dict:
         batcher.kernels = None
 
 
-def mesh_ab_block(device: str) -> dict:
-    """Mesh serving A/B (ISSUE 13, opt-in via DTS_BENCH_MESH=1): run
-    tools/mesh_ab.py in a SUBPROCESS — single-chip vs data-parallel
-    ({N,1}) vs data×model ({N/2,2}) serving throughput of one process,
-    with a bit-identity gate across all three modes.
-
-    The subprocess is the point: the mesh needs >= MESH_AB_DEVICES chips,
-    and this child may be running on a 1-device CPU host — the block then
-    forces an EMULATED 8-device CPU mesh in the child's env and records
-    `emulated: true` (the standing-debt field: emulated numbers are
-    functional trajectory points, never throughput claims; the next
-    live-TPU round overwrites them with emulated: false ones the same
-    block shape)."""
-    need = int(os.environ.get("MESH_AB_DEVICES", "8"))
+def _device_ab_block(
+    device: str, script_name: str, label: str,
+    devices_env: str, force_cpu_env: str,
+) -> dict:
+    """ONE substrate-selection implementation for the multi-device A/B
+    children (mesh_ab.py, elastic_ab.py): on a live slice with >= the
+    needed chips, run the child IN-PROCESS — this bench child already
+    owns the TPU backend (libtpu is single-process-exclusive), so a
+    subprocess could never initialize it; otherwise force an EMULATED
+    N-device CPU mesh in a SUBPROCESS (the device count must land in
+    the env before that process imports jax; `force_cpu_env` is the
+    child's pre-import emulation switch). `emulated` records which —
+    the standing-debt field keeping CPU trajectory points distinct from
+    live-slice throughput. One copy on purpose: the PR-13 review fixed
+    a substrate bug in exactly this logic once, and a second copy would
+    need the same fix found twice."""
+    need = int(os.environ.get(devices_env, "8"))
     script = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "tools", "mesh_ab.py"
+        os.path.dirname(os.path.abspath(__file__)), "tools", script_name
     )
     live = False
     try:
@@ -827,28 +830,23 @@ def mesh_ab_block(device: str) -> dict:
     except Exception:  # noqa: BLE001 — substrate probe only
         pass
     if live:
-        # LIVE slice: run IN-PROCESS. This bench child already owns the
-        # TPU backend (libtpu is single-process-exclusive), so a
-        # subprocess could never initialize it — importing the module
-        # here reuses the live devices this process holds.
         try:
             import importlib.util
 
-            spec = importlib.util.spec_from_file_location("mesh_ab", script)
+            spec = importlib.util.spec_from_file_location(
+                script_name.removesuffix(".py"), script
+            )
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)
             block = mod.main()
         except Exception as exc:  # noqa: BLE001 — diagnostic block only
-            return {"error": f"mesh A/B in-process failed: {exc}",
+            return {"error": f"{label} A/B in-process failed: {exc}",
                     "emulated": False}
         block["emulated"] = False
         block["parent_device"] = device
         return block
-    # No live slice: an EMULATED 8-device CPU mesh in a SUBPROCESS (the
-    # forced device count must land before that process imports jax;
-    # MESH_AB_FORCE_CPU is the child's pre-import emulation switch).
     env = dict(os.environ)
-    env["MESH_AB_FORCE_CPU"] = "1"
+    env[force_cpu_env] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "")
@@ -861,17 +859,44 @@ def mesh_ab_block(device: str) -> dict:
             text=True, timeout=600,
         )
     except subprocess.TimeoutExpired:
-        return {"error": "mesh A/B child timed out", "emulated": True}
+        return {"error": f"{label} A/B child timed out", "emulated": True}
     block = _last_json(r.stdout)
     if block is None:
         tail = (r.stderr or "").strip().splitlines()[-3:]
         return {
-            "error": f"mesh A/B child rc={r.returncode}, no JSON line",
+            "error": f"{label} A/B child rc={r.returncode}, no JSON line",
             "stderr_tail": tail, "emulated": True,
         }
     block["emulated"] = True
     block["parent_device"] = device
     return block
+
+
+def mesh_ab_block(device: str) -> dict:
+    """Mesh serving A/B (ISSUE 13, opt-in via DTS_BENCH_MESH=1):
+    tools/mesh_ab.py — single-chip vs data-parallel ({N,1}) vs
+    data×model ({N/2,2}) serving throughput of one process, with a
+    bit-identity gate across all three modes. Substrate selection (live
+    in-process vs emulated subprocess) in _device_ab_block."""
+    return _device_ab_block(
+        device, "mesh_ab.py", "mesh",
+        devices_env="MESH_AB_DEVICES", force_cpu_env="MESH_AB_FORCE_CPU",
+    )
+
+
+def elastic_ab_block(device: str) -> dict:
+    """Elastic serving A/B (ISSUE 15, opt-in via DTS_BENCH_ELASTIC=1):
+    tools/elastic_ab.py — the SAME seeded ramped stream (nominal ->
+    pressure -> recovery phases) served by a pinned {N/2,2} split and by
+    the elastic ladder, reporting goodput per pressure phase, switch
+    count + history, and the first post-switch request latency (the
+    no-serving-path-compile evidence). Substrate selection (live
+    in-process vs emulated subprocess) in _device_ab_block."""
+    return _device_ab_block(
+        device, "elastic_ab.py", "elastic",
+        devices_env="ELASTIC_AB_DEVICES",
+        force_cpu_env="ELASTIC_AB_FORCE_CPU",
+    )
 
 
 def device_decomposition(batcher, servable, scale: Scale, rtt_floor_ms, device: str) -> dict:
@@ -2625,6 +2650,17 @@ def child_main() -> None:
                     for m, b in (res["mesh"].get("modes") or {}).items()
                 },
             }))
+        if os.environ.get("DTS_BENCH_ELASTIC", "0") == "1":
+            stage = "elastic"
+            res["elastic"] = elastic_ab_block(device)
+            log(stage, json.dumps({
+                "emulated": res["elastic"].get("emulated"),
+                "bit_identical": res["elastic"].get("bit_identical"),
+                "switch_count": res["elastic"].get("switch_count"),
+                "goodput_gain_by_phase": res["elastic"].get(
+                    "goodput_gain_by_phase"
+                ),
+            }))
         batcher.stop()
 
         asyncio.run(measure_host_ceiling())
@@ -2698,6 +2734,15 @@ def child_main() -> None:
             # ran on forced CPU devices (functional trajectory point) or
             # a live slice (real throughput). Absent when off (default).
             "mesh": res.get("mesh"),
+            # Elastic serving A/B (ISSUE 15, DTS_BENCH_ELASTIC=1): the
+            # same seeded ramped stream (nominal -> pressure ->
+            # recovery) against a pinned {N/2,2} split vs the elastic
+            # ladder — per-phase goodput, switch count + history, the
+            # first post-switch latency next to the steady p50 (warmup-
+            # built executables only: no compile spike), bit-identity
+            # across runs, and the emulated-vs-live flag. Absent when
+            # off (default).
+            "elastic": res.get("elastic"),
             # Output-transfer pipeline attribution (ISSUE 1): wire bytes
             # fetched vs. the full-fp32 all-outputs baseline, and the
             # fraction of the in-flight D2H window the completers never

@@ -63,9 +63,17 @@ def row_label_keys(arrays: dict[str, np.ndarray]) -> list[str]:
     canonical bytes — the same canonical_rows layout the dedup plane
     keys row identity on, so the key a client computes over the arrays
     it SENT equals the key the server computes over the arrays it
-    decoded. Plain blake2b (not the native hash128): both sides must
-    produce identical hex with or without the compiled host ops."""
+    decoded. The digest is ALWAYS blake2b (both sides must produce
+    identical hex with or without the compiled host ops); when the host
+    ops are built, native.hash128_rows computes the SAME blake2b for the
+    whole batch in one GIL-released call (RFC 7693 in hostops.cc,
+    byte-identity regression-tested) instead of a per-row python loop."""
     rows = canonical_rows(arrays)
+    from .. import native
+
+    if native.available():
+        digests = native.hash128_rows(rows)
+        return [digests[i].tobytes().hex() for i in range(digests.shape[0])]
     return [
         hashlib.blake2b(rows[i].tobytes(), digest_size=16).hexdigest()
         for i in range(rows.shape[0])

@@ -55,9 +55,23 @@ def digest_rows(
 ) -> list[bytes]:
     """16-byte blake2b digest per row of a canonical_rows blob (+ the
     structure header). `rows` restricts to a subset of row indices (the
-    dedup-unique slots); None digests every row. Plain blake2b, matching
-    row_label_keys: the digest must not depend on whether the native host
-    ops are built."""
+    dedup-unique slots); None digests every row. ALWAYS blake2b, matching
+    row_label_keys — the digest must not depend on whether the native
+    host ops are built — but with the host ops present the whole batch
+    hashes in ONE GIL-released native call (hostops.cc hash128_rows, the
+    same RFC 7693 blake2b byte for byte) instead of a per-row python
+    loop: at the armed-row-cache bucket sizes the loop was a measurable
+    slice of every batch's host time (ISSUE 15 satellite)."""
+    from .. import native
+
+    if native.available():
+        if rows is None:
+            sel = blob
+        else:
+            idx = np.fromiter(rows, dtype=np.int64)
+            sel = blob[idx] if idx.size else blob[:0]
+        digests = native.hash128_rows(sel, header)
+        return [digests[i].tobytes() for i in range(digests.shape[0])]
     if rows is None:
         rows = range(blob.shape[0])
     out = []

@@ -106,6 +106,10 @@ def _load_locked(build: bool = True) -> ctypes.CDLL | None:
     lib.pack_u24_i32.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
     lib.f32_to_bf16.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
     lib.hash128.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    lib.hash128_rows.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+    ]
     lib.pack_batch_u24_bf16.argtypes = [
         ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
         ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
@@ -188,6 +192,28 @@ def hash128(arr: np.ndarray) -> bytes:
     out = np.empty(2, np.uint64)
     lib.hash128(_ptr(arr), arr.nbytes, _ptr(out))
     return out.tobytes()
+
+
+def hash128_rows(blob: np.ndarray, header: bytes = b"") -> np.ndarray:
+    """Batched per-row blake2b-128 (ISSUE 15 satellite): a [n, B] uint8
+    row matrix -> [n, 16] uint8 digests, row i = blake2b(header +
+    blob[i].tobytes(), digest_size=16) — BYTE-IDENTICAL to hashlib's
+    blake2b (RFC 7693 in hostops.cc), because these digests are wire
+    contracts (row-cache keys, dedup identity, client label-join keys)
+    that must not depend on whether the host ops are built. One
+    GIL-released call hashes the whole batch."""
+    lib = _load()
+    assert lib is not None
+    blob = np.ascontiguousarray(blob, dtype=np.uint8)
+    if blob.ndim != 2:
+        raise ValueError(f"hash128_rows wants [n, B] uint8, got {blob.shape}")
+    header = bytes(header)
+    out = np.empty((blob.shape[0], 16), np.uint8)
+    lib.hash128_rows(
+        header, len(header), _ptr(blob), blob.shape[0], blob.shape[1],
+        _ptr(out),
+    )
+    return out
 
 
 def f32_to_bf16(wts: np.ndarray) -> np.ndarray:

@@ -7,6 +7,7 @@ from .embedding_sharding import (
     sharded_field_embed,
     tree_path_str,
 )
+from .elastic import ElasticController, ElasticMeshExecutor
 from .executor import ShardedExecutor, shard_map_score
 from .mesh import (
     DATA_AXIS,
@@ -33,6 +34,8 @@ __all__ = [
     "batch_shardings",
     "place_params",
     "ShardedExecutor",
+    "ElasticMeshExecutor",
+    "ElasticController",
     "shard_map_score",
     "sharded_field_embed",
     "MODEL_PARTITION_RULES",

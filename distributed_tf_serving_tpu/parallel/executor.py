@@ -262,6 +262,18 @@ class ShardedExecutor:
         # are already real-rows-only; sidecars are minted after it.
         return fn(params, packed, out_keys=out_keys, pad=pad)
 
+    def clear_for_recovery(self) -> None:
+        """REINIT hook ([recovery]×[mesh] compose, ISSUE 15): drop the
+        placed params and compiled entries — after a device failure they
+        reference the dead backend state, exactly like the single-chip
+        batcher's _jitted entries the recovery plane already clears. The
+        recovery re-warm rebuilds them through the queue before replay
+        (the executor recovers as ONE unit; per-chip recovery of an SPMD
+        executable is not a thing)."""
+        with self._lock:
+            self._placed = weakref.WeakKeyDictionary()
+            self._jitted = weakref.WeakKeyDictionary()
+
     def snapshot(self) -> dict:
         """The `mesh` /monitoring block body: mesh geometry + devices +
         serving counters + the layout source per served model. Per-device

@@ -1310,6 +1310,13 @@ class DynamicBatcher:
         """Precompile the bucket ladder for a servable (compile storms belong
         at load time, not first-request time). Executes directly — only safe
         before the batcher serves traffic; once live, use warmup_via_queue.
+        EXCEPTION: elastic run_fns — the elastic branch below routes through
+        warmup_call into each ShardedExecutor's internally-locked entry
+        cache and never touches the single-chip _jitted dict this contract
+        protects, so warmup_via_queue's ladder tail and the recovery
+        re-warm deliberately call it on a LIVE batcher. Keep it that way:
+        batcher-level warmup state for run_fn executors belongs behind
+        the queue, not here.
 
         Each bucket warms the output-selection variants live traffic
         predictably hits: the all-outputs entry (unfiltered requests,
@@ -1335,10 +1342,27 @@ class DynamicBatcher:
             out_variants: tuple = (None,)
             if getattr(self._run_fn, "supports_out_keys", False):
                 out_variants = (None, (model.score_output,))
+            # Elastic executors warm EVERY split's executable per variant
+            # (warmup_call) — the switch-never-compiles contract: a
+            # runtime split change must never pay an XLA compile on the
+            # dispatch path (which would stall the pipeline, and trip the
+            # [recovery] wedge clock when armed). The arrays are folded
+            # here exactly like _execute folds them, so the warmed
+            # executables match live traffic's dtypes.
+            warm_all = (
+                self._run_fn.warmup_call
+                if getattr(self._run_fn, "elastic", False) else None
+            )
             for b in buckets or self.buckets:
                 arrays = prepare_inputs(model, self.warmup_arrays(servable, b))
                 for out_keys in out_variants:
-                    self._execute(servable, arrays, out_keys=out_keys)
+                    if warm_all is not None:
+                        warm_all(
+                            servable, self._fold_host(servable, arrays),
+                            out_keys=out_keys,
+                        )
+                    else:
+                        self._execute(servable, arrays, out_keys=out_keys)
             return
         score_only = (model.score_output,)
         _, _, combined = self._jit_for(servable)
@@ -1376,6 +1400,15 @@ class DynamicBatcher:
         ]
         for fut in futures:
             fut.result(timeout=600)
+        if getattr(self._run_fn, "elastic", False):
+            # The queue path compiled only the CURRENT split's entries.
+            # Warm the rest of the ladder directly (warmup() routes
+            # elastic run_fns through warmup_call — every split; the
+            # current split's second pass is a cache hit), so a
+            # hot-loaded version keeps the switch-never-compiles
+            # contract: its first post-switch batch must not pay an XLA
+            # compile on the dispatch path.
+            self.warmup(servable, buckets)
 
     def jit_entry(self, servable: Servable) -> tuple[Callable, dict[str, str], bool]:
         """The (jitted fn, transfer spec, combined) this batcher serves
@@ -1388,6 +1421,18 @@ class DynamicBatcher:
         selecting the output-compaction variant — defaults reproduce the
         all-outputs entry (see _build_entry)."""
         return self._jit_for(servable)
+
+    def queue_load(self) -> tuple[int, int]:
+        """(queued + staged candidates, configured queue capacity) — the
+        elastic controller's queue-pressure signal (parallel/elastic.py):
+        the fraction of the admission bound currently waiting is the
+        backlog term of its load EWMA. One lock hold, called at most once
+        per controller tick interval."""
+        with self._cv:
+            return (
+                self._queued_candidates + self._staged_candidates,
+                self.queue_capacity_candidates,
+            )
 
     def pipeline_stats(self) -> dict:
         """Continuous-batching pipeline snapshot (ISSUE 9): configured
@@ -1910,6 +1955,21 @@ class DynamicBatcher:
         k_apply = kern.pallas_apply_for(servable, quantized) if pallas else None
         return params, k_apply
 
+    @staticmethod
+    def _fold_host(servable: Servable, arrays: dict) -> dict:
+        """Deferred per-request fold (prepare_inputs fold_ids=False): one
+        native fold over the whole padded batch. Runs BEFORE the content
+        digest, so cache keys are over the same folded bytes as the
+        eager-fold path produced. Shared by _execute and the elastic
+        warmup path (which calls the run_fn directly and must hand it the
+        exact dtype live traffic carries — an unfolded int64 batch would
+        warm an executable no live batch ever hits)."""
+        ids = arrays.get("feat_ids")
+        if ids is not None and ids.dtype == np.int64 and servable.model.folds_ids_on_host:
+            arrays = dict(arrays)
+            arrays["feat_ids"] = fold_ids_host(ids, servable.model.config.vocab_size)
+        return arrays
+
     def _execute(
         self,
         servable: Servable,
@@ -1927,14 +1987,7 @@ class DynamicBatcher:
         donating variant without going through cache-bypass traffic;
         _kernel_override pins the kernel plane's (quantized, pallas)
         variant for the autotune harness."""
-        ids = arrays.get("feat_ids")
-        if ids is not None and ids.dtype == np.int64 and servable.model.folds_ids_on_host:
-            # Deferred per-request fold (prepare_inputs fold_ids=False):
-            # one native fold over the whole padded batch. Runs BEFORE the
-            # content digest, so cache keys are over the same folded bytes
-            # as the eager-fold path produced.
-            arrays = dict(arrays)
-            arrays["feat_ids"] = fold_ids_host(ids, servable.model.config.vocab_size)
+        arrays = self._fold_host(servable, arrays)
         if self._run_fn is not None:
             if getattr(self._run_fn, "supports_out_keys", False):
                 # Mesh executor (parallel/executor.py): the group's
@@ -2663,6 +2716,15 @@ class DynamicBatcher:
         pending_closed = sid is None
         util = None  # assigned once the batch passes the early-out checks
         util_handed_off = False
+        # Elastic run_fn completion protocol (parallel/elastic.py): the
+        # dispatch below mints a per-batch issue token naming the split it
+        # routed to; the completer's finally closes it (note_complete) —
+        # the per-split in-flight accounting that is the hitless-switch
+        # drain barrier. Captured here so a run_fn detached mid-flight
+        # still gets its token back.
+        run_fn_cap = self._run_fn
+        run_token = None
+        run_handed = False
 
         def release_bufs():
             # Pre-completion exit (shed, all-cancelled, device-stage
@@ -2791,6 +2853,12 @@ class DynamicBatcher:
                             servable, batched,
                             out_keys=wanted_key, topk=topk, n_valid=n_valid,
                         )
+            if run_fn_cap is not None and getattr(run_fn_cap, "elastic", False):
+                # Same thread, synchronous: the token names the split the
+                # dispatch above routed to. It travels to the completer
+                # and closes there (or in this frame's finally on a
+                # pre-handoff failure).
+                run_token = run_fn_cap.take_issue_token()
             if topk:
                 self.stats.topk_batches += 1
                 # Top-k outputs ARE the fetch (the score vector is
@@ -2889,11 +2957,13 @@ class DynamicBatcher:
             self._completers.submit(
                 self._complete, batch_id, group, fetch, issue_t0, meta, scatter,
                 stage_t0, util=util, bucket=bucket, ring_bufs=ring_bufs,
-                row_ctx=row_ctx,
+                row_ctx=row_ctx, run_token=run_token,
+                run_fn=run_fn_cap if run_token is not None else None,
             ).add_done_callback(
                 lambda f, g=group: self._guard_worker_future(f, g, "completer")
             )
             util_handed_off = True
+            run_handed = True
         except Exception as exc:  # propagate to every waiter, keep serving
             # Ring buffers are deliberately NOT recycled on a device-stage
             # failure: an async H2D transfer may still be reading them, so
@@ -2926,6 +2996,14 @@ class DynamicBatcher:
                 # A device-stage failure never reaches _complete: close
                 # the gauge here so in_flight cannot drift upward.
                 util.depth_dec()
+            if run_token is not None and not run_handed:
+                # A minted-but-never-handed-off token (post-dispatch
+                # failure before the completer submit) must close here,
+                # or the elastic drain barrier holds open forever.
+                try:
+                    run_fn_cap.note_complete(run_token)
+                except Exception:  # noqa: BLE001 — accounting, never fatal
+                    pass
             with self._cv:
                 self._dispatching_since = None
                 self._dispatching_group = None
@@ -2941,6 +3019,7 @@ class DynamicBatcher:
         util=None, bucket: int = 0,
         ring_bufs: list | None = None,
         row_ctx: "_RowContext | None" = None,
+        run_token=None, run_fn=None,
     ) -> None:
         phases: list | None = (
             [] if tracing.enabled() and any(it.span is not None for it in group)
@@ -3106,6 +3185,14 @@ class DynamicBatcher:
         finally:
             if util is not None:
                 util.depth_dec()
+            if run_token is not None and run_fn is not None:
+                # Close the elastic per-split in-flight registration: THIS
+                # is the drain barrier's release point — readback done (or
+                # failed), the old split's batch is no longer in flight.
+                try:
+                    run_fn.note_complete(run_token)
+                except Exception:  # noqa: BLE001 — accounting, never fatal
+                    pass
             # Recycle the padded-batch buffers: the readback finished, so
             # the H2D upload that read them is long done — the only point
             # in the batch lifecycle where reuse is provably safe. The

@@ -514,6 +514,16 @@ class RecoveryController:
                 b._jitted.clear()
         except Exception:  # noqa: BLE001 — a fake batcher may lack these
             pass
+        # [recovery]×[mesh] compose (ISSUE 15): a custom run_fn that owns
+        # device state (the ShardedExecutor's placed params + sharded
+        # executables, or the elastic executor's whole ladder) is part of
+        # the executor unit this plane recovers — clear it like the
+        # single-chip entries above. Executors without the hook (tests'
+        # plain callables) are untouched.
+        run_fn = getattr(b, "_run_fn", None)
+        clear_run_fn = getattr(run_fn, "clear_for_recovery", None)
+        if clear_run_fn is not None:
+            self._safe(clear_run_fn)
         cache = getattr(b, "input_cache", None)
         if cache is not None:
             self._safe(cache.clear)
@@ -565,6 +575,26 @@ class RecoveryController:
             fut_wait(futs, timeout=max(
                 getattr(self.config, "rewarm_timeout_s", 120.0), 1.0
             ))
+        run_fn = getattr(b, "_run_fn", None)
+        if getattr(run_fn, "elastic", False):
+            # Elastic mesh (ISSUE 15): the queue re-warm above compiled
+            # only the CURRENT split's executables. Re-warm the whole
+            # ladder directly (batcher.warmup routes elastic run_fns
+            # through warmup_call, every split) so a post-recovery
+            # switch keeps the never-compiles-on-the-serving-path
+            # contract — a rung compiling under the wedge clock would
+            # trip a spurious re-quarantine.
+            for name in names:
+                try:
+                    sv = reg.resolve(name)
+                except Exception:  # noqa: BLE001 — vanished mid-cycle
+                    continue
+                try:
+                    b.warmup(sv)
+                except Exception:  # noqa: BLE001 — keep warming the rest
+                    log.exception(
+                        "recovery elastic ladder re-warm failed (%s)", name
+                    )
 
     def _wait_replay(self, futs: list) -> None:
         """Bounded wait for the replayed futures: ends early when a

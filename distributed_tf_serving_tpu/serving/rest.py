@@ -194,6 +194,11 @@ class RestGateway:
             # poisoned-input bisection verdicts, and the last cycle's
             # duration (the live MTTR evidence).
             web.get("/recoveryz", self.recoveryz),
+            # Mesh serving mode (ISSUE 13/15): geometry, device list,
+            # executor pad/layout counters — and, with [elastic] armed,
+            # the current split, switch history ring, and per-split
+            # serve counters.
+            web.get("/meshz", self.meshz),
         ])
 
     # ------------------------------------------------------------- helpers
@@ -551,10 +556,12 @@ class RestGateway:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         stats = getattr(self.impl.batcher, "stats", None)
-        # Computed once and shared with the mesh block: mesh_stats lifts
-        # its per-device attribution from this snapshot instead of
-        # re-running the ledger's waterfall merge per scrape.
+        # Computed once and shared downstream: mesh_stats lifts its
+        # per-device attribution from the utilization snapshot, and
+        # elastic_stats lifts its block from the mesh snapshot — one
+        # snapshot each per scrape, never recomputed.
         utilization = self.impl.utilization_stats()
+        mesh = self.impl.mesh_stats(utilization=utilization)
         return web.Response(
             body=self.metrics.prometheus_text(
                 stats, cache=self.impl.cache_stats(),
@@ -566,7 +573,8 @@ class RestGateway:
                 pipeline=self.impl.pipeline_stats(),
                 recovery=self.impl.recovery_stats(),
                 kernels=self.impl.kernels_stats(),
-                mesh=self.impl.mesh_stats(utilization=utilization),
+                mesh=mesh,
+                elastic=self.impl.elastic_stats(mesh=mesh),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -601,6 +609,7 @@ class RestGateway:
             "recovery": self.impl.recovery_stats,
             "kernels": self.impl.kernels_stats,
             "mesh": self.impl.mesh_stats,
+            "elastic": self.impl.elastic_stats,
             "versions": self.impl.versions_stats,
             "pipeline": self.impl.pipeline_stats,
             "request_log": request_log,
@@ -635,11 +644,17 @@ class RestGateway:
         # waterfall merge).
         for name in ("cache", "row_cache", "overload", "utilization",
                      "quality", "lifecycle", "recovery", "kernels", "mesh",
-                     "versions", "pipeline"):
-            block = (
-                self.impl.mesh_stats(utilization=snap.get("utilization"))
-                if name == "mesh" else builders[name]()
-            )
+                     "elastic", "versions", "pipeline"):
+            if name == "mesh":
+                block = self.impl.mesh_stats(
+                    utilization=snap.get("utilization")
+                )
+            elif name == "elastic":
+                # Lifted from the mesh block computed just above in this
+                # same pass — never a second executor/history walk.
+                block = self.impl.elastic_stats(mesh=snap.get("mesh"))
+            else:
+                block = builders[name]()
             if block is not None:
                 snap[name] = block
         snap["draining"] = builders["draining"]()
@@ -794,6 +809,20 @@ class RestGateway:
         `{"enabled": false}` when no controller is armed ([recovery]
         enabled=false), so probes need no config knowledge."""
         stats = self.impl.recovery_stats()
+        return web.json_response(
+            stats if stats is not None else {"enabled": False}
+        )
+
+    async def meshz(self, request: web.Request) -> web.Response:
+        """GET /meshz: the mesh serving-mode surface — mesh geometry +
+        device list, executor batch/pad counters, the layout source per
+        served model, per-device occupancy attribution when the
+        utilization ledger rides along, and (elastic mode, ISSUE 15) the
+        `elastic` block: current split, ladder, switch history ring,
+        per-split serve counters, controller state. `{"enabled": false}`
+        when serving is single-chip, so probes need no config
+        knowledge."""
+        stats = self.impl.mesh_stats()
         return web.json_response(
             stats if stats is not None else {"enabled": False}
         )

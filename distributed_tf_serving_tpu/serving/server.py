@@ -1205,6 +1205,7 @@ def build_stack(
     recovery_config=None,
     kernels_config=None,
     mesh_config=None,
+    elastic_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -1249,10 +1250,21 @@ def build_stack(
     rules, same wire protocol, one process spanning N chips. Mode
     conflicts are EXPLICIT build-time refusals, never runtime surprises:
     [kernels] (per-bucket kernel routing owns the single-chip
-    executables), [recovery] (REINIT rebuilds the batcher's executors,
-    not the mesh executor's), output_top_k (a single-chip jitted-entry
-    variant), and the legacy [server] mesh_devices knob (pick one
-    surface)."""
+    executables), [recovery] scope='per_chip' (an SPMD executable spans
+    every chip; whole-executor recovery COMPOSES — the mesh executor
+    quarantines/reinits/replays as one unit), output_top_k (a
+    single-chip jitted-entry variant), and the legacy [server]
+    mesh_devices knob (pick one surface).
+    elastic_config (the TOML [elastic] section, a utils.config.
+    ElasticConfig; requires [mesh]) arms ELASTIC MESH SERVING
+    (ISSUE 15): a pre-built, pre-warmed ladder of ("data", "model")
+    splits over the same devices with a pressure/load-driven controller
+    switching the serving split at runtime — hitlessly (new dispatches
+    route to the target split while in-flight batches on the old split
+    drain behind the per-split in-flight barrier; executables are
+    warmup-compiled per rung, so a switch never compiles on the serving
+    path). Surfaces: the `elastic` block in /meshz//monitoring and
+    dts_tpu_elastic_* Prometheus series."""
     # Validate plane prerequisites BEFORE any threads exist — a typo'd
     # config must leave nothing to tear down.
     mesh_armed = mesh_config is not None and mesh_config.enabled
@@ -1271,15 +1283,23 @@ def build_stack(
                 "variant the sharded executor does not provide — "
                 "disable one of them"
             )
-        if recovery_config is not None and recovery_config.enabled:
+        if (
+            recovery_config is not None and recovery_config.enabled
+            and getattr(recovery_config, "scope", "executor") == "per_chip"
+        ):
+            # The ISSUE-15 scoped lift: WHOLE-MESH recovery composes (the
+            # watchdog treats the mesh executor as one unit — quarantine
+            # captures everything, REINIT clears the executor's placed
+            # params + sharded executables via clear_for_recovery, replay
+            # re-dispatches through the re-warmed mesh). What stays
+            # refused is the finer granularity nobody implements:
             raise ValueError(
-                "[mesh] enabled conflicts with [recovery]: the recovery "
-                "plane's REINIT rebuilds the single-chip batcher "
-                "executors, not the mesh executor's placed params and "
-                "sharded executables — quarantining a mesh replica would "
-                "replay onto a stale executor. Mesh replicas fail whole "
-                "and clients reroute via the scoreboard (the multihost "
-                "fail-fast contract); per-mesh recovery is future work"
+                "[recovery] scope='per_chip' conflicts with [mesh]: an "
+                "SPMD executable spans every chip of the mesh, so there "
+                "is no per-chip quarantine to run — a sick chip takes "
+                "the executor with it. Use scope='executor' (the "
+                "default): the mesh executor quarantines, reinits, and "
+                "replays as ONE unit"
             )
     lifecycle_armed = lifecycle_config is not None and lifecycle_config.enabled
     if lifecycle_armed:
@@ -1296,6 +1316,15 @@ def build_stack(
                 "version-pair drift and per-version label AUC — a "
                 "lifecycle with no signal could only ever promote blind"
             )
+    elastic_armed = elastic_config is not None and elastic_config.enabled
+    if elastic_armed and not mesh_armed:
+        raise ValueError(
+            "[elastic] enabled requires [mesh] enabled: the elastic "
+            "plane re-factorizes the MESH's devices at runtime — the "
+            "[mesh] section's split is where serving starts (and the "
+            "ladder's rungs must factorize its device count). Arm both, "
+            "or drop [elastic]"
+        )
     model_configs = None
     if cfg.model_config_file:
         if model_base_path or checkpoint or savedmodel:
@@ -1323,15 +1352,59 @@ def build_stack(
         # The [mesh] section is AUTHORITATIVE for the layout (the legacy
         # [server] knobs were refused above, so no silent OR-merge).
         tensor_parallel = mesh_config.tensor_parallel
-        # make_mesh validates device availability and the
-        # devices/model_parallel factorization (explicit refusals).
-        mesh = make_mesh(n_devices, model_parallel=mesh_config.model_parallel)
-        run_fn = ShardedExecutor(
-            mesh,
-            compress_transfer=cfg.compress_transfer,
-            tensor_parallel=tensor_parallel,
-            output_wire_dtype=cfg.output_wire_dtype,
-        )
+        if elastic_armed:
+            # Elastic mesh serving (ISSUE 15): one ShardedExecutor per
+            # ladder rung over the SAME devices, the [mesh] split as the
+            # initial rung; warmup below pre-compiles every rung so a
+            # runtime switch never pays a compile on the serving path.
+            from ..parallel.elastic import (
+                ElasticMeshExecutor,
+                resolve_ladder,
+            )
+
+            if n_devices % mesh_config.model_parallel != 0:
+                # Same refusal (and wording) make_mesh raises on the
+                # static path — a typo'd [mesh] factorization must not
+                # surface as a confusing ladder-entry error here.
+                raise ValueError(
+                    f"n_devices={n_devices} not divisible by "
+                    f"model_parallel={mesh_config.model_parallel}"
+                )
+            initial = (
+                n_devices // mesh_config.model_parallel,
+                mesh_config.model_parallel,
+            )
+            ladder = resolve_ladder(elastic_config.splits, n_devices, initial)
+            run_fn = ElasticMeshExecutor(
+                splits=ladder,
+                initial=initial,
+                devices=list(jax.devices())[:n_devices],
+                compress_transfer=cfg.compress_transfer,
+                tensor_parallel=tensor_parallel,
+                output_wire_dtype=cfg.output_wire_dtype,
+                history_events=elastic_config.history_events,
+            )
+            mesh = run_fn.mesh
+            log.info(
+                "elastic mesh serving on: %d devices, ladder %s (initial "
+                "%s) — `elastic` block in /meshz//monitoring, "
+                "dts_tpu_elastic_* series",
+                n_devices,
+                [f"{d}x{m}" for d, m in ladder],
+                f"{initial[0]}x{initial[1]}",
+            )
+        else:
+            # make_mesh validates device availability and the
+            # devices/model_parallel factorization (explicit refusals).
+            mesh = make_mesh(
+                n_devices, model_parallel=mesh_config.model_parallel
+            )
+            run_fn = ShardedExecutor(
+                mesh,
+                compress_transfer=cfg.compress_transfer,
+                tensor_parallel=tensor_parallel,
+                output_wire_dtype=cfg.output_wire_dtype,
+            )
         log.info(
             "mesh serving mode on: %d devices as %s tensor_parallel=%s "
             "wire=%s — `mesh` block in /monitoring, dts_tpu_mesh_* series",
@@ -1497,6 +1570,30 @@ def build_stack(
         # — wired for the legacy mesh knobs too, so the dryrun/bench
         # surface reports identically.
         impl.mesh_executor = run_fn
+    if elastic_armed:
+        # Elastic controller (ISSUE 15): pressure (overload state, when
+        # that plane is armed) + the batcher's queue-load/bucket-occupancy
+        # EWMA drive runtime split switches. No thread — ticks ride the
+        # dispatch path and monitoring scrapes (the overload precedent).
+        from ..parallel.elastic import ElasticController
+
+        impl.elastic = ElasticController(
+            elastic_config,
+            run_fn,
+            overload=overload_ctrl,
+            load_fn=batcher.queue_load,
+            largest_bucket=max(cfg.buckets),
+        )
+        log.info(
+            "elastic controller on: tick=%.2fs dwell=%.1fs up/down after "
+            "%d/%d ticks, load thresholds %.2f/%.2f, overload pressure "
+            "%s",
+            elastic_config.tick_interval_s, elastic_config.dwell_s,
+            elastic_config.up_after_ticks, elastic_config.down_after_ticks,
+            elastic_config.load_up_threshold,
+            elastic_config.load_down_threshold,
+            "wired" if overload_ctrl is not None else "absent (load-only)",
+        )
     if kernel_manager is not None:
         # Attach the kernel plane: the batcher consults the per-bucket
         # decision table at dispatch; /monitoring + Prometheus read
@@ -1729,7 +1826,19 @@ def serve(argv=None) -> None:
         "[mesh] enabled=true; with --mesh, --mesh-devices / "
         "--model-parallel / --tensor-parallel configure the MESH "
         "section (`mesh` block in /monitoring, dts_tpu_mesh_* series). "
-        "Refuses [kernels], [recovery], and output_top_k at build time",
+        "Refuses [kernels], [recovery] scope='per_chip', and "
+        "output_top_k at build time; whole-executor [recovery] and "
+        "[elastic] compose",
+    )
+    parser.add_argument(
+        "--elastic", action="store_true", default=None,
+        help="elastic mesh serving (ISSUE 15; requires --mesh or [mesh]): "
+        "pre-build a ladder of ('data', 'model') splits over the same "
+        "devices and let a pressure/load-driven controller switch the "
+        "serving split at runtime — hitlessly, with warmup-compiled "
+        "executables per rung. Equivalent to [elastic] enabled=true "
+        "(`elastic` block in /meshz//monitoring, dts_tpu_elastic_* "
+        "series)",
     )
     parser.add_argument("--mesh-devices", dest="mesh_devices", type=int)
     parser.add_argument("--model-parallel", dest="model_parallel", type=int)
@@ -1888,6 +1997,7 @@ def serve(argv=None) -> None:
     from ..utils.config import (
         BatchingConfig,
         CacheConfig,
+        ElasticConfig,
         KernelsConfig,
         LifecycleConfig,
         MeshConfig,
@@ -1940,6 +2050,16 @@ def serve(argv=None) -> None:
     mesh_config = cfgs.get("mesh") or MeshConfig()
     if args.mesh:
         mesh_config = dataclasses.replace(mesh_config, enabled=True)
+    elastic_config = cfgs.get("elastic") or ElasticConfig()
+    if args.elastic:
+        elastic_config = dataclasses.replace(elastic_config, enabled=True)
+        if not mesh_config.enabled:
+            # The --elastic FLAG implies the mesh mode it resizes (the
+            # --lifecycle/--quality precedent: the flag user's intent is
+            # unambiguous). A TOML-only [elastic] without [mesh] is NOT
+            # auto-armed — a serving-topology change must never ride a
+            # config omission; build_stack refuses it explicitly.
+            mesh_config = dataclasses.replace(mesh_config, enabled=True)
     if mesh_config.enabled:
         # With the mesh MODE armed, the CLI mesh-geometry flags configure
         # the [mesh] section (and are withheld from the legacy [server]
@@ -2024,6 +2144,7 @@ def serve(argv=None) -> None:
         recovery_config=recovery_config,
         kernels_config=kernels_config,
         mesh_config=mesh_config,
+        elastic_config=elastic_config,
     )
     if impl.lifecycle is not None:
         # The CLI server drives the controller with its background thread

@@ -128,6 +128,12 @@ class PredictionServiceImpl:
         # /monitoring's `mesh` block and the dts_tpu_mesh_* Prometheus
         # series read its snapshot; None (default) = single-chip.
         self.mesh_executor = None
+        # Elastic mesh serving (ISSUE 15): the ElasticController driving
+        # runtime split switches, when [elastic] armed the ladder. The
+        # `elastic` /monitoring section and dts_tpu_elastic_* Prometheus
+        # series read through it; None (default) = static split (or no
+        # mesh at all).
+        self.elastic = None
         # Streamed sub-batch results (ISSUE 9): default server-side split
         # size (candidates per sub-batch) for PredictStream. 0 = no split
         # (one chunk per request — streaming stays wire-available but the
@@ -338,6 +344,25 @@ class PredictionServiceImpl:
             except Exception:  # noqa: BLE001 — telemetry, never a dependency
                 pass
         return snap
+
+    def elastic_stats(self, mesh: dict | None = None) -> dict | None:
+        """Elastic-plane snapshot (current split, ladder, per-split serve
+        counters + live in-flight, switch history ring, controller
+        decision state) — the `elastic` /monitoring section and the
+        dts_tpu_elastic_* Prometheus series. None when the plane is off
+        ([elastic] enabled=false). The same block also rides inside
+        mesh_stats()//meshz as snapshot()['elastic']; `mesh` (an
+        already-computed mesh_stats() snapshot) lifts it from there
+        instead of re-walking the executor locks + history ring when the
+        caller renders both blocks in one pass (the Prometheus scrape
+        and the full /monitoring snapshot do — the mesh_stats
+        (utilization=) precedent)."""
+        ctrl = self.elastic
+        if ctrl is None:
+            return None
+        if mesh is not None and mesh.get("elastic") is not None:
+            return mesh["elastic"]
+        return ctrl.executor.elastic_snapshot()
 
     def versions_stats(self) -> dict | None:
         """Version-watcher snapshot (loaded versions, last reconcile

@@ -307,6 +307,89 @@ class MeshConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic mesh serving (ISSUE 15, parallel/elastic.py): a ladder of
+    ("data", "model") splits over the SAME devices, pre-built and
+    pre-warmed at load time, with a pressure-driven controller switching
+    the serving split at runtime — hitlessly (in-flight batches on the
+    old split drain behind the per-split in-flight barrier; new
+    dispatches route to the target immediately; no serving-path
+    compiles). Requires [mesh] enabled (the initial split IS the [mesh]
+    factorization); off by default — with the section absent, mesh
+    serving is exactly the static PR-13 mode."""
+
+    # Master switch: build an ElasticMeshExecutor + ElasticController
+    # instead of the static ShardedExecutor.
+    enabled: bool = False
+    # The split ladder, e.g. ["8x1", "4x2", "2x4"] (DATAxMODEL; every
+    # entry must factorize the [mesh] device count). Empty = derived:
+    # {n,1}, {n/2,2} (n even), and the [mesh] split. Sorted
+    # throughput-first internally; "up" switches move toward the
+    # data-parallel end.
+    splits: tuple = ()
+    # Controller cadence (opportunistic — ticked from dispatches and
+    # monitoring scrapes, no thread; the overload plane's precedent).
+    tick_interval_s: float = 0.5
+    # Minimum time between switches (the anti-flap floor; also the time
+    # the FIRST switch waits after arming).
+    dwell_s: float = 5.0
+    # Consecutive over/under ticks before a one-rung move. Down is
+    # deliberately slower: relaxing parallelism is a latency nicety,
+    # escalating it is a survival move.
+    up_after_ticks: int = 2
+    down_after_ticks: int = 6
+    # Load-EWMA thresholds (queue fraction / bucket occupancy, max of
+    # both): >= up counts an up tick even at NOMINAL pressure; <= down
+    # (at NOMINAL) counts a down tick; between is the hysteresis band
+    # (streaks reset, split holds).
+    load_up_threshold: float = 0.75
+    load_down_threshold: float = 0.20
+    load_ewma_alpha: float = 0.3
+    # Retained switch-history events (the /meshz ring).
+    history_events: int = 64
+
+    def __post_init__(self):
+        for s in self.splits:
+            d, sep, m = str(s).strip().lower().partition("x")
+            if not sep or not d.isdigit() or not m.isdigit() \
+                    or int(d) < 1 or int(m) < 1:
+                raise ValueError(
+                    f"[elastic] splits entry {s!r} is not 'DATAxMODEL' "
+                    "with positive integer axes (e.g. '4x2')"
+                )
+        for name in ("tick_interval_s", "dwell_s", "load_ewma_alpha"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+                raise ValueError(
+                    f"[elastic] {name} must be a positive number, got {v!r}"
+                )
+        for name in ("up_after_ticks", "down_after_ticks", "history_events"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"[elastic] {name} must be a positive integer, got {v!r}"
+                )
+        up, down = self.load_up_threshold, self.load_down_threshold
+        for name, v in (("load_up_threshold", up), ("load_down_threshold", down)):
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not 0.0 <= v <= 1.0:
+                raise ValueError(
+                    f"[elastic] {name} must be in [0, 1], got {v!r}"
+                )
+        if down >= up:
+            raise ValueError(
+                f"[elastic] load_down_threshold ({down}) must be below "
+                f"load_up_threshold ({up}) — the gap IS the hysteresis "
+                "band; equal thresholds would flap on every load wiggle"
+            )
+        if self.load_ewma_alpha > 1.0:
+            raise ValueError(
+                f"[elastic] load_ewma_alpha must be in (0, 1], got "
+                f"{self.load_ewma_alpha!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class ObservabilityConfig:
     """Telemetry-plane knobs (utils/tracing.py + utils/metrics.py): the
     per-request trace recorder behind GET /tracez and the rolling-window
@@ -704,8 +787,20 @@ class RecoveryConfig:
     max_cycle_rounds: int = 20
     # Retained transition-event history (/recoveryz `events`).
     history_events: int = 64
+    # Recovery unit. "executor" (the only implemented scope): the whole
+    # serving executor quarantines/reinits/replays as ONE unit — over a
+    # [mesh] that means the entire mesh (an SPMD executable spans every
+    # chip; there is no half-alive mesh to keep serving). "per_chip" is
+    # refused at build time when a mesh is armed (documented future
+    # work); on a single chip the two scopes are the same thing.
+    scope: str = "executor"
 
     def __post_init__(self):
+        if self.scope not in ("executor", "per_chip"):
+            raise ValueError(
+                f"[recovery] scope must be 'executor' or 'per_chip', "
+                f"got {self.scope!r}"
+            )
         for name in ("replay_budget", "poison_kills", "bisect_after_kills",
                      "max_cycle_rounds"):
             v = getattr(self, name)
@@ -817,6 +912,7 @@ _SECTIONS = {
     "server": ServerConfig,
     "client": ClientConfig,
     "mesh": MeshConfig,
+    "elastic": ElasticConfig,
     "batching": BatchingConfig,
     "transport": TransportConfig,
     "observability": ObservabilityConfig,

@@ -1,0 +1,749 @@
+"""Elastic mesh serving (ISSUE 15): the split ladder + hitless switching
++ pressure/load controller end to end on the virtual 8-device CPU mesh —
+ladder parsing/validation, the per-split in-flight drain barrier, the
+controller's dwell/hysteresis trajectory under a fake clock, batcher
+integration with bit-identical scores across runtime switches, warmup of
+every rung, the [recovery]×[mesh] compose lift, and the elastic
+monitoring/Prometheus surfaces."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tf_serving_tpu import faults
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.parallel import (
+    ElasticController,
+    ElasticMeshExecutor,
+)
+from distributed_tf_serving_tpu.parallel.elastic import (
+    format_split,
+    parse_split,
+    resolve_ladder,
+)
+from distributed_tf_serving_tpu.serving.batcher import DynamicBatcher
+from distributed_tf_serving_tpu.serving.server import build_stack
+from distributed_tf_serving_tpu.utils.config import (
+    ElasticConfig,
+    MeshConfig,
+    OverloadConfig,
+    RecoveryConfig,
+    ServerConfig,
+)
+
+CFG = ModelConfig(
+    num_fields=8, vocab_size=1024, embed_dim=4, mlp_dims=(16,),
+    num_cross_layers=1, compute_dtype="float32",
+)
+
+
+def _servable(seed=0):
+    model = build_model("dcn_v2", CFG)
+    return Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(seed)),
+        signatures=ctr_signatures(CFG.num_fields),
+    )
+
+
+def _arrays(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(
+            0, 1 << 40, size=(n, CFG.num_fields)
+        ).astype(np.int64),
+        "feat_wts": rng.rand(n, CFG.num_fields).astype(np.float32),
+    }
+
+
+def _fake_exec(name):
+    def fn(servable, arrays, out_keys=None):
+        n = next(iter(arrays.values())).shape[0]
+        fn.calls.append(n)
+        return {"prediction_node": np.full(n, fn.value, np.float32)}
+
+    fn.calls = []
+    fn.value = float(hash(name) % 7)
+    return fn
+
+
+def _fake_elastic(clock=None, splits=((8, 1), (4, 2))):
+    execs = {s: _fake_exec(format_split(s)) for s in splits}
+    kwargs = {"clock": clock} if clock is not None else {}
+    ex = ElasticMeshExecutor(
+        splits=list(splits), initial=splits[-1], executors=execs, **kwargs
+    )
+    return ex, execs
+
+
+# ----------------------------------------------------------- ladder/config
+
+
+def test_parse_split_forms_and_errors():
+    assert parse_split("4x2") == (4, 2)
+    assert parse_split((2, 4)) == (2, 4)
+    assert format_split((8, 1)) == "8x1"
+    for bad in ("4", "x2", "ax2", "0x8", "4x-1", "4x2x1"):
+        with pytest.raises(ValueError):
+            parse_split(bad)
+
+
+def test_resolve_ladder_derived_and_explicit():
+    # Derived: {n,1}, {n/2,2}, + the initial split, throughput-first.
+    assert resolve_ladder((), 8, (4, 2)) == [(8, 1), (4, 2)]
+    assert resolve_ladder((), 8, (2, 4)) == [(8, 1), (4, 2), (2, 4)]
+    # Explicit, any order in, sorted throughput-first out, initial added.
+    assert resolve_ladder(["2x4", "8x1"], 8, (4, 2)) == [
+        (8, 1), (4, 2), (2, 4)
+    ]
+    # A split that does not factorize the device count is refused.
+    with pytest.raises(ValueError, match="factorize"):
+        resolve_ladder(["3x2"], 8, (8, 1))
+    # A one-rung ladder cannot switch.
+    with pytest.raises(ValueError, match=">= 2"):
+        resolve_ladder(["8x1"], 8, (8, 1))
+
+
+def test_elastic_config_validation():
+    ElasticConfig(splits=("8x1", "4x2"))
+    with pytest.raises(ValueError, match="DATAxMODEL"):
+        ElasticConfig(splits=("8by1",))
+    with pytest.raises(ValueError, match="positive number"):
+        ElasticConfig(dwell_s=0)
+    with pytest.raises(ValueError, match="positive integer"):
+        ElasticConfig(up_after_ticks=0)
+    with pytest.raises(ValueError, match="hysteresis"):
+        ElasticConfig(load_up_threshold=0.5, load_down_threshold=0.5)
+    with pytest.raises(ValueError, match="load_ewma_alpha"):
+        ElasticConfig(load_ewma_alpha=1.5)
+
+
+def test_executor_rejects_initial_outside_ladder():
+    with pytest.raises(ValueError, match="not in the"):
+        ElasticMeshExecutor(
+            splits=[(8, 1), (4, 2)], initial=(2, 4),
+            executors={(8, 1): _fake_exec("a"), (4, 2): _fake_exec("b")},
+        )
+
+
+# ------------------------------------------------- switch + drain barrier
+
+
+def test_switch_routes_new_dispatches_and_drains_old():
+    clk = [0.0]
+    ex, execs = _fake_elastic(clock=lambda: clk[0])
+    sv, arrays = object(), {"x": np.zeros((4, 1), np.float32)}
+    # One batch in flight on the initial split (4,2).
+    ex(sv, arrays)
+    tok = ex.take_issue_token()
+    assert tok == ((4, 2), 0)  # (split, in-flight epoch)
+    assert ex.elastic_snapshot()["per_split"]["4x2"]["in_flight"] == 1
+    # Switch while it is still in flight: hitless — new dispatches route
+    # to the target immediately, the old split drains behind the barrier.
+    clk[0] = 1.0
+    assert ex.switch_split((8, 1), reason="test")
+    assert ex.current_split == (8, 1)
+    assert ex.drain_pending
+    ex(sv, arrays)
+    assert ex.take_issue_token()[0] == (8, 1)
+    assert execs[(8, 1)].calls == [4]
+    # A second switch is refused while the drain is open.
+    assert not ex.switch_split((4, 2), reason="too-soon")
+    assert ex.switches_refused_drain == 1
+    # The old batch completes: the drain closes and records its duration.
+    clk[0] = 3.5
+    ex.note_complete(tok)
+    assert not ex.drain_pending
+    snap = ex.elastic_snapshot()
+    assert snap["last_drain_s"] == pytest.approx(2.5)
+    assert snap["history"][-1]["drain_s"] == pytest.approx(2.5)
+    assert snap["history"][-1]["direction"] == "up"
+    assert snap["switches_up"] == 1
+    # And switching is possible again.
+    assert ex.switch_split((4, 2), reason="back")
+    assert ex.elastic_snapshot()["switches_down"] == 1
+
+
+def test_idle_switch_records_zero_drain():
+    ex, _ = _fake_elastic()
+    assert ex.switch_split((8, 1))
+    assert not ex.drain_pending
+    assert ex.last_drain_s == 0.0
+
+
+def test_dispatch_failure_releases_registration():
+    ex, execs = _fake_elastic()
+
+    def boom(servable, arrays, out_keys=None):
+        raise RuntimeError("device gone")
+
+    ex._executors[(4, 2)] = boom
+    with pytest.raises(RuntimeError):
+        ex(object(), {"x": np.zeros((2, 1), np.float32)})
+    assert ex.take_issue_token() is None
+    assert ex.elastic_snapshot()["per_split"]["4x2"]["in_flight"] == 0
+
+
+def test_clear_for_recovery_resets_accounting():
+    ex, _ = _fake_elastic()
+    ex(object(), {"x": np.zeros((2, 1), np.float32)})
+    ex.take_issue_token()
+    assert ex.switch_split((8, 1))  # old split still draining
+    assert ex.drain_pending
+    ex.clear_for_recovery()
+    assert not ex.drain_pending
+    snap = ex.elastic_snapshot()
+    assert all(b["in_flight"] == 0 for b in snap["per_split"].values())
+
+
+def test_stale_epoch_token_never_closes_new_registrations():
+    """A completer stranded by a recovery capture reports in AFTER
+    clear_for_recovery reset the accounting: its dead-epoch token must
+    be a no-op, or the stray close would release the drain barrier
+    while a post-recovery batch is still in flight (review finding)."""
+    ex, _ = _fake_elastic()  # initial (4,2)
+    ex(object(), {"x": np.zeros((2, 1), np.float32)})
+    stale = ex.take_issue_token()
+    ex.clear_for_recovery()  # capture: epoch bumps, accounting resets
+    # A post-recovery batch goes in flight on the same split.
+    ex(object(), {"x": np.zeros((2, 1), np.float32)})
+    fresh = ex.take_issue_token()
+    assert stale[1] != fresh[1]
+    ex.note_complete(stale)  # the straggler closes a DEAD epoch: no-op
+    assert ex.elastic_snapshot()["per_split"]["4x2"]["in_flight"] == 1
+    # The live batch still holds the drain barrier open across a switch.
+    assert ex.switch_split((8, 1), reason="test")
+    assert ex.drain_pending
+    ex.note_complete(fresh)
+    assert not ex.drain_pending
+
+
+# ------------------------------------------------------------- controller
+
+
+class _FakeOverload:
+    def __init__(self):
+        self.pressure = "nominal"
+
+    def state(self):
+        return self.pressure
+
+
+def _controller(ex, clock, **cfg_overrides):
+    kw = dict(
+        enabled=True, tick_interval_s=1.0, dwell_s=5.0,
+        up_after_ticks=2, down_after_ticks=3,
+        load_up_threshold=0.75, load_down_threshold=0.2,
+    )
+    kw.update(cfg_overrides)
+    cfg = ElasticConfig(**kw)
+    ov = _FakeOverload()
+    load = [0]
+    ctrl = ElasticController(
+        cfg, ex, overload=ov,
+        load_fn=lambda: (load[0], 100), largest_bucket=100, clock=clock,
+    )
+    return ctrl, ov, load
+
+
+def test_controller_pressure_up_then_recovery_down():
+    clk = [0.0]
+    ex, _ = _fake_elastic(clock=lambda: clk[0])  # initial (4,2)
+    ctrl, ov, _load = _controller(ex, lambda: clk[0])
+    ov.pressure = "brownout"
+    clk[0] = 1.1
+    ctrl.maybe_tick()  # up streak 1
+    assert ex.current_split == (4, 2)
+    clk[0] = 2.2
+    ctrl.maybe_tick()  # streak 2, but inside dwell (< 5s since arming)
+    assert ex.current_split == (4, 2)
+    assert ctrl.holds_dwell == 1
+    clk[0] = 5.5
+    ctrl.maybe_tick()  # dwell satisfied -> one rung toward throughput
+    assert ex.current_split == (8, 1)
+    assert ex.switches_up == 1
+    # Pressure clears, load stays low: down after down_after_ticks + dwell.
+    ov.pressure = "nominal"
+    for t in (6.6, 7.7, 8.8, 9.9):
+        clk[0] = t
+        ctrl.maybe_tick()
+    assert ex.current_split == (8, 1)  # dwell held it
+    assert ctrl.holds_dwell >= 2
+    clk[0] = 11.0
+    ctrl.maybe_tick()
+    assert ex.current_split == (4, 2)
+    assert ex.switches_down == 1
+
+
+def test_controller_load_ewma_drives_up_without_pressure():
+    clk = [0.0]
+    ex, _ = _fake_elastic(clock=lambda: clk[0])
+    ctrl, ov, load = _controller(ex, lambda: clk[0])
+    ov.pressure = "nominal"
+    load[0] = 95  # 0.95 of capacity, past load_up_threshold
+    for t in (1.1, 2.2, 3.3, 4.4, 5.6):
+        clk[0] = t
+        ctrl.maybe_tick()
+    assert ex.current_split == (8, 1)
+    assert ctrl.snapshot()["load_ewma"] > 0.75
+
+
+def test_controller_hysteresis_band_never_switches():
+    clk = [0.0]
+    ex, _ = _fake_elastic(clock=lambda: clk[0])
+    ctrl, ov, load = _controller(ex, lambda: clk[0])
+    load[0] = 50  # 0.5: between the thresholds — the hysteresis band
+    for i in range(20):
+        clk[0] = 1.1 * (i + 1)
+        ctrl.maybe_tick()
+    assert ex.current_split == (4, 2)
+    assert ex.switches_up == 0 and ex.switches_down == 0
+    snap = ctrl.snapshot()
+    assert snap["up_streak"] == 0 and snap["down_streak"] == 0
+
+
+def test_controller_holds_while_drain_pending():
+    clk = [0.0]
+    ex, _ = _fake_elastic(clock=lambda: clk[0])
+    ctrl, ov, _load = _controller(ex, lambda: clk[0], dwell_s=0.5)
+    # A batch in flight on the initial split, then an up-switch: the old
+    # split is draining when the controller next wants to move.
+    ex(object(), {"x": np.zeros((2, 1), np.float32)})
+    tok = ex.take_issue_token()
+    ov.pressure = "shed"
+    clk[0] = 1.1
+    ctrl.maybe_tick()
+    clk[0] = 2.2
+    ctrl.maybe_tick()  # up streak 2, dwell ok -> switch; (4,2) drains
+    assert ex.current_split == (8, 1)
+    assert ex.drain_pending
+    # Wants another rung (already at the top) — but even with a lower
+    # rung available the drain gate would hold: simulate by forcing a
+    # down signal (nominal + low load) with the drain still open.
+    ov.pressure = "nominal"
+    for t in (3.3, 4.4, 5.5):
+        clk[0] = t
+        ctrl.maybe_tick()
+    assert ctrl.holds_drain >= 1
+    assert ex.current_split == (8, 1)
+    ex.note_complete(tok)  # drain closes
+    clk[0] = 6.6
+    ctrl.maybe_tick()
+    assert ex.current_split == (4, 2)
+
+
+# ------------------------------------------------- batcher integration
+
+
+def test_batcher_switches_bit_identical_and_drained():
+    sv = _servable()
+    ex = ElasticMeshExecutor(splits=["8x1", "4x2", "2x4"], initial=(4, 2))
+    b = DynamicBatcher(buckets=(10, 50), max_wait_us=100, run_fn=ex).start()
+    try:
+        b.warmup(sv)
+        payloads = [_arrays(7, 1), _arrays(33, 2), _arrays(50, 3)]
+
+        def score_all():
+            return [
+                np.asarray(
+                    b.submit(
+                        sv, dict(p), output_keys=("prediction_node",)
+                    ).result(timeout=60)["prediction_node"]
+                )
+                for p in payloads
+            ]
+
+        ref = score_all()
+        for target in ((8, 1), (2, 4), (4, 2)):
+            assert ex.switch_split(target, reason="test")
+            got = score_all()
+            assert all(
+                np.array_equal(a, c) for a, c in zip(ref, got)
+            ), f"scores diverged on split {target}"
+        snap = ex.elastic_snapshot()
+        assert all(
+            blk["in_flight"] == 0 for blk in snap["per_split"].values()
+        )
+        assert snap["switches_up"] + snap["switches_down"] == 3
+        # Every split actually served batches.
+        assert all(
+            blk["batches"] > 0 for blk in snap["per_split"].values()
+        )
+    finally:
+        b.stop()
+
+
+def test_snapshot_counters_aggregate_across_rungs():
+    """The dts_tpu_mesh_*_total families are process-lifetime counters:
+    a switch must never make them jump to the new rung's (smaller)
+    value — Prometheus would read the regression as a counter reset and
+    rate()/increase() would over-count (review finding)."""
+    sv = _servable()
+    ex = ElasticMeshExecutor(splits=["8x1", "4x2"], initial=(4, 2))
+    b = DynamicBatcher(buckets=(10,), max_wait_us=100, run_fn=ex).start()
+    try:
+        b.warmup(sv)
+        for _ in range(3):
+            b.submit(sv, _arrays(7, 1)).result(timeout=60)
+        before = ex.snapshot()["executor"]
+        assert ex.switch_split((8, 1), reason="test")
+        b.submit(sv, _arrays(7, 2)).result(timeout=60)
+        after = ex.snapshot()["executor"]
+        # Monotone across the switch, and equal to the per-rung sum.
+        assert after["batches"] > before["batches"]
+        per = ex.elastic_snapshot()["per_split"]
+        live = sum(blk["batches"] for blk in per.values())
+        # Warmup batches count in the executor totals but not in the
+        # elastic per-split serve counters (no tokens minted there).
+        assert after["batches"] >= live
+        assert after["layout"].get("DCN") == "rules:dcn_v2"
+    finally:
+        b.stop()
+
+
+def test_warmup_warms_every_split():
+    sv = _servable()
+    ex = ElasticMeshExecutor(splits=["8x1", "4x2"], initial=(8, 1))
+    b = DynamicBatcher(buckets=(10,), max_wait_us=100, run_fn=ex).start()
+    try:
+        b.warmup(sv)
+        for split in ((8, 1), (4, 2)):
+            sub = ex._executors[split]
+            # Params placed and entries compiled on EVERY rung — the
+            # switch-never-compiles contract.
+            assert len(sub._placed) == 1, split
+            assert sub.batches > 0, split
+        # Warmup minted no issue tokens (it is not in-flight work).
+        snap = ex.elastic_snapshot()
+        assert all(
+            blk["in_flight"] == 0 for blk in snap["per_split"].values()
+        )
+    finally:
+        b.stop()
+
+
+def test_warmup_via_queue_warms_every_split():
+    """Hot-load warmup (version rollouts, recovery re-warm) goes through
+    the queue — which routes to the CURRENT split only — and must then
+    warm the rest of the ladder directly, or the first post-switch batch
+    of a hot-loaded version would compile on the dispatch path."""
+    sv = _servable()
+    ex = ElasticMeshExecutor(splits=["8x1", "4x2"], initial=(8, 1))
+    b = DynamicBatcher(buckets=(10,), max_wait_us=100, run_fn=ex).start()
+    try:
+        b.warmup_via_queue(sv)
+        for split in ((8, 1), (4, 2)):
+            assert len(ex._executors[split]._placed) == 1, split
+        snap = ex.elastic_snapshot()
+        assert all(
+            blk["in_flight"] == 0 for blk in snap["per_split"].values()
+        )
+    finally:
+        b.stop()
+
+
+def test_completer_failure_still_closes_token():
+    """A readback-stage failure must release the per-split registration
+    (the _complete finally), or the drain barrier wedges forever."""
+    ex, execs = _fake_elastic()
+    b = DynamicBatcher(buckets=(4,), max_wait_us=100, run_fn=ex).start()
+    try:
+        faults.get().add("readback", kind="error", code="INTERNAL", count=1)
+        sv = _servable()
+        fut = b.submit(sv, _arrays(2, 0))
+        with pytest.raises(Exception):
+            fut.result(timeout=30)
+        snap = ex.elastic_snapshot()
+        assert all(
+            blk["in_flight"] == 0 for blk in snap["per_split"].values()
+        )
+    finally:
+        faults.reset()
+        b.stop()
+
+
+# ------------------------------------------------------ build_stack wiring
+
+
+def _server_cfg(**over):
+    return ServerConfig(
+        model_kind="dcn_v2", model_name="DCN", num_fields=CFG.num_fields,
+        buckets=(10, 50), max_wait_us=100, warmup=True, **over,
+    )
+
+
+def test_build_stack_elastic_requires_mesh():
+    with pytest.raises(ValueError, match="requires \\[mesh\\]"):
+        build_stack(
+            _server_cfg(), model_config=CFG,
+            elastic_config=ElasticConfig(enabled=True),
+        )
+
+
+def test_build_stack_elastic_full_wiring():
+    from distributed_tf_serving_tpu.serving import overload as overload_mod
+
+    reg, b, impl, sv, mesh, _w = build_stack(
+        _server_cfg(), model_config=CFG,
+        mesh_config=MeshConfig(enabled=True, devices=8, model_parallel=2),
+        elastic_config=ElasticConfig(
+            enabled=True, tick_interval_s=0.05, dwell_s=0.1,
+        ),
+        overload_config=OverloadConfig(enabled=True),
+    )
+    try:
+        assert impl.elastic is not None
+        assert impl.elastic.executor.splits == [(8, 1), (4, 2)]
+        assert dict(mesh.shape) == {"data": 4, "model": 2}
+        r = b.submit(
+            sv, _arrays(7, 1), output_keys=("prediction_node",)
+        ).result(timeout=60)
+        assert np.asarray(r["prediction_node"]).shape == (7,)
+        es = impl.elastic_stats()
+        assert es["current_split"] == "4x2"
+        assert es["controller"]["ticks"] >= 1
+        ms = impl.mesh_stats()
+        assert ms["elastic"]["current_split"] == "4x2"
+    finally:
+        b.stop()
+        overload_mod.deactivate()
+
+
+def test_build_stack_elastic_off_is_static_mesh():
+    reg, b, impl, sv, mesh, _w = build_stack(
+        _server_cfg(), model_config=CFG,
+        mesh_config=MeshConfig(enabled=True, devices=8, model_parallel=2),
+        elastic_config=ElasticConfig(enabled=False),
+    )
+    try:
+        assert impl.elastic is None
+        assert impl.elastic_stats() is None
+        assert "elastic" not in (impl.mesh_stats() or {})
+    finally:
+        b.stop()
+
+
+def test_elastic_toml_parsing(tmp_path):
+    from distributed_tf_serving_tpu.utils.config import load_config
+
+    cfg_file = tmp_path / "cfg.toml"
+    cfg_file.write_text(
+        """
+[server]
+model_kind = "dcn_v2"
+
+[mesh]
+enabled = true
+devices = 8
+model_parallel = 2
+
+[elastic]
+enabled = true
+splits = ["8x1", "4x2", "2x4"]
+dwell_s = 2.5
+up_after_ticks = 3
+"""
+    )
+    cfgs = load_config(cfg_file)
+    el = cfgs["elastic"]
+    assert el.enabled and el.splits == ("8x1", "4x2", "2x4")
+    assert el.dwell_s == 2.5 and el.up_after_ticks == 3
+    # Absent section -> defaults (disabled).
+    cfg_file.write_text("[server]\nmodel_kind = 'dcn_v2'\n")
+    assert load_config(cfg_file)["elastic"].enabled is False
+
+
+# --------------------------------------------- [recovery] x [mesh] compose
+
+
+def test_per_chip_recovery_refused_over_mesh():
+    with pytest.raises(ValueError, match="per_chip"):
+        build_stack(
+            _server_cfg(), model_config=CFG,
+            mesh_config=MeshConfig(enabled=True, devices=8),
+            recovery_config=RecoveryConfig(enabled=True, scope="per_chip"),
+        )
+    with pytest.raises(ValueError, match="scope"):
+        RecoveryConfig(scope="per_host")
+
+
+def test_recovery_composes_with_mesh_whole_unit():
+    """The ISSUE-15 scoped lift: a device-fatal batch failure over the
+    mesh quarantines the WHOLE executor, REINIT clears its placed params
+    + entries (clear_for_recovery), and replay answers the captured
+    request bit-identically."""
+    import time as time_mod
+
+    reg, b, impl, sv, mesh, _w = build_stack(
+        _server_cfg(), model_config=CFG,
+        mesh_config=MeshConfig(enabled=True, devices=8, model_parallel=2),
+        elastic_config=ElasticConfig(
+            enabled=True, tick_interval_s=0.05, dwell_s=0.1,
+        ),
+        recovery_config=RecoveryConfig(
+            enabled=True, watchdog_interval_s=0.1,
+        ),
+    )
+    rec = impl.recovery
+    try:
+        arrays = _arrays(7, 11)
+        ref = np.asarray(
+            b.submit(
+                sv, dict(arrays), output_keys=("prediction_node",)
+            ).result(timeout=60)["prediction_node"]
+        )
+        faults.get().add(
+            "device_lost", kind="error", code="UNAVAILABLE", count=1
+        )
+        fut = b.submit(sv, dict(arrays), output_keys=("prediction_node",))
+        deadline = time_mod.time() + 90
+        while not fut.done() and time_mod.time() < deadline:
+            rec.check()
+            if rec.cycle_active():
+                rec.run_cycle("test")
+            time_mod.sleep(0.05)
+        got = np.asarray(fut.result(timeout=60)["prediction_node"])
+        assert np.array_equal(ref, got)
+        # The elastic accounting survived the quarantine capture.
+        es = impl.elastic_stats()
+        assert all(
+            blk["in_flight"] == 0 for blk in es["per_split"].values()
+        )
+    finally:
+        faults.reset()
+        b.stop()
+
+
+def test_sharded_executor_clear_for_recovery():
+    from distributed_tf_serving_tpu.parallel import ShardedExecutor, make_mesh
+
+    from distributed_tf_serving_tpu.serving.batcher import fold_ids_host
+
+    sv = _servable()
+    ex = ShardedExecutor(make_mesh(8, model_parallel=2))
+    arrays = _arrays(8, 0)
+    # Direct executor calls skip the batcher's host fold — fold here.
+    arrays["feat_ids"] = fold_ids_host(arrays["feat_ids"], CFG.vocab_size)
+    ex(sv, arrays)
+    assert len(ex._placed) == 1
+    ex.clear_for_recovery()
+    assert len(ex._placed) == 0 and len(ex._jitted) == 0
+    # Serves again after the clear (fresh placement + compile).
+    out = ex(sv, arrays)
+    assert np.asarray(out["prediction_node"]).shape == (8,)
+
+
+# ---------------------------------------------------------------- surfaces
+
+
+def test_meshz_route_and_elastic_sections():
+    """GET /meshz (new, ISSUE 15) serves the mesh block with the elastic
+    sub-block; /monitoring gains an `elastic` section; Prometheus
+    carries dts_tpu_elastic_*; a mesh-less impl answers enabled=false."""
+    import asyncio
+
+    aiohttp = pytest.importorskip("aiohttp")
+
+    from distributed_tf_serving_tpu.models import ServableRegistry
+    from distributed_tf_serving_tpu.serving.rest import start_rest_gateway
+    from distributed_tf_serving_tpu.serving.service import (
+        PredictionServiceImpl,
+    )
+
+    sv = _servable()
+    registry = ServableRegistry()
+    registry.load(sv)
+    ex = ElasticMeshExecutor(splits=["8x1", "4x2"], initial=(4, 2))
+    b = DynamicBatcher(buckets=(10,), max_wait_us=100, run_fn=ex).start()
+    impl = PredictionServiceImpl(registry, b)
+    impl.mesh_executor = ex
+    ctrl, _ov, _load = _controller(ex, __import__("time").monotonic)
+    impl.elastic = ctrl
+
+    async def go():
+        runner, port = await start_rest_gateway(impl, port=0)
+        try:
+            async with aiohttp.ClientSession(
+                f"http://127.0.0.1:{port}"
+            ) as s:
+                async with s.get("/meshz") as r:
+                    body = await r.json()
+                    assert r.status == 200 and body["enabled"] is True
+                    assert body["elastic"]["current_split"] == "4x2"
+                    assert body["elastic"]["splits"] == ["8x1", "4x2"]
+                async with s.get("/monitoring?section=elastic") as r:
+                    sec = await r.json()
+                    assert set(sec) == {"elastic"}
+                    assert sec["elastic"]["current_split"] == "4x2"
+                async with s.get("/monitoring") as r:
+                    snap = await r.json()
+                    assert "elastic" in snap and "mesh" in snap
+                async with s.get("/monitoring/prometheus/metrics") as r:
+                    text = await r.text()
+                assert "dts_tpu_elastic_model_parallel 2" in text
+                assert (
+                    'dts_tpu_elastic_split_batches_total{split="8x1"}' in text
+                )
+                # Plane off: /meshz answers enabled=false, sections null/absent.
+                impl.mesh_executor = None
+                impl.elastic = None
+                async with s.get("/meshz") as r:
+                    assert (await r.json()) == {"enabled": False}
+                async with s.get("/monitoring") as r:
+                    assert "elastic" not in await r.json()
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(go())
+    finally:
+        b.stop()
+
+
+def test_elastic_prometheus_series_and_lint():
+    import os
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools",
+        ),
+    )
+    from check_prom import lint_text
+
+    from distributed_tf_serving_tpu.utils.metrics import ServerMetrics
+
+    clk = [0.0]
+    ex, _ = _fake_elastic(clock=lambda: clk[0])
+    ctrl, ov, _load = _controller(ex, lambda: clk[0])
+    ex(object(), {"x": np.zeros((3, 1), np.float32)})
+    ex.note_complete(ex.take_issue_token())
+    clk[0] = 6.0
+    ov.pressure = "shed"
+    ctrl.maybe_tick()
+    clk[0] = 7.1
+    ctrl.maybe_tick()  # up-switch
+    text = ServerMetrics().prometheus_text(
+        None, elastic=ex.elastic_snapshot()
+    )
+    assert lint_text(text) == []
+    for marker in (
+        "dts_tpu_elastic_data_parallel 8",
+        "dts_tpu_elastic_model_parallel 1",
+        'dts_tpu_elastic_switches_total{direction="up"} 1',
+        'dts_tpu_elastic_switches_total{direction="down"} 0',
+        'dts_tpu_elastic_split_batches_total{split="4x2"} 1',
+        "dts_tpu_elastic_controller_ticks_total",
+        'dts_tpu_elastic_holds_total{reason="dwell"}',
+        "dts_tpu_elastic_load_ewma",
+    ):
+        assert marker in text, marker

@@ -347,12 +347,23 @@ def test_build_stack_refusals():
             mesh_config=_mesh_cfg(),
             kernels_config=KernelsConfig(enabled=True),
         )
-    # [mesh] x [recovery]
-    with pytest.raises(ValueError, match="conflicts with \\[recovery\\]"):
+    # [mesh] x [recovery]: the blanket refusal is LIFTED (ISSUE 15 — the
+    # mesh executor recovers as one unit, default scope="executor");
+    # only per-chip scope stays refused.
+    _r, b, impl, _sv, _m, _w = build_stack(
+        _server_cfg(), model_config=_model_cfg(),
+        mesh_config=_mesh_cfg(),
+        recovery_config=RecoveryConfig(enabled=True),
+    )
+    try:
+        assert impl.recovery is not None
+    finally:
+        b.stop()
+    with pytest.raises(ValueError, match="per_chip"):
         build_stack(
             _server_cfg(), model_config=_model_cfg(),
             mesh_config=_mesh_cfg(),
-            recovery_config=RecoveryConfig(enabled=True),
+            recovery_config=RecoveryConfig(enabled=True, scope="per_chip"),
         )
     # [mesh] x legacy [server] mesh knobs (all three)
     for legacy in (

@@ -136,3 +136,82 @@ def test_hash128_no_small_collisions():
     rng = np.random.RandomState(7)
     seen = {native.hash128(rng.randint(0, 256, size=40).astype(np.uint8)) for _ in range(2000)}
     assert len(seen) == 2000
+
+
+# ----------------------------------------------------------- hash128_rows
+# (ISSUE 15 satellite): the batched per-row blake2b-128. Unlike hash128
+# above (a private fast mix), these digests are a WIRE contract — the
+# row-cache keys, dedup identity, and client label-join keys — so the
+# native path must be BYTE-IDENTICAL to hashlib.blake2b(digest_size=16).
+
+
+def test_hash128_rows_byte_identical_to_hashlib():
+    import hashlib
+
+    rng = np.random.RandomState(3)
+    for n, width, header in (
+        (1, 1, b""),
+        (5, 43, b""),
+        (7, 130, b"feat_ids:<i8:(8,);feat_wts:<f4:(8,);"),
+        (2, 127, b"h"),
+        (2, 128, b""),
+        (3, 129, b"z" * 200),  # header + row spanning several blocks
+        (4, 0, b"only-header"),
+    ):
+        blob = rng.randint(0, 256, size=(n, width)).astype(np.uint8)
+        got = native.hash128_rows(blob, header)
+        assert got.shape == (n, 16)
+        for i in range(n):
+            ref = hashlib.blake2b(
+                header + blob[i].tobytes(), digest_size=16
+            ).digest()
+            assert got[i].tobytes() == ref, (n, width, header, i)
+
+
+def test_hash128_rows_empty_message_and_shapes():
+    import hashlib
+
+    empty = np.zeros((1, 0), np.uint8)
+    assert (
+        native.hash128_rows(empty)[0].tobytes()
+        == hashlib.blake2b(b"", digest_size=16).digest()
+    )
+    assert native.hash128_rows(np.zeros((0, 8), np.uint8)).shape == (0, 16)
+    with pytest.raises(ValueError):
+        native.hash128_rows(np.zeros(8, np.uint8))  # 1-D refused
+
+
+def test_digest_rows_native_equals_fallback(monkeypatch):
+    """cache/row_cache.py digest_rows — the row-cache key mint — must
+    produce the same bytes with the native path armed and with it forced
+    off, including the subset-rows form the dedup plan uses."""
+    from distributed_tf_serving_tpu.cache.row_cache import digest_rows
+
+    rng = np.random.RandomState(5)
+    blob = rng.randint(0, 256, size=(20, 43)).astype(np.uint8)
+    header = b"feat_ids:<i8:(8,);"
+    for rows in (None, [0, 3, 19], range(5), []):
+        with_native = digest_rows(blob, header, rows=rows)
+        monkeypatch.setattr(native, "available", lambda: False)
+        without = digest_rows(blob, header, rows=rows)
+        monkeypatch.undo()
+        assert with_native == without
+        assert all(len(d) == 16 for d in with_native)
+
+
+def test_row_label_keys_native_equals_fallback(monkeypatch):
+    """The label-join keys clients compute over the bytes they SENT must
+    equal the server's — whichever side has the host ops built."""
+    from distributed_tf_serving_tpu.cache.digest import row_label_keys
+
+    rng = np.random.RandomState(6)
+    arrays = {
+        "feat_ids": rng.randint(0, 1 << 40, size=(9, 8)).astype(np.int64),
+        "feat_wts": rng.rand(9, 8).astype(np.float32),
+    }
+    with_native = row_label_keys(arrays)
+    monkeypatch.setattr(native, "available", lambda: False)
+    without = row_label_keys(arrays)
+    monkeypatch.undo()
+    assert with_native == without
+    assert all(len(k) == 32 for k in with_native)  # 16-byte hex

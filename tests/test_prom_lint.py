@@ -152,6 +152,35 @@ def _fully_armed_text() -> str:
         },
         "occupancy_attribution": "spmd_uniform",
     }
+    # Elastic mesh serving (ISSUE 15, the thirteenth plane): the shape
+    # impl.elastic_stats() emits mid-switch — drain pending, history
+    # ring populated, controller attached.
+    elastic = {
+        "enabled": True,
+        "current_split": "8x1",
+        "splits": ["8x1", "4x2", "2x4"],
+        "pending_drain_from": "4x2",
+        "switches_up": 2,
+        "switches_down": 1,
+        "switches_refused_drain": 1,
+        "last_drain_s": 0.031,
+        "per_split": {
+            "8x1": {"batches": 9, "rows": 420, "in_flight": 1},
+            "4x2": {"batches": 4, "rows": 180, "in_flight": 1},
+            "2x4": {"batches": 0, "rows": 0, "in_flight": 0},
+        },
+        "history": [
+            {"t": 1.0, "from": "4x2", "to": "8x1", "direction": "up",
+             "reason": "pressure=brownout", "drained_behind": 2,
+             "drain_s": 0.031},
+        ],
+        "controller": {
+            "ticks": 40, "pressure": "brownout", "load_ewma": 0.81,
+            "occupancy_ewma": 0.77, "up_streak": 0, "down_streak": 0,
+            "holds_dwell": 3, "holds_drain": 1, "dwell_s": 5.0,
+            "load_up_threshold": 0.75, "load_down_threshold": 0.2,
+        },
+    }
     return m.prometheus_text(
         stats,
         cache=cache.snapshot(),
@@ -164,6 +193,7 @@ def _fully_armed_text() -> str:
         recovery=recovery.snapshot(),
         kernels=kern.snapshot(),
         mesh=mesh,
+        elastic=elastic,
     )
 
 
@@ -182,6 +212,8 @@ def test_fully_armed_snapshot_passes_lint():
         "dts_tpu_recovery_", "dts_tpu_kernel_",
         "dts_tpu_kernel_variant_speedup",
         "dts_tpu_mesh_", "dts_tpu_mesh_device_busy_fraction",
+        "dts_tpu_elastic_", "dts_tpu_elastic_switches_total",
+        "dts_tpu_elastic_split_in_flight",
     ):
         assert marker in text
 

@@ -184,6 +184,22 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_MESH_SMOKE:-0}" = "1" ]; then
         python tools/check_mesh_smoke.py | tee "$MESH_LINE" || rc=1
 fi
 
+# Elastic smoke (TIER1_ELASTIC_SMOKE=1): the ISSUE-15 serving-mode gate —
+# on 8 emulated CPU devices (the script forces the device count itself) a
+# pinned `pressure` fault escalates the overload state machine to
+# BROWNOUT under a ramped stream: the serving split must switch UP
+# (toward data-parallel) under pressure and DOWN after recovery, with
+# every response BIT-IDENTICAL to a pinned-split reference stack serving
+# the same checkpoint, ZERO failed requests across both switch windows,
+# every ladder rung warmup-compiled before the stream, and the
+# dts_tpu_elastic_* series lint-clean (tools/check_elastic_smoke.py).
+if [ "$rc" -eq 0 ] && [ "${TIER1_ELASTIC_SMOKE:-0}" = "1" ]; then
+    ELASTIC_LINE="${TIER1_ELASTIC_LINE:-/tmp/tier1_elastic_smoke.json}"
+    echo "tier1: elastic smoke (line $ELASTIC_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python tools/check_elastic_smoke.py | tee "$ELASTIC_LINE" || rc=1
+fi
+
 # Lifecycle smoke (TIER1_LIFECYCLE_SMOKE=1): a SOAK_LIFECYCLE=1 soak —
 # trained model behind a real version watcher + lifecycle controller;
 # the driver publishes a fine-tuned GOOD canary (must auto-promote) and
