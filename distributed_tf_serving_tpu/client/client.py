@@ -18,6 +18,7 @@ shutdown(), DCNClient.java:127-135).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import dataclasses
 import random
 import time
@@ -98,6 +99,13 @@ class ResilienceCounters:
     # announcing its own recovery cycle) — steered around as "alive but
     # rebuilding", never charged to the ejection budget.
     rebuilding_hints: int = 0
+    # Drain hints (ISSUE 17 satellite): UNAVAILABLE refusals carrying the
+    # GracefulShutdown drain detail, or NOT_SERVING health answers whose
+    # x-dts-health-reason trailer says "draining" — the backend is
+    # LEAVING. Recorded as kind="draining" on the scoreboard: steered
+    # away from immediately, no ejection budget spent, and the
+    # rebuilding retry window never cycled.
+    draining_hints: int = 0
     # int8 score response wire (ISSUE 12): responses whose score tensor
     # arrived as DT_INT8 + sidecars and was dequantized locally.
     int8_responses: int = 0
@@ -141,6 +149,55 @@ _SCORE_WIRE_KEY = "x-dts-score-wire"
 # A drain refusal ("server draining ...") deliberately does NOT match:
 # a draining replica is leaving, not coming back.
 _REBUILDING_MARKER = "replica quarantined"
+# Substring a DRAINING replica's UNAVAILABLE refusal carries
+# (serving/service.py _refuse_if_draining: "server is draining (shutdown
+# in progress); retry against another backend") and the value the health
+# servicer's x-dts-health-reason trailer uses. Recorded as
+# kind="draining" (ISSUE 17 satellite): the scoreboard steers away from
+# the FIRST hint and never cycles the rebuilding retry window — before
+# this split, a draining replica burned the whole rebuilding_streak_limit
+# before ejection, eating one routed request per busy-window cycle.
+_DRAINING_MARKER = "server is draining"
+# grpc.health.v1 carries no detail field, so the serving stack annotates
+# NOT_SERVING Check answers with the refusal reason ("draining" /
+# "quarantined" / "starting") in this trailing-metadata key. Advisory:
+# absent on foreign servers, the bare status keeps its historical
+# rebuilding interpretation.
+_HEALTH_REASON_KEY = "x-dts-health-reason"
+# Retry-budget forwarding across a fleet router hop (ISSUE 17): a client
+# with max_attempts_total set advertises it here; the router caps its own
+# server-side attempt budget at min(local, advertised) so the edge's
+# storm-suppression intent survives the hop.
+_RETRY_BUDGET_KEY = "x-dts-retry-budget"
+
+
+# Per-request override channel (ISSUE 17): the fleet router serves many
+# edge requests through ONE embedded ShardedPredictClient, and each
+# inbound RPC carries its own deadline / criticality / traceparent /
+# retry budget. Client-level attributes cannot express that, so the
+# router (or any embedding caller) wraps predict() in
+# `with client.request_overrides(...)`: contextvars propagate into every
+# shard task asyncio spawns under the call, and concurrent requests see
+# only their own values. All default to None = use the client attribute.
+_OVERRIDES: "contextvars.ContextVar[dict | None]" = contextvars.ContextVar(
+    "dts_client_request_overrides", default=None
+)
+
+
+class _OverrideScope:
+    __slots__ = ("_values", "_token")
+
+    def __init__(self, values: dict):
+        self._values = values
+        self._token = None
+
+    def __enter__(self):
+        self._token = _OVERRIDES.set(self._values)
+        return self
+
+    def __exit__(self, *exc):
+        _OVERRIDES.reset(self._token)
+        return False
 
 
 def _retry_after_ms_of(err) -> int | None:
@@ -500,6 +557,43 @@ class ShardedPredictClient:
     async def __aexit__(self, *exc):
         await self.close()
 
+    def request_overrides(
+        self,
+        *,
+        criticality: str | None = None,
+        timeout_s: float | None = None,
+        traceparent: str | None = None,
+        max_attempts_total: int | None = None,
+    ) -> _OverrideScope:
+        """Per-request overrides for ONE predict()/predict_streamed()/
+        predict_prepared() call issued inside the returned context
+        (ISSUE 17: the fleet router forwards each inbound RPC's deadline,
+        x-dts-criticality, traceparent, and retry budget through its
+        embedded client). Contextvar-scoped: every shard/hedge task of
+        the wrapped call inherits the values; concurrent requests on the
+        same client see only their own. None = keep the client-level
+        attribute. `traceparent` is only attached when tracing is not
+        already supplying a span of its own (a live span's id wins — it
+        joined the inbound trace at start_root)."""
+        return _OverrideScope({
+            "criticality": criticality,
+            "timeout_s": timeout_s,
+            "traceparent": traceparent,
+            "max_attempts_total": max_attempts_total,
+        })
+
+    @staticmethod
+    def _override(key: str):
+        values = _OVERRIDES.get()
+        return values.get(key) if values else None
+
+    def _rpc_timeout(self) -> float:
+        """Per-attempt RPC deadline: the request override (the router
+        forwarding the edge's remaining deadline) when present, else the
+        client attribute."""
+        t = self._override("timeout_s")
+        return float(t) if t else self.timeout_s
+
     async def _one_rpc(
         self, i: int, rr: int, host_idx: int, invoke,
         attempt: int = 0, hedge: bool = False,
@@ -521,8 +615,23 @@ class ShardedPredictClient:
                     ("traceparent",
                      tracing.make_traceparent(span.trace_id, span.span_id))
                 )
-            if self.criticality:
-                md.append((_CRITICALITY_KEY, self.criticality))
+            else:
+                # No local span (tracing disarmed): a forwarded
+                # traceparent override still rides through verbatim, so
+                # a router hop never breaks the edge's trace.
+                fwd_tp = self._override("traceparent")
+                if fwd_tp:
+                    md.append(("traceparent", fwd_tp))
+            crit = self._override("criticality")
+            if crit is None:
+                crit = self.criticality
+            if crit:
+                md.append((_CRITICALITY_KEY, crit))
+            if self.max_attempts_total:
+                # Advertise the retry budget across the hop (ISSUE 17):
+                # a fleet router caps its own attempt budget at
+                # min(local, advertised).
+                md.append((_RETRY_BUDGET_KEY, str(self.max_attempts_total)))
             if self.score_wire_int8:
                 md.append((_SCORE_WIRE_KEY, "int8"))
             metadata = tuple(md) or None
@@ -537,7 +646,7 @@ class ShardedPredictClient:
                     try:
                         await asyncio.wait_for(
                             faults.fire_async("client.rpc", key=host),
-                            timeout=self.timeout_s,
+                            timeout=self._rpc_timeout(),
                         )
                     except asyncio.TimeoutError:
                         raise faults.InjectedFaultError(
@@ -579,10 +688,24 @@ class ShardedPredictClient:
                     self.counters.pushbacks_received += 1
                     if span is not None and retry_after_ms:
                         span.attrs["retry_after_ms"] = retry_after_ms
+                details = e.details() or ""
                 rebuilding = (
-                    code_name == "UNAVAILABLE"
-                    and _REBUILDING_MARKER in (e.details() or "")
+                    code_name == "UNAVAILABLE" and _REBUILDING_MARKER in details
                 )
+                draining = (
+                    code_name == "UNAVAILABLE" and _DRAINING_MARKER in details
+                )
+                if draining:
+                    # Drain-aware hint (ISSUE 17 satellite): the backend
+                    # ANSWERED with its GracefulShutdown refusal — it is
+                    # leaving, not recovering. Flip it to the scoreboard's
+                    # DRAINING state: steering skips it from this first
+                    # hint (no more routed requests while an alternative
+                    # exists), no ejection budget is spent, and the
+                    # rebuilding retry window is never cycled.
+                    self.counters.draining_hints += 1
+                    if span is not None:
+                        span.attrs["draining"] = True
                 if rebuilding:
                     # Quarantine-aware hint (ISSUE 12 satellite): the
                     # backend ANSWERED with its own recovery-cycle
@@ -598,7 +721,11 @@ class ShardedPredictClient:
                     if span is not None:
                         span.attrs["rebuilding"] = True
                 if self.scoreboard is not None:
-                    if rebuilding:
+                    if draining:
+                        self.scoreboard.record_failure(
+                            host_idx, kind="draining"
+                        )
+                    elif rebuilding:
                         self.scoreboard.record_failure(
                             host_idx, kind="rebuilding"
                         )
@@ -732,8 +859,10 @@ class ShardedPredictClient:
         health, service \"\") — the cheap half-open probe that never costs a
         real request its latency. Returns "serving", "not_serving" (the
         server ANSWERED — alive but refusing, e.g. a recovery-cycle
-        rebuild or warmup), "inconclusive" (no health service — the
-        answer proves liveness), or "down"."""
+        rebuild or warmup), "draining" (NOT_SERVING with the server's
+        `x-dts-health-reason: draining` trailer — it is leaving, don't
+        re-probe it on the rebuild cadence), "inconclusive" (no health
+        service — the answer proves liveness), or "down"."""
         from ..proto import health as health_proto
 
         stub = self._health_stubs[host_idx]
@@ -742,10 +871,12 @@ class ShardedPredictClient:
                 self._channels[host_idx][0]
             )
         try:
-            resp = await stub.Check(
+            call = stub.Check(
                 health_proto.HealthCheckRequest(""),
                 timeout=min(self.timeout_s, 2.0),
             )
+            resp = await call
+            trailing = await call.trailing_metadata()
         except grpc.aio.AioRpcError as e:
             if getattr(e.code(), "name", "") == "UNIMPLEMENTED":
                 # Backend build without the health service: the answer
@@ -755,19 +886,31 @@ class ShardedPredictClient:
             return "down"
         except Exception:  # noqa: BLE001 — any other probe failure = down
             return "down"
-        return (
-            "serving" if resp.status == health_proto.SERVING
-            else "not_serving"
-        )
+        if resp.status == health_proto.SERVING:
+            return "serving"
+        reason = ""
+        for k, v in trailing or ():
+            if k == _HEALTH_REASON_KEY:
+                reason = v
+                break
+        return "draining" if reason == "draining" else "not_serving"
 
     def _new_budget(self, shards: int) -> "_AttemptBudget | None":
         """Per-request attempt budget, or None when the knob is off.
         Each shard's first attempt is guaranteed (the request cannot run
         without it), so the pool holds max(max_attempts_total - shards,
-        0) EXTRA attempts shared across failover hops and hedges."""
-        if not self.max_attempts_total:
+        0) EXTRA attempts shared across failover hops and hedges. A
+        router forwarding an edge client's x-dts-retry-budget caps the
+        local knob at the advertised value via request_overrides — the
+        fleet never multiplies the edge's retry intent."""
+        forwarded = self._override("max_attempts_total")
+        caps = [
+            c for c in (self.max_attempts_total, forwarded)
+            if c  # 0/None = knob off
+        ]
+        if not caps:
             return None
-        return _AttemptBudget(self.max_attempts_total - shards)
+        return _AttemptBudget(min(int(c) for c in caps) - shards)
 
     def _note_budget_exhausted(self, budget: "_AttemptBudget") -> None:
         """Count one REQUEST's budget exhaustion (first trip only: every
@@ -875,6 +1018,23 @@ class ShardedPredictClient:
                     and self.scoreboard.state(host_idx) == HALF_OPEN
                 ):
                     status = await self._health_check(host_idx)
+                    if status == "draining":
+                        # The server answered NOT_SERVING and NAMED the
+                        # reason: GracefulShutdown drain. Flip straight to
+                        # the DRAINING scoreboard state — steer away now,
+                        # never cycle the rebuilding retry window on a
+                        # replica that is leaving (ISSUE 17 satellite).
+                        self.counters.draining_hints += 1
+                        self.scoreboard.record_failure(
+                            host_idx, kind="draining"
+                        )
+                        if last is None:
+                            last = _ShardAttemptError(
+                                host_idx,
+                                grpc.StatusCode.UNAVAILABLE,
+                                "health probe reported draining",
+                            )
+                        continue
                     if status == "not_serving":
                         # The server ANSWERED NOT_SERVING: alive but
                         # refusing — a recovery-cycle rebuild (or warmup).
@@ -966,7 +1126,7 @@ class ShardedPredictClient:
         return await self._shard_call(
             i, rr,
             lambda stub, metadata=None: stub.Predict(
-                req, timeout=self.timeout_s, metadata=metadata
+                req, timeout=self._rpc_timeout(), metadata=metadata
             ),
             budget=budget,
         )
@@ -1275,7 +1435,7 @@ class ShardedPredictClient:
             merger = StreamingMerger(n)
             t0 = time.perf_counter()
             call = stub.PredictStream(
-                req, timeout=self.timeout_s, metadata=md or None
+                req, timeout=self._rpc_timeout(), metadata=md or None
             )
             first_ms: float | None = None
             async for ch in call:
@@ -1401,7 +1561,7 @@ class ShardedPredictClient:
         return await self._shard_call(
             i, rr,
             lambda stub, metadata=None: stub.PredictRaw(
-                blob, timeout=self.timeout_s, metadata=metadata
+                blob, timeout=self._rpc_timeout(), metadata=metadata
             ),
             budget=budget,
         )

@@ -36,6 +36,14 @@ import threading
 import time
 
 HEALTHY, EJECTED, HALF_OPEN = "healthy", "ejected", "half_open"
+# Draining (ISSUE 17 satellite): the host ANNOUNCED it is leaving
+# (GracefulShutdown refusal detail / NOT_SERVING-with-reason health
+# answer). Distinct from EJECTED (no ejection budget was spent, no
+# doubling) and from the rebuilding busy-bias (a drain is not coming
+# back within an MTTR): steering skips the host entirely until
+# draining_probe_s passes, then half-open probing lets a RESTARTED
+# process on the same address rejoin.
+DRAINING = "draining"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +78,14 @@ class ScoreboardConfig:
     # round. Past the streak, the normal eject-with-doubling machinery
     # takes over.
     rebuilding_streak_limit: int = 3
+    # How long a DRAINING host (kind="draining" — the backend announced
+    # a graceful shutdown) is held out of steering before half-open
+    # probing checks whether a restarted process took over the address.
+    # Unlike the rebuilding window this is not an MTTR estimate — a
+    # draining replica is leaving — it is the probe cadence for the
+    # replacement process. Never consumes the ejection budget and never
+    # cycles the rebuilding_busy_s retry window.
+    draining_probe_s: float = 3.0
 
 
 @dataclasses.dataclass
@@ -94,6 +110,10 @@ class _HostState:
     # hint can defer ejection — see rebuilding_streak_limit.
     rebuilds: int = 0
     consecutive_rebuilds: int = 0
+    # Drain hints (ISSUE 17 satellite): the host said it is shutting
+    # down. State flips to DRAINING — skipped by steering outright —
+    # with no ejection budget spent and no rebuilding streak cycled.
+    drains: int = 0
 
 
 class BackendScoreboard:
@@ -122,6 +142,11 @@ class BackendScoreboard:
         # Rebuilding hints (ISSUE 12 satellite): quarantine refusals /
         # NOT_SERVING health answers recorded as kind="rebuilding".
         self.rebuilds = 0
+        # Drain hints (ISSUE 17 satellite): "server is draining" refusals
+        # / NOT_SERVING-while-draining health answers recorded as
+        # kind="draining" — steered away from immediately, no ejection
+        # budget spent, no rebuilding retry window cycled.
+        self.drains = 0
         # Retry-budget trips (ISSUE 11): requests whose per-request
         # attempt cap (client max_attempts_total) ran dry — the
         # storm-suppression evidence next to the ejection counters it
@@ -171,9 +196,31 @@ class BackendScoreboard:
         host is the probe succeeding at being alive: the host recovers to
         HEALTHY (busy) instead of re-ejecting with a doubled interval —
         without this, a fleet-wide overload turns into a fleet-wide
-        ejection cascade and the survivors inherit ALL the traffic."""
+        ejection cascade and the survivors inherit ALL the traffic.
+        kind="draining": the backend announced a graceful shutdown (the
+        drain refusal detail, a NOT_SERVING health answer carrying the
+        draining reason, or a fleet gossip record) — it is leaving, not
+        recovering, so it flips to the DRAINING state: steering skips it
+        outright from the FIRST hint (zero further routed requests while
+        an alternative exists), the ejection budget is untouched, and
+        the rebuilding busy window is never cycled. After
+        draining_probe_s, half-open probing checks whether a restarted
+        process took over the address."""
         with self._lock:
             st = self._states[idx]
+            if kind == "draining":
+                st.drains += 1
+                self.drains += 1
+                st.consecutive_failures = 0
+                st.consecutive_rebuilds = 0
+                st.state = DRAINING
+                st.probe_inflight = False
+                st.current_ejection_s = 0.0
+                # Reuse the ejected_until timeline for the probe-again
+                # horizon; repeated hints extend it (the replica is still
+                # announcing its exit).
+                st.ejected_until = self._clock() + self.config.draining_probe_s
+                return
             if kind == "rebuilding" and \
                     st.consecutive_rebuilds >= self.config.rebuilding_streak_limit:
                 # The host has announced "rebuilding" this many times in a
@@ -255,7 +302,10 @@ class BackendScoreboard:
     # ------------------------------------------------------------- steering
 
     def _advance_locked(self, st: _HostState) -> None:
-        if st.state == EJECTED and self._clock() >= st.ejected_until:
+        if (
+            st.state in (EJECTED, DRAINING)
+            and self._clock() >= st.ejected_until
+        ):
             st.state = HALF_OPEN
             st.probe_inflight = False
 
@@ -357,6 +407,7 @@ class BackendScoreboard:
                 "recoveries": self.recoveries,
                 "pushbacks": self.pushbacks,
                 "rebuilds": self.rebuilds,
+                "drains": self.drains,
                 "retry_budget_exhausted": self.retry_budget_exhausted,
                 "backends": {
                     host: {
@@ -367,6 +418,7 @@ class BackendScoreboard:
                         "failures": st.failures,
                         "pushbacks": st.pushbacks,
                         "rebuilds": st.rebuilds,
+                        "drains": st.drains,
                         "busy": st.busy_until > now,
                     }
                     for host, st in zip(self.hosts, self._states)

@@ -1,0 +1,623 @@
+"""The fleet router (ISSUE 17 tentpole, part 1).
+
+A standalone process speaking the PredictionService wire protocol on
+both transports (TCP + optional UDS) that embeds `ShardedPredictClient`
+SERVER-side: every steering mechanism built for the fan-out client —
+scoreboard ejection/half-open probes, hedging, failover, jittered
+backoff, retry budgets, jump-hash row affinity (`placement="affinity"`),
+partial results — becomes the fleet's routing brain, with zero new
+steering code. An edge client dials ONE address; a replica's death is a
+router-local failover, not a client-visible error.
+
+Request metadata rides through the hop: the edge's deadline becomes the
+embedded client's per-attempt timeout (context.time_remaining), its
+`x-dts-criticality` lane and `traceparent` forward verbatim, and its
+`x-dts-retry-budget` caps the router's own attempt budget at
+min(local, advertised) — the fleet never multiplies the edge's retry
+intent (all via `client.request_overrides`, a contextvar scope, so one
+embedded client serves many concurrent edge requests).
+
+Health arrives three ways, fastest wins:
+- gossip (fleet/gossip.py): a replica announcing draining/quarantined
+  steers the whole fleet BEFORE its first failed RPC;
+- grpc.health.v1 Watch subscriptions per backend (the satellite: push,
+  not half-open polling);
+- the RPC outcomes themselves (the scoreboard's native signal).
+
+The router is also the rollout coordinator (fleet/rollout.py,
+`rollout_writer=true`): its gossip record carries the shared rollout
+state every replica follows.
+
+Run it as `python -m distributed_tf_serving_tpu.fleet.router --config
+router.toml` or `... .serving.server --router --config router.toml`:
+[server] is the router's bind address, [client] its backend list +
+steering knobs, [fleet] gossip/rollout. jax-free by construction — the
+router never loads a model.
+
+Scores through the router are bit-identical to a direct backend call:
+inputs decode/re-encode through the same codec both hops, and float32
+tensors round-trip exactly. Deliberate simplifications, documented:
+the router serves the client's single configured model + score output
+(NOT_FOUND otherwise), and PredictStream answers as ONE final chunk —
+the stream's incremental-merge benefit needs row ownership the router
+already spent on fleet affinity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+import threading
+import time
+
+import grpc
+import grpc.aio
+
+from .. import codec
+from ..client.client import PredictClientError, PredictResult, client_from_config
+from ..client.health import HEALTHY
+from ..proto import health as health_proto
+from ..proto import serving_apis_pb2 as apis
+from ..proto.service_grpc import (
+    KEEPALIVE_SERVER_OPTIONS,
+    LARGE_MESSAGE_CHANNEL_OPTIONS,
+    add_PredictionServiceServicer_to_server,
+)
+from ..utils.config import load_config
+from . import gossip as gossip_mod
+from .gossip import GossipAgent
+from .rollout import RolloutCoordinator
+
+log = logging.getLogger("dts_tpu.fleet.router")
+
+_CRITICALITY_KEY = "x-dts-criticality"
+_RETRY_BUDGET_KEY = "x-dts-retry-budget"
+_DEGRADED_KEY = "x-dts-degraded"
+
+
+def _metadata_of(context) -> dict[str, str]:
+    try:
+        return {k: v for k, v in context.invocation_metadata() or ()
+                if isinstance(v, str)}
+    except Exception:  # noqa: BLE001 — metadata quirks must not fail RPCs
+        return {}
+
+
+def _deadline_of(context) -> float | None:
+    remaining = context.time_remaining()
+    if remaining is None or remaining == float("inf") or remaining <= 0:
+        return None
+    return remaining
+
+
+class Router:
+    """The wiring: embedded client + gossip agent + rollout coordinator
+    + counters. Servicers below are thin adapters over `forward()`."""
+
+    def __init__(self, cfgs: dict, *, clock=time.time):
+        self.client = client_from_config(cfgs["client"])
+        self.fleet_cfg = cfgs.get("fleet")
+        self._clock = clock
+        # Gossip record id -> backend index in the client's host list.
+        # Convention: a replica's [fleet] self_id is its SERVING address
+        # exactly as the router's [client] hosts lists it.
+        self._backend_idx = {h: i for i, h in enumerate(self.client.hosts)}
+        self.coordinator: RolloutCoordinator | None = None
+        self.gossip: GossipAgent | None = None
+        if self.fleet_cfg is not None and self.fleet_cfg.enabled:
+            if self.fleet_cfg.rollout_writer:
+                self.coordinator = RolloutCoordinator(
+                    self.fleet_cfg.rollout_state_file, clock=clock
+                )
+            self.gossip = GossipAgent(
+                self.fleet_cfg.self_id or "router",
+                role="router",
+                host=self.fleet_cfg.gossip_host,
+                port=self.fleet_cfg.gossip_port,
+                uds_path=self.fleet_cfg.gossip_uds,
+                peers=self.fleet_cfg.peers,
+                interval_s=self.fleet_cfg.gossip_interval_s,
+                ttl_s=self.fleet_cfg.record_ttl_s,
+                record_fn=self._gossip_record,
+                on_update=self.fold_gossip,
+                extra_routes={
+                    "/fleetz": self.fleetz,
+                    "/metrics": self.prometheus_text,
+                },
+                clock=clock,
+            )
+        # Counters (monotonic; /fleetz + dts_tpu_fleet_*).
+        self.requests = 0
+        self.errors = 0
+        self.degraded = 0
+        self.gossip_steers = 0
+        self.gossip_rejoins = 0
+        self.watch_updates = 0
+        self._started_t = clock()
+        self._watch_tasks: list[asyncio.Task] = []
+
+    # ------------------------------------------------------------- gossip
+
+    def _gossip_record(self) -> dict:
+        rec = {"state": gossip_mod.SERVING}
+        if self.coordinator is not None and self.gossip is not None:
+            # Coordination rides the publish cadence: fold the current
+            # view (sans self — self_record() is what's being built) and
+            # attach the resulting shared state to the outgoing record.
+            view = self.gossip.view(include_self=False)
+            rec["rollout"] = self.coordinator.tick(view).to_dict()
+        return rec
+
+    def fold_gossip(self, rec) -> None:
+        """Gossip -> scoreboard steering: quarantine/drain announcements
+        steer the fleet BEFORE the first failed RPC lands on them; a
+        fresh serving record from a non-healthy backend is the rejoin
+        path (the restarted process re-admits itself by speaking)."""
+        sb = self.client.scoreboard
+        idx = self._backend_idx.get(rec.id)
+        if sb is None or idx is None:
+            return
+        if rec.state == gossip_mod.DRAINING:
+            if sb.state(idx) != gossip_mod.DRAINING:
+                self.gossip_steers += 1
+            sb.record_failure(idx, kind="draining")
+        elif rec.state in (gossip_mod.QUARANTINED, gossip_mod.STARTING):
+            if sb.state(idx) == HEALTHY:
+                self.gossip_steers += 1
+                sb.record_failure(idx, kind="rebuilding")
+        elif rec.state == gossip_mod.SERVING and sb.state(idx) != HEALTHY:
+            self.gossip_rejoins += 1
+            sb.record_success(idx)
+
+    # --------------------------------------------------- health watchers
+
+    async def watch_backends(self) -> None:
+        """Subscribe to every backend's grpc.health.v1 Watch stream (the
+        satellite: push replaces half-open polling). Each status CHANGE
+        folds into the scoreboard; a broken stream retries with capped
+        backoff forever — a dead backend simply has no stream."""
+        for idx in range(len(self.client.hosts)):
+            self._watch_tasks.append(
+                asyncio.ensure_future(self._watch_one(idx))
+            )
+
+    async def _watch_one(self, idx: int) -> None:
+        backoff = 0.5
+        while True:
+            try:
+                # The client's channel for this backend: one connection
+                # serves Predict traffic and the Watch subscription.
+                stub = health_proto.HealthStub(
+                    self.client._channels[idx][0]
+                )
+                call = stub.Watch(health_proto.HealthCheckRequest(""))
+                async for resp in call:
+                    backoff = 0.5
+                    self._fold_watch(idx, resp.status)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — a dead backend is normal
+                pass
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)
+
+    def _fold_watch(self, idx: int, status: int) -> None:
+        sb = self.client.scoreboard
+        if sb is None:
+            return
+        self.watch_updates += 1
+        if status == health_proto.SERVING:
+            if sb.state(idx) != HEALTHY:
+                self.gossip_rejoins += 1
+                sb.record_success(idx)
+        elif status == health_proto.NOT_SERVING and sb.state(idx) == HEALTHY:
+            # No reason trailer on a stream message: steer-around bias
+            # (rebuilding), not ejection — gossip carries the distinction
+            # between drain and quarantine.
+            sb.record_failure(idx, kind="rebuilding")
+
+    def stop_watchers(self) -> None:
+        for t in self._watch_tasks:
+            t.cancel()
+        self._watch_tasks = []
+
+    # ------------------------------------------------------------ forward
+
+    def healthy_backends(self) -> int:
+        sb = self.client.scoreboard
+        if sb is None:
+            return len(self.client.hosts)
+        return sum(
+            1 for i in range(len(self.client.hosts))
+            if sb.state(i) == HEALTHY
+        )
+
+    async def forward(self, request: apis.PredictRequest, context):
+        """One edge Predict through the embedded client. Returns the
+        merged score array (+ degraded flag); raises PredictClientError
+        for the servicer to map."""
+        name = request.model_spec.name
+        if name and name != self.client.model_name:
+            raise ServiceRefusal(
+                grpc.StatusCode.NOT_FOUND,
+                f"router serves model {self.client.model_name!r}, "
+                f"not {name!r}",
+            )
+        try:
+            arrays = {
+                k: codec.to_ndarray(request.inputs[k])
+                for k in request.inputs
+            }
+        except (codec.CodecError, ValueError) as e:
+            raise ServiceRefusal(
+                grpc.StatusCode.INVALID_ARGUMENT, f"bad input tensor: {e}"
+            ) from e
+        if not arrays:
+            raise ServiceRefusal(
+                grpc.StatusCode.INVALID_ARGUMENT, "request has no inputs"
+            )
+        md = _metadata_of(context)
+        budget = md.get(_RETRY_BUDGET_KEY)
+        try:
+            budget = max(int(budget), 1) if budget else None
+        except ValueError:
+            budget = None
+        self.requests += 1
+        with self.client.request_overrides(
+            criticality=md.get(_CRITICALITY_KEY),
+            timeout_s=_deadline_of(context),
+            traceparent=md.get("traceparent"),
+            max_attempts_total=budget,
+        ):
+            result = await self.client.predict(arrays)
+        if isinstance(result, PredictResult):
+            if result.degraded:
+                self.degraded += 1
+                try:
+                    context.set_trailing_metadata(((_DEGRADED_KEY, "partial"),))
+                except Exception:  # noqa: BLE001 — advisory only
+                    pass
+            return result.scores
+        return result
+
+    def encode_response(self, request, scores) -> apis.PredictResponse:
+        resp = apis.PredictResponse()
+        resp.model_spec.name = self.client.model_name
+        resp.model_spec.signature_name = (
+            request.model_spec.signature_name or "serving_default"
+        )
+        # Mirror the edge's tensor encoding (the server's own rule, so
+        # the bytes match a direct backend response).
+        mirror = any(
+            request.inputs[name].tensor_content for name in request.inputs
+        )
+        codec.from_ndarray(
+            scores, use_tensor_content=mirror,
+            out=resp.outputs[self.client.output_key],
+        )
+        return resp
+
+    # ----------------------------------------------------------- surfaces
+
+    def fleetz(self) -> dict:
+        out = {
+            "enabled": True,
+            "role": "router",
+            "model": self.client.model_name,
+            "backends": list(self.client.hosts),
+            "healthy_backends": self.healthy_backends(),
+            "uptime_s": round(self._clock() - self._started_t, 3),
+            "counters": {
+                "requests": self.requests,
+                "errors": self.errors,
+                "degraded": self.degraded,
+                "gossip_steers": self.gossip_steers,
+                "gossip_rejoins": self.gossip_rejoins,
+                "watch_updates": self.watch_updates,
+            },
+            "resilience": self.client.resilience_counters(),
+        }
+        if self.gossip is not None:
+            out["gossip"] = self.gossip.snapshot()
+        if self.coordinator is not None:
+            out["rollout"] = self.coordinator.snapshot()
+        return out
+
+    def fleet_stats(self) -> dict:
+        """The shape utils.metrics._fleet_prometheus_lines consumes (the
+        replica side builds the same shape in service.fleet_stats)."""
+        stats = {
+            "role": "router",
+            "router": {
+                "requests": self.requests,
+                "errors": self.errors,
+                "degraded": self.degraded,
+                "gossip_steers": self.gossip_steers,
+                "gossip_rejoins": self.gossip_rejoins,
+                "watch_updates": self.watch_updates,
+                "healthy_backends": self.healthy_backends(),
+                "backends": len(self.client.hosts),
+            },
+        }
+        if self.gossip is not None:
+            stats["gossip"] = self.gossip.snapshot()
+        if self.coordinator is not None:
+            stats["rollout"] = self.coordinator.snapshot()
+        return stats
+
+    def prometheus_text(self) -> str:
+        from ..utils.metrics import fleet_prometheus_text
+
+        return fleet_prometheus_text(self.fleet_stats())
+
+
+class ServiceRefusal(Exception):
+    """A router-local refusal with a grpc status (the ServiceError shape
+    without the serving package's jax-linked import)."""
+
+    def __init__(self, code, details: str):
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class RouterPredictionService:
+    """PredictionService servicer over Router.forward. Predict and
+    PredictStream proxy; GetModelMetadata forwards to a healthy backend;
+    the tf.Example RPCs answer UNIMPLEMENTED (the fleet tier fronts the
+    tensor path — the reference deployment's shape)."""
+
+    def __init__(self, router: Router):
+        self.router = router
+
+    async def _abort(self, context, e) -> None:
+        self.router.errors += 1
+        code = getattr(e, "code", None)
+        if not isinstance(code, grpc.StatusCode):
+            code = grpc.StatusCode.UNAVAILABLE
+        await context.abort(code, getattr(e, "details", str(e)))
+
+    async def Predict(self, request, context):
+        try:
+            scores = await self.router.forward(request, context)
+            return self.router.encode_response(request, scores)
+        except (ServiceRefusal, PredictClientError) as e:
+            await self._abort(context, e)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — surface as INTERNAL
+            log.exception("router Predict failed")
+            self.router.errors += 1
+            await context.abort(
+                grpc.StatusCode.INTERNAL, f"router internal error: {e}"
+            )
+
+    async def PredictStream(self, request, context):
+        """One FINAL chunk carrying the whole merged result (documented
+        simplification: the router already fanned the rows out by
+        affinity; a second chunking layer would re-split the merge it
+        just paid for). Wire-compatible with the incremental client —
+        offset 0, count == total, final=True."""
+        try:
+            scores = await self.router.forward(request, context)
+        except (ServiceRefusal, PredictClientError) as e:
+            await self._abort(context, e)
+            return
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            log.exception("router PredictStream failed")
+            self.router.errors += 1
+            await context.abort(
+                grpc.StatusCode.INTERNAL, f"router internal error: {e}"
+            )
+            return
+        chunk = apis.PredictStreamChunk()
+        chunk.model_spec.name = self.router.client.model_name
+        chunk.model_spec.signature_name = (
+            request.model_spec.signature_name or "serving_default"
+        )
+        n = int(scores.shape[0]) if scores.ndim else 1
+        chunk.offset = 0
+        chunk.count = n
+        chunk.total = n
+        chunk.final = True
+        mirror = any(
+            request.inputs[name].tensor_content for name in request.inputs
+        )
+        codec.from_ndarray(
+            scores, use_tensor_content=mirror,
+            out=chunk.outputs[self.router.client.output_key],
+        )
+        yield chunk
+
+    async def GetModelMetadata(self, request, context):
+        """Proxied to one healthy backend (metadata is fleet-uniform: the
+        replicas serve the same model dirs)."""
+        client = self.router.client
+        sb = client.scoreboard
+        idx = (sb.pick(0) if sb is not None else 0) or 0
+        stub = client._stubs[idx][0]
+        try:
+            return await stub.GetModelMetadata(
+                request, timeout=client.timeout_s
+            )
+        except grpc.aio.AioRpcError as e:
+            self.router.errors += 1
+            await context.abort(e.code(), e.details() or "backend error")
+
+    async def Classify(self, request, context):
+        await context.abort(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "the fleet router proxies the tensor Predict path only",
+        )
+
+    async def Regress(self, request, context):
+        await context.abort(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "the fleet router proxies the tensor Predict path only",
+        )
+
+    async def MultiInference(self, request, context):
+        await context.abort(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "the fleet router proxies the tensor Predict path only",
+        )
+
+
+class RouterHealthService:
+    """grpc.health.v1 for the router itself: SERVING while at least one
+    backend is believed healthy (the router without backends is down in
+    every way that matters to an edge client)."""
+
+    watch_poll_s = 0.2
+
+    def __init__(self, router: Router):
+        self.router = router
+
+    def _status(self, service: str) -> int | None:
+        if service and service != self.router.client.model_name:
+            return None
+        return (
+            health_proto.SERVING
+            if self.router.healthy_backends() > 0
+            else health_proto.NOT_SERVING
+        )
+
+    async def Check(self, request, context):
+        st = self._status(request.service)
+        if st is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"unknown service {request.service!r}",
+            )
+        return health_proto.HealthCheckResponse(status=st)
+
+    async def Watch(self, request, context):
+        last = None
+        while True:
+            st = self._status(request.service)
+            if st is None:
+                st = health_proto.SERVICE_UNKNOWN
+            if st != last:
+                last = st
+                yield health_proto.HealthCheckResponse(status=st)
+            await asyncio.sleep(self.watch_poll_s)
+
+
+async def run_router(
+    cfgs: dict,
+    *,
+    host: str | None = None,
+    port: int | None = None,
+    uds_path: str | None = None,
+    ready_cb=None,
+) -> None:
+    """Build and serve a router until cancelled/SIGTERM. `ready_cb(port,
+    router)` fires after bind (tests + the soak's readiness line)."""
+    router = Router(cfgs)
+    server = grpc.aio.server(
+        options=list(LARGE_MESSAGE_CHANNEL_OPTIONS)
+        + list(KEEPALIVE_SERVER_OPTIONS),
+    )
+    add_PredictionServiceServicer_to_server(
+        RouterPredictionService(router), server
+    )
+    health_proto.add_HealthServicer_to_server(
+        RouterHealthService(router), server
+    )
+    srv_cfg = cfgs["server"]
+    bind_host = host if host is not None else srv_cfg.host
+    bind_port = port if port is not None else srv_cfg.port
+    bound = server.add_insecure_port(f"{bind_host}:{bind_port}")
+    if bound == 0:
+        raise RuntimeError(f"could not bind {bind_host}:{bind_port}")
+    transport = cfgs.get("transport")
+    eff_uds = uds_path if uds_path is not None else (
+        getattr(transport, "uds_path", "") or ""
+    )
+    if eff_uds:
+        import os
+
+        try:
+            if os.path.exists(eff_uds):
+                os.unlink(eff_uds)
+        except OSError:
+            pass
+        if server.add_insecure_port(f"unix:{eff_uds}") == 0:
+            raise RuntimeError(f"could not bind unix:{eff_uds}")
+    await server.start()
+    if router.gossip is not None:
+        router.gossip.start()
+    await router.watch_backends()
+    log.info(
+        "fleet router up on %s:%d -> %d backends%s", bind_host, bound,
+        len(router.client.hosts),
+        f" (gossip {router.gossip.listen_addr})" if router.gossip else "",
+    )
+    if ready_cb is not None:
+        ready_cb(bound, router)
+    stop_evt = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop_evt.set)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-main thread / platform without signal support
+    try:
+        await stop_evt.wait()
+    finally:
+        router.stop_watchers()
+        if router.gossip is not None:
+            router.gossip.stop()
+        await server.stop(grace=2.0)
+        try:
+            await router.client.close()
+        except Exception:  # noqa: BLE001 — channels may already be gone
+            pass
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="TPU-serving fleet router: PredictionService front "
+        "for a replica fleet, steered by scoreboard + health gossip"
+    )
+    parser.add_argument("--config", required=True,
+                        help="TOML with [server] (bind), [client] "
+                        "(backends + steering), [fleet] (gossip/rollout)")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--uds-path", default=None)
+    parser.add_argument("--ready-fd", type=int, default=None,
+                        help="fd to write one readiness JSON line to "
+                        "after bind (harness plumbing)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    cfgs = load_config(args.config)
+
+    def _ready(port: int, router: Router) -> None:
+        if args.ready_fd is None:
+            return
+        import os
+
+        line = json.dumps({
+            "port": port,
+            "gossip": router.gossip.listen_addr if router.gossip else None,
+        })
+        os.write(args.ready_fd, (line + "\n").encode("utf-8"))
+        os.close(args.ready_fd)
+
+    asyncio.run(run_router(
+        cfgs, host=args.host, port=args.port, uds_path=args.uds_path,
+        ready_cb=_ready,
+    ))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,4 @@
-"""Hand-written grpc.health.v1 bindings (Check only).
+"""Hand-written grpc.health.v1 bindings (Check + streaming Watch).
 
 The standard `grpcio-health-checking` package is not in this image, and the
 two messages involved are trivial, so — like service_grpc.py — the wire
@@ -10,9 +10,14 @@ health/v1/health.proto:
     enum ServingStatus { UNKNOWN=0; SERVING=1; NOT_SERVING=2; SERVICE_UNKNOWN=3; }
 
 Standard health-checking clients (grpc_health_probe, Kubernetes gRPC
-probes, the upstream HealthStub) interoperate unchanged. Only the unary
-`Check` RPC is wired; `Watch` (server-streaming) is left unimplemented —
-the scoreboard's half-open probes and orchestration probes both poll.
+probes, the upstream HealthStub) interoperate unchanged. Both RPCs are
+wired: unary `Check` (the scoreboard's half-open probes and orchestration
+probes poll it) and server-streaming `Watch` (a subscriber gets the
+current status immediately, then a message on every change — fleet
+routers subscribe instead of polling). Per the health.proto contract,
+Watch answers status SERVICE_UNKNOWN for a service the server does not
+know — it does NOT abort, so the watcher keeps the stream and sees the
+service appear later.
 """
 
 from __future__ import annotations
@@ -139,19 +144,32 @@ class HealthStub:
             request_serializer=HealthCheckRequest.SerializeToString,
             response_deserializer=HealthCheckResponse.FromString,
         )
+        self.Watch = channel.unary_stream(
+            f"/{HEALTH_SERVICE_NAME}/Watch",
+            request_serializer=HealthCheckRequest.SerializeToString,
+            response_deserializer=HealthCheckResponse.FromString,
+        )
 
 
 class HealthServicer:
-    """Service base class; override Check."""
+    """Service base class; override Check and Watch."""
 
     def Check(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "Check not implemented")
+
+    def Watch(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Watch not implemented")
 
 
 def add_HealthServicer_to_server(servicer, server) -> None:
     handlers = {
         "Check": grpc.unary_unary_rpc_method_handler(
             servicer.Check,
+            request_deserializer=HealthCheckRequest.FromString,
+            response_serializer=HealthCheckResponse.SerializeToString,
+        ),
+        "Watch": grpc.unary_stream_rpc_method_handler(
+            servicer.Watch,
             request_deserializer=HealthCheckRequest.FromString,
             response_serializer=HealthCheckResponse.SerializeToString,
         ),

@@ -160,6 +160,11 @@ class LifecycleController:
         self._stable: int | None = None
         self._canary: int | None = None
         self._fraction = 0.0
+        # Fleet-coordinated rollout (ISSUE 17): when the fleet plane sets
+        # a fleet-global ramp fraction, it overrides the local ramp
+        # schedule — every replica serves the SAME canary share, decided
+        # once by the rollout coordinator. None = local schedule.
+        self._fleet_fraction: float | None = None
         self._route_seq = 0
         self._next_tick = -math.inf
         # When the ramp first reached max_fraction (None below it): the
@@ -361,6 +366,13 @@ class LifecycleController:
                 cfg.canary_initial_fraction + steps * cfg.canary_ramp_step,
                 cfg.canary_max_fraction,
             )
+        fleet_frac = self._fleet_fraction
+        if fleet_frac is not None:
+            # Fleet override: the coordinator's fraction wins over the
+            # local clock (still capped at the operator's ceiling — the
+            # fleet can slow a replica down or catch it up, not push it
+            # past its configured max).
+            frac = min(max(float(fleet_frac), 0.0), cfg.canary_max_fraction)
         with self._lock:
             if frac != self._fraction:
                 self._route_seq = 0  # restart the counter ramp per step
@@ -518,6 +530,60 @@ class LifecycleController:
             log.exception("lifecycle watcher call failed")  # kill the tick
             return default
 
+    # --------------------------------------------------------- fleet hooks
+
+    def set_fleet_fraction(self, fraction: float | None) -> None:
+        """Adopt the fleet-global ramp fraction (rollout coordinator via
+        gossip); None returns routing to the local ramp schedule."""
+        with self._lock:
+            self._fleet_fraction = (
+                None if fraction is None else float(fraction)
+            )
+
+    def force_rollback(self, reason: str = "forced") -> bool:
+        """Roll back the live canary NOW without waiting for local
+        quality evidence — the fleet-coordinated rollback path (another
+        replica's judge fired) and the POST /lifecyclez/rollback
+        operator surface. Returns False when no canary is live."""
+        now = self._clock()
+        with self._tick_mutex:
+            with self._lock:
+                if self._state != CANARY or self._canary is None:
+                    return False
+            self._rollback(now, {"verdict": "regressed", "reason": reason})
+        return True
+
+    def fleet_blacklist(self, version: int) -> str:
+        """Apply a fleet-wide version blacklist entry locally: the live
+        canary rolls back; a merely-loaded version is retired
+        (unload + blacklist); an unseen version is blacklisted so the
+        watcher can never hot-load it. The stable version is REFUSED —
+        the fleet must never talk a replica out of its only good
+        version. Returns the action taken (for /fleetz and tests)."""
+        with self._lock:
+            canary, stable = self._canary, self._stable
+        if version == stable:
+            return "refused_stable"
+        if version == canary:
+            return (
+                "rolled_back"
+                if self.force_rollback(reason="fleet_blacklist")
+                else "noop"
+            )
+        if self.watcher is not None:
+            if self._safe(lambda: self.watcher.is_blacklisted(version), False):
+                return "already_blacklisted"
+            if version in self._versions():
+                self._safe(lambda: self.watcher.retire(version))
+                return "retired"
+            self._safe(lambda: self.watcher.blacklist(version))
+            return "blacklisted"
+        try:
+            self.registry.unload(self.model, version)
+            return "unloaded"
+        except KeyError:
+            return "noop"
+
     # ----------------------------------------------------------- publisher
 
     def publish_once(self, stop_evt: threading.Event | None = None) -> dict | None:
@@ -641,6 +707,17 @@ class LifecycleController:
 
     # ------------------------------------------------------------ surfaces
 
+    def fleet_record(self) -> dict:
+        """The lifecycle slice of this replica's gossip record — cheap
+        (no events copy, no watcher snapshot): published every gossip
+        interval."""
+        with self._lock:
+            return {
+                "canary": self._canary,
+                "canary_fraction": round(self._fraction, 4),
+                "rolled_back": self._rolled_back_version,
+            }
+
     def snapshot(self) -> dict:
         """The /lifecyclez body, the `lifecycle` /monitoring block, and
         the dts_tpu_lifecycle_* Prometheus source."""
@@ -656,6 +733,7 @@ class LifecycleController:
                 "stable_version": self._stable,
                 "canary_version": self._canary,
                 "canary_fraction": round(self._fraction, 4),
+                "fleet_fraction": self._fleet_fraction,
                 "promoted_version": self._promoted_version,
                 "rolled_back_version": self._rolled_back_version,
                 "counters": {

@@ -189,6 +189,15 @@ class RestGateway:
             # rollback history, and the version watcher's blacklist/pin
             # state.
             web.get("/lifecyclez", self.lifecyclez),
+            # Operator rollback lever (ISSUE 17): demote the live canary
+            # NOW — the same path the quality gate takes, so the fleet
+            # coordinator sees rolled_back in the next gossip record and
+            # blacklists the version fleet-wide.
+            web.post("/lifecyclez/rollback", self.lifecyclez_rollback),
+            # Fleet plane (ISSUE 17): this member's gossip view — every
+            # known replica/router record, exchange counters, and the
+            # rollout follower/coordinator state.
+            web.get("/fleetz", self.fleetz),
             # Recovery plane (ISSUE 11): the device-failure recovery
             # state machine — quarantine/reinit/replay counters, the
             # poisoned-input bisection verdicts, and the last cycle's
@@ -575,6 +584,7 @@ class RestGateway:
                 kernels=self.impl.kernels_stats(),
                 mesh=mesh,
                 elastic=self.impl.elastic_stats(mesh=mesh),
+                fleet=self.impl.fleet_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -610,6 +620,7 @@ class RestGateway:
             "kernels": self.impl.kernels_stats,
             "mesh": self.impl.mesh_stats,
             "elastic": self.impl.elastic_stats,
+            "fleet": self.impl.fleet_stats,
             "versions": self.impl.versions_stats,
             "pipeline": self.impl.pipeline_stats,
             "request_log": request_log,
@@ -644,7 +655,7 @@ class RestGateway:
         # waterfall merge).
         for name in ("cache", "row_cache", "overload", "utilization",
                      "quality", "lifecycle", "recovery", "kernels", "mesh",
-                     "elastic", "versions", "pipeline"):
+                     "elastic", "fleet", "versions", "pipeline"):
             if name == "mesh":
                 block = self.impl.mesh_stats(
                     utilization=snap.get("utilization")
@@ -800,6 +811,43 @@ class RestGateway:
         return web.json_response(
             stats if stats is not None else {"enabled": False}
         )
+
+    async def lifecyclez_rollback(self, request: web.Request) -> web.Response:
+        """POST /lifecyclez/rollback: operator-forced demotion of the
+        live canary — the SAME path the drift/AUC gate takes (retire +
+        blacklist + restore stable), so the fleet coordinator's next
+        tick sees `rolled_back` in this replica's gossip record and
+        blacklists the version on EVERY replica. Body (optional JSON):
+        {"reason": "..."}. 409 when there is no canary to roll back;
+        `{"enabled": false}` + 404 when no controller is armed."""
+        lifecycle = getattr(self.impl, "lifecycle", None)
+        if lifecycle is None:
+            return web.json_response({"enabled": False}, status=404)
+        reason = "operator"
+        try:
+            body = await request.json()
+            if isinstance(body, dict) and body.get("reason"):
+                reason = str(body["reason"])
+        except Exception:  # noqa: BLE001 — empty body is fine
+            pass
+        rolled = lifecycle.force_rollback(reason)
+        return web.json_response(
+            {"rolled_back": rolled, "reason": reason,
+             "lifecycle": self.impl.lifecycle_stats()},
+            status=200 if rolled else 409,
+        )
+
+    async def fleetz(self, request: web.Request) -> web.Response:
+        """GET /fleetz: this member's fleet view — gossip membership
+        (every known replica/router record with state/pressure/versions/
+        canary fields), exchange + record-disposition counters, and the
+        rollout follower state. `{"enabled": false}` when the replica is
+        not fleet-joined ([fleet] enabled=false), so probes need no
+        config knowledge."""
+        plane = getattr(self.impl, "fleet", None)
+        if plane is None:
+            return web.json_response({"enabled": False})
+        return web.json_response({"enabled": True, **plane.snapshot()})
 
     async def recoveryz(self, request: web.Request) -> web.Response:
         """GET /recoveryz: the device-failure recovery surface — the

@@ -328,6 +328,13 @@ class GrpcModelService(_SyncServicerBase):
         )
 
 
+# Trailing-metadata key naming WHY a health Check answered NOT_SERVING
+# ("draining" | "quarantined" | "starting"): the fan-out client and the
+# fleet router steer a draining replica straight to the DRAINING
+# scoreboard state instead of cycling the rebuilding retry window.
+HEALTH_REASON_METADATA_KEY = "x-dts-health-reason"
+
+
 class GrpcHealthService:
     """grpc.health.v1 Health over the serving state (proto/health.py glue;
     standard health-checking clients and the fan-out client's half-open
@@ -343,6 +350,11 @@ class GrpcHealthService:
       names this server was never told about (the health spec's
       unknown-service answer).
     """
+
+    # How often Watch re-evaluates serving state. Each sync watcher holds
+    # a thread-pool worker for the stream's lifetime, so this is a
+    # router-tier surface (a handful of subscribers), not an edge one.
+    watch_poll_s = 0.2
 
     def __init__(self, impl: PredictionServiceImpl):
         self.impl = impl
@@ -375,27 +387,89 @@ class GrpcHealthService:
             else None
         )
 
-    def Check(self, request, context):
+    def _reason(self, service: str) -> str:
+        """WHY the overall service is NOT_SERVING, as the
+        x-dts-health-reason trailer: "draining" (GracefulShutdown — the
+        process is leaving; steer away and do NOT re-probe it on the
+        rebuild cadence), "quarantined" (recovery cycle — it comes back),
+        or "starting" (warmup not finished). Empty for per-model checks,
+        whose NOT_SERVING already means "configured, no version"."""
+        if service:
+            return ""
+        if getattr(self.impl, "draining", False):
+            return "draining"
+        recovery = getattr(self.impl, "recovery", None)
+        if recovery is not None and recovery.not_serving():
+            return "quarantined"
+        return "starting"
+
+    def _check_response(self, request, context):
         st = self._status(request.service)
         if st is None:
+            return None
+        if st == health_proto.NOT_SERVING:
+            reason = self._reason(request.service)
+            if reason:
+                context.set_trailing_metadata(
+                    ((HEALTH_REASON_METADATA_KEY, reason),)
+                )
+        return health_proto.HealthCheckResponse(status=st)
+
+    def Check(self, request, context):
+        resp = self._check_response(request, context)
+        if resp is None:
             context.abort(
                 grpc.StatusCode.NOT_FOUND,
                 f"unknown service {request.service!r}",
             )
-        return health_proto.HealthCheckResponse(status=st)
+        return resp
+
+    def Watch(self, request, context):
+        """grpc.health.v1 streaming Watch: current status immediately,
+        then a message per CHANGE. Per the health spec an unknown service
+        streams SERVICE_UNKNOWN (no abort) so the watcher sees it appear
+        later. Fleet routers subscribe here instead of half-open
+        polling."""
+        last = None
+        while context.is_active():
+            st = self._status(request.service)
+            if st is None:
+                st = health_proto.SERVICE_UNKNOWN
+            if st != last:
+                last = st
+                yield health_proto.HealthCheckResponse(status=st)
+            time.sleep(self.watch_poll_s)
+
+    def watch_once(self, request, context):  # pragma: no cover - hook
+        """Test seam: one Watch evaluation without the stream loop."""
+        st = self._status(request.service)
+        return health_proto.SERVICE_UNKNOWN if st is None else st
 
 
 class AioGrpcHealthService(GrpcHealthService):
     """Same status logic on the coroutine server (context.abort awaits)."""
 
     async def Check(self, request, context):
-        st = self._status(request.service)
-        if st is None:
+        resp = self._check_response(request, context)
+        if resp is None:
             await context.abort(
                 grpc.StatusCode.NOT_FOUND,
                 f"unknown service {request.service!r}",
             )
-        return health_proto.HealthCheckResponse(status=st)
+        return resp
+
+    async def Watch(self, request, context):
+        import asyncio
+
+        last = None
+        while True:
+            st = self._status(request.service)
+            if st is None:
+                st = health_proto.SERVICE_UNKNOWN
+            if st != last:
+                last = st
+                yield health_proto.HealthCheckResponse(status=st)
+            await asyncio.sleep(self.watch_poll_s)
 
 
 def _add_uds_port(server, uds_path: str) -> None:
@@ -1110,6 +1184,11 @@ class GracefulShutdown:
         # ISSUE 11 satellite). Captured-but-unreplayed items fail
         # UNAVAILABLE so their clients reroute immediately.
         self.recovery = recovery
+        # Fleet plane (fleet/replica.py): announced IMMEDIATELY after the
+        # draining flip — peers and the router hear the drain through
+        # gossip before their next health probe — then stopped with the
+        # transport.
+        self.fleet = None
         self.server = None  # attached once created (create_server[_async])
         self.drained: bool | None = None
         self._lock = threading.Lock()
@@ -1148,6 +1227,14 @@ class GracefulShutdown:
             t0 = time.perf_counter()
             # 1. Refuse new work; health goes NOT_SERVING.
             self.impl.draining = True
+            # 1.5. Tell the fleet NOW (one immediate push-pull round, not
+            # the next interval): the router folds the draining record
+            # into its scoreboard before this replica's first refused RPC.
+            if self.fleet is not None:
+                try:
+                    self.fleet.announce()
+                except Exception:
+                    log.debug("fleet drain announce failed", exc_info=True)
             # 2. No new loads/warmups behind the drain: the lifecycle
             # controller first (its ticks drive the watcher), then the
             # watcher itself.
@@ -1178,6 +1265,8 @@ class GracefulShutdown:
             )
             if self.server is not None:
                 self.server.stop(left).wait()
+            if self.fleet is not None:
+                self.fleet.stop()
             self.batcher.stop()
             if self.request_logger is not None:
                 self.request_logger.close()
@@ -1936,6 +2025,25 @@ def serve(argv=None) -> None:
         "dts_tpu_kernel_* Prometheus series)",
     )
     parser.add_argument(
+        "--fleet", action="store_true", default=None,
+        help="fleet robustness plane (fleet/): join the cross-replica "
+        "health gossip mesh and follow fleet-coordinated rollout state "
+        "(fleet/gossip.py + fleet/rollout.py). Equivalent to [fleet] "
+        "enabled=true; the [fleet] section carries the self_id/peers/"
+        "gossip/rollout knobs (GET /fleetz, `fleet` block in /monitoring, "
+        "dts_tpu_fleet_* Prometheus series)",
+    )
+    parser.add_argument(
+        "--router", action="store_true", default=None,
+        help="run as the FLEET ROUTER instead of a serving replica "
+        "(fleet/router.py): a jax-free tier speaking the PredictionService "
+        "wire protocol that embeds the sharded fan-out client as its "
+        "steering brain — fleet-scope row affinity, hedging, failover, "
+        "gossip-informed scoreboard, single-writer rollout coordination. "
+        "Requires --config with [client] hosts (the replica fleet) and "
+        "[fleet]; ignores every serving/model flag",
+    )
+    parser.add_argument(
         "--uds-path", dest="uds_path",
         help="also serve gRPC on this Unix-domain socket path (co-located "
         "fan-out clients dial unix:<path>, skipping the TCP/loopback "
@@ -1994,10 +2102,29 @@ def serve(argv=None) -> None:
     )
     args = parser.parse_args(argv)
 
+    if args.router:
+        # Router tier: no model, no jax, no batcher — delegate to the
+        # fleet router's own entry point before any stack build. Shared
+        # transport flags pass through; everything else is replica-only.
+        if not args.config:
+            raise SystemExit("--router requires --config ([client] hosts "
+                             "+ [fleet] section)")
+        from ..fleet.router import main as router_main
+
+        router_argv = ["--config", args.config]
+        if args.host:
+            router_argv += ["--host", args.host]
+        if args.port:
+            router_argv += ["--port", str(args.port)]
+        if args.uds_path:
+            router_argv += ["--uds-path", args.uds_path]
+        return router_main(router_argv)
+
     from ..utils.config import (
         BatchingConfig,
         CacheConfig,
         ElasticConfig,
+        FleetConfig,
         KernelsConfig,
         LifecycleConfig,
         MeshConfig,
@@ -2047,6 +2174,9 @@ def serve(argv=None) -> None:
     kernels_config = cfgs.get("kernels") or KernelsConfig()
     if args.kernels:
         kernels_config = dataclasses.replace(kernels_config, enabled=True)
+    fleet_config = cfgs.get("fleet") or FleetConfig()
+    if args.fleet:
+        fleet_config = dataclasses.replace(fleet_config, enabled=True)
     mesh_config = cfgs.get("mesh") or MeshConfig()
     if args.mesh:
         mesh_config = dataclasses.replace(mesh_config, enabled=True)
@@ -2198,6 +2328,49 @@ def serve(argv=None) -> None:
     # SIGTERM = drain: health NOT_SERVING, new admissions refused
     # UNAVAILABLE("draining"), accepted work answered up to the grace.
     shutdown.install_signal_handler()
+    if fleet_config.enabled:
+        from ..fleet import gossip as fleet_gossip
+        from ..fleet.replica import ReplicaFleetPlane
+
+        # The gossip id defaults to this replica's serving address — the
+        # SAME string the router lists in its [client] hosts, so a gossip
+        # record steers the router's scoreboard without any id mapping.
+        fleet_self_id = fleet_config.self_id or f"{cfg.host}:{port}"
+
+        def _fleet_record() -> dict:
+            # Published every gossip interval: cheap reads only.
+            if impl.draining:
+                state = fleet_gossip.DRAINING
+            elif impl.recovery is not None and impl.recovery.not_serving():
+                state = fleet_gossip.QUARANTINED
+            elif not (impl.warmup_complete and registry.models()):
+                state = fleet_gossip.STARTING
+            else:
+                state = fleet_gossip.SERVING
+            rec = {
+                "state": state,
+                "versions": tuple(registry.models().get(cfg.model_name, ())),
+            }
+            ov = impl.overload_stats()
+            if ov:
+                rec["pressure"] = str(ov.get("state") or "")
+            if impl.lifecycle is not None:
+                rec.update(impl.lifecycle.fleet_record())
+            return rec
+
+        fleet_plane = ReplicaFleetPlane(
+            dataclasses.replace(fleet_config, self_id=fleet_self_id),
+            record_fn=_fleet_record,
+            lifecycle=impl.lifecycle,
+        )
+        impl.fleet = fleet_plane
+        shutdown.fleet = fleet_plane
+        fleet_plane.start()
+        log.info(
+            "fleet plane up (id=%s gossip=%s peers=%d rollout_follow=%s)",
+            fleet_self_id, fleet_plane.agent.listen_addr,
+            len(fleet_config.peers), impl.lifecycle is not None,
+        )
     if credentials is not None:
         log.info("gRPC port is TLS-secured (--ssl-config-file)")
     if args.rest_port:

@@ -134,6 +134,12 @@ class PredictionServiceImpl:
         # series read through it; None (default) = static split (or no
         # mesh at all).
         self.elastic = None
+        # Fleet robustness plane (fleet/replica.py, ISSUE 17): the
+        # ReplicaFleetPlane (gossip membership + rollout follower) when
+        # [fleet] armed it. GET /fleetz and the dts_tpu_fleet_*
+        # Prometheus series read through it; None (default) costs one
+        # attribute read where consulted.
+        self.fleet = None
         # Streamed sub-batch results (ISSUE 9): default server-side split
         # size (candidates per sub-batch) for PredictStream. 0 = no split
         # (one chunk per request — streaming stays wire-available but the
@@ -295,6 +301,14 @@ class PredictionServiceImpl:
         armed ([recovery] enabled=false)."""
         rec = self.recovery
         return rec.snapshot() if rec is not None else None
+
+    def fleet_stats(self) -> dict | None:
+        """Fleet-plane snapshot (gossip membership view + exchange
+        counters, rollout-follower state) — the body of GET /fleetz, the
+        `fleet` block in /monitoring, and the dts_tpu_fleet_* Prometheus
+        series. None when the plane is off ([fleet] enabled=false)."""
+        fl = self.fleet
+        return fl.fleet_stats() if fl is not None else None
 
     def kernels_stats(self) -> dict | None:
         """Kernel-plane snapshot (per-bucket decision table, measured

@@ -902,6 +902,75 @@ class KernelsConfig:
         return KernelManager(self)
 
 
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet robustness plane knobs (fleet/ package, ISSUE 17): the
+    cross-replica health gossip every member runs, and the shared rollout
+    state the router (single writer) coordinates. The router process
+    itself is `--router` / `python -m distributed_tf_serving_tpu.fleet.router`
+    over the SAME file: [server] is its bind address, [client] its
+    backend list + steering knobs, [fleet] this section. Off by default;
+    a disarmed replica pays one attribute read per hook."""
+
+    # Master switch: start a GossipAgent next to the server and register
+    # /fleetz.
+    enabled: bool = False
+    # Stable member name in gossip records. "" = derive from the gossip
+    # listen address (fine for static fleets; set it when replicas sit
+    # behind NAT or get respawned on new ports).
+    self_id: str = ""
+    # Address PEERS use to reach this member's gossip listener
+    # ("host:port" or "unix:/path"). "" = the listener's own bind
+    # address.
+    advertise_addr: str = ""
+    # Other members' gossip endpoints ("host:port" or "unix:/path").
+    # Every member gossips with every listed peer each interval
+    # (push-pull, so one live peer in common converges the fleet).
+    peers: tuple[str, ...] = ()
+    # Gossip listener bind. Port 0 = ephemeral (tests); production sets
+    # a fixed port so peers can list it. gossip_uds switches the
+    # listener (and dialing peers given as unix:...) to AF_UNIX.
+    gossip_host: str = "127.0.0.1"
+    gossip_port: int = 0
+    gossip_uds: str = ""
+    # Push-pull exchange cadence; fleet-wide convergence is one or two
+    # intervals (record rides both the push and the response).
+    gossip_interval_s: float = 0.5
+    # A member silent this long is dropped from the view (SIGKILL with
+    # no goodbye). Must exceed a few intervals or flaky peers flap.
+    record_ttl_s: float = 5.0
+    # Rollout coordination (fleet/rollout.py). Exactly ONE member — the
+    # router — sets rollout_writer=true and owns the state file; every
+    # other member follows the rollout state it sees in gossip.
+    rollout_writer: bool = False
+    # Where the writer persists rollout state (atomic rename). "" on
+    # the writer = in-memory only (still distributed via gossip, lost
+    # on router restart).
+    rollout_state_file: str = ""
+
+    def __post_init__(self):
+        for name in ("gossip_interval_s", "record_ttl_s"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v <= 0:
+                raise ValueError(
+                    f"[fleet] {name} must be a positive number, got {v!r}"
+                )
+        if not isinstance(self.gossip_port, int) or \
+                isinstance(self.gossip_port, bool) or self.gossip_port < 0:
+            raise ValueError(
+                f"[fleet] gossip_port must be a non-negative integer, "
+                f"got {self.gossip_port!r}"
+            )
+        if self.record_ttl_s <= self.gossip_interval_s:
+            raise ValueError(
+                "[fleet] record_ttl_s must exceed gossip_interval_s "
+                f"(got ttl={self.record_ttl_s!r} <= "
+                f"interval={self.gossip_interval_s!r}) — a member would "
+                "expire between its own heartbeats"
+            )
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -923,6 +992,7 @@ _SECTIONS = {
     "lifecycle": LifecycleConfig,
     "recovery": RecoveryConfig,
     "kernels": KernelsConfig,
+    "fleet": FleetConfig,
 }
 
 

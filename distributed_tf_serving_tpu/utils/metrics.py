@@ -575,7 +575,7 @@ class ServerMetrics:
     def prometheus_text(
         self, batcher_stats=None, cache=None, row_cache=None, overload=None,
         utilization=None, quality=None, lifecycle=None, pipeline=None,
-        recovery=None, kernels=None, mesh=None, elastic=None,
+        recovery=None, kernels=None, mesh=None, elastic=None, fleet=None,
     ) -> str:
         """Prometheus exposition (text format 0.0.4) of the same data
         snapshot() serves as JSON. Metric names mirror tensorflow_model_
@@ -903,6 +903,8 @@ class ServerMetrics:
             lines.extend(_mesh_prometheus_lines(mesh))
         if elastic is not None:
             lines.extend(_elastic_prometheus_lines(elastic))
+        if fleet is not None:
+            lines.extend(_fleet_prometheus_lines(fleet))
         return "\n".join(lines) + "\n"
 
 
@@ -1291,6 +1293,119 @@ def _elastic_prometheus_lines(elastic: dict) -> list[str]:
                 f'{si}{{split="{esc(split)}"}} {blk.get("in_flight", 0)}'
             )
     return lines
+
+
+def _fleet_prometheus_lines(fleet: dict) -> list[str]:
+    """dts_tpu_fleet_* exposition from a fleet_stats() snapshot (ISSUE
+    17): gossip membership (member count + members-by-state), exchange /
+    record-disposition counters, the coordinated rollout picture (seq /
+    fraction / blacklist on the router's coordinator; applied seq +
+    apply counters on a replica's follower), and the router's forwarding
+    counters. One function serves BOTH shapes — `role: "router"` carries
+    `router`/`rollout` blocks, `role: "replica"` carries `follower` —
+    so the lint's families-declared-once invariant holds either way."""
+    esc = escape_label_value
+    lines: list[str] = []
+    role = str(fleet.get("role") or "replica")
+    rl = "dts_tpu_fleet_role"
+    _family_lines(lines, rl, "gauge")
+    lines.append(f'{rl}{{role="{esc(role)}"}} 1')
+    gossip = fleet.get("gossip") or {}
+    members = gossip.get("members") or {}
+    mc = "dts_tpu_fleet_members"
+    _family_lines(lines, mc, "gauge")
+    lines.append(f"{mc} {gossip.get('member_count', len(members))}")
+    by_state: dict[str, int] = {}
+    for rec in members.values():
+        st = str((rec or {}).get("state") or "unknown")
+        by_state[st] = by_state.get(st, 0) + 1
+    ms = "dts_tpu_fleet_members_by_state"
+    _family_lines(lines, ms, "gauge")
+    for st, n in sorted(by_state.items()):
+        lines.append(f'{ms}{{state="{esc(st)}"}} {n}')
+    counters = gossip.get("counters") or {}
+    ex = "dts_tpu_fleet_gossip_exchanges_total"
+    _family_lines(lines, ex, "counter")
+    lines.append(f'{ex}{{status="ok"}} {counters.get("exchanges_ok", 0)}')
+    lines.append(
+        f'{ex}{{status="failed"}} {counters.get("exchanges_failed", 0)}'
+    )
+    rec_t = "dts_tpu_fleet_gossip_records_total"
+    _family_lines(lines, rec_t, "counter")
+    for disp in ("accepted", "stale", "expired"):
+        lines.append(
+            f'{rec_t}{{disposition="{esc(disp)}"}} '
+            f'{counters.get(f"records_{disp}", 0)}'
+        )
+    rollout = fleet.get("rollout") or {}
+    follower = fleet.get("follower") or {}
+    state = rollout.get("state") or {}
+    if state or follower:
+        seq = "dts_tpu_fleet_rollout_seq"
+        _family_lines(lines, seq, "gauge")
+        if state:
+            lines.append(f'{seq}{{side="coordinator"}} {state.get("seq", 0)}')
+        if follower:
+            lines.append(
+                f'{seq}{{side="applied"}} {follower.get("applied_seq", -1)}'
+            )
+    if state:
+        for metric, value in (
+            ("dts_tpu_fleet_rollout_fraction", state.get("fraction", 0.0)),
+            ("dts_tpu_fleet_rollout_canary_version",
+             state.get("canary_version") or 0),
+            ("dts_tpu_fleet_rollout_blacklist_size",
+             len(state.get("blacklist") or ())),
+        ):
+            _family_lines(lines, metric, "gauge")
+            lines.append(f"{metric} {value}")
+        rc = rollout.get("counters") or {}
+        ch = "dts_tpu_fleet_rollout_changes_total"
+        _family_lines(lines, ch, "counter")
+        for kind in ("adoptions", "blacklists", "clears"):
+            lines.append(f'{ch}{{kind="{esc(kind)}"}} {rc.get(kind, 0)}')
+    if follower:
+        ap = "dts_tpu_fleet_rollout_applies_total"
+        _family_lines(lines, ap, "counter")
+        lines.append(f"{ap} {follower.get('applies', 0)}")
+        bl = "dts_tpu_fleet_rollout_blacklists_applied_total"
+        _family_lines(lines, bl, "counter")
+        lines.append(f"{bl} {follower.get('blacklists_applied', 0)}")
+    router = fleet.get("router") or {}
+    if router:
+        rr = "dts_tpu_fleet_router_requests_total"
+        _family_lines(lines, rr, "counter")
+        lines.append(f'{rr}{{status="ok"}} {router.get("requests", 0)}')
+        lines.append(f'{rr}{{status="error"}} {router.get("errors", 0)}')
+        lines.append(
+            f'{rr}{{status="degraded"}} {router.get("degraded", 0)}'
+        )
+        st = "dts_tpu_fleet_router_steers_total"
+        _family_lines(lines, st, "counter")
+        lines.append(
+            f'{st}{{source="gossip"}} {router.get("gossip_steers", 0)}'
+        )
+        lines.append(
+            f'{st}{{source="watch"}} {router.get("watch_updates", 0)}'
+        )
+        rj = "dts_tpu_fleet_router_rejoins_total"
+        _family_lines(lines, rj, "counter")
+        lines.append(f"{rj} {router.get('gossip_rejoins', 0)}")
+        hb = "dts_tpu_fleet_router_healthy_backends"
+        _family_lines(lines, hb, "gauge")
+        lines.append(f"{hb} {router.get('healthy_backends', 0)}")
+        tb = "dts_tpu_fleet_router_backends"
+        _family_lines(lines, tb, "gauge")
+        lines.append(f"{tb} {router.get('backends', 0)}")
+    return lines
+
+
+def fleet_prometheus_text(fleet: dict) -> str:
+    """Standalone dts_tpu_fleet_* exposition — the router's /metrics body
+    (the router has no ServerMetrics; its only Prometheus surface is the
+    fleet plane itself). Replica-side fleet series ride the main
+    prometheus_text(fleet=...) path instead."""
+    return "\n".join(_fleet_prometheus_lines(fleet)) + "\n"
 
 
 def resilience_prometheus_text(resilience: dict) -> str:

@@ -67,3 +67,54 @@ def test_inconsistent_rows_rejected():
 def test_more_parts_than_items_rejected():
     with pytest.raises(ValueError, match="non-empty"):
         partition_list([1, 2], 3)
+
+
+# ----------------------- fleet-scope jump-hash continuity (ISSUE 17)
+
+
+def test_jump_hash_shrink_remaps_exactly_the_killed_tail_bucket():
+    """Consistency property the fleet's affinity routing leans on:
+    shrinking n -> n-1 buckets remaps EXACTLY the keys that lived in
+    bucket n-1 (~1/n of them); every other key keeps its home — so a
+    fleet resize does not cold-start every warm row cache at once."""
+    from distributed_tf_serving_tpu.client.partition import jump_hash
+
+    n, keys = 8, 4000
+    before = [jump_hash(k * 2654435761 + 17, n) for k in range(keys)]
+    after = [jump_hash(k * 2654435761 + 17, n - 1) for k in range(keys)]
+    moved = [k for k in range(keys) if before[k] != after[k]]
+    # Only ex-tail keys moved, and ALL of them did (the bucket is gone).
+    assert all(before[k] == n - 1 for k in moved)
+    assert len(moved) == sum(1 for b in before if b == n - 1)
+    # ~1/n of the keyspace (binomial around 500/4000 here).
+    assert 0.5 * keys / n < len(moved) < 1.6 * keys / n
+
+
+def test_affinity_groups_survive_replica_kill_with_one_nth_remap():
+    """Killing replica k of n at FLEET scope: affinity assignment is a
+    pure function of the row digests, so the surviving groups are
+    byte-identical — only the dead replica's ~1/n of row groups need
+    re-homing (the router's scoreboard steers just those)."""
+    from distributed_tf_serving_tpu.client.partition import affinity_groups
+
+    rng = np.random.RandomState(7)
+    rows, n = 400, 4
+    arrays = {
+        "feat_ids": rng.randint(0, 1 << 40, size=(rows, 8)).astype(np.int64),
+        "feat_wts": rng.rand(rows, 8).astype(np.float32),
+    }
+    groups = {h: idx for h, idx, _ in affinity_groups(arrays, n)}
+    assert sum(len(idx) for idx in groups.values()) == rows
+    for killed in range(n):
+        # Recomputing after the kill changes NOTHING about placement —
+        # the hash runs over the same n buckets; the router reroutes the
+        # dead group at pick() time instead of reshuffling the fleet.
+        regrouped = {h: idx for h, idx, _ in affinity_groups(arrays, n)}
+        assert sorted(regrouped) == sorted(groups)
+        for h in groups:
+            np.testing.assert_array_equal(regrouped[h], groups[h])
+        # The displaced share is ~1/n of the rows, never the whole set.
+        displaced = len(groups.get(killed, ()))
+        assert displaced < 2 * rows / n
+    # Balance: every replica owns a non-trivial share (the hash spreads).
+    assert all(rows / (3 * n) < len(idx) for idx in groups.values())

@@ -181,6 +181,38 @@ def _fully_armed_text() -> str:
             "load_up_threshold": 0.75, "load_down_threshold": 0.2,
         },
     }
+    # Fleet plane (ISSUE 17, the fourteenth plane): the union shape —
+    # router counters + gossip view + coordinator state + a follower
+    # block — so every dts_tpu_fleet_* family appears in one exposition
+    # (replica and router deployments each emit a subset).
+    fleet = {
+        "role": "router",
+        "router": {
+            "requests": 120, "errors": 2, "degraded": 1,
+            "gossip_steers": 4, "gossip_rejoins": 1, "watch_updates": 7,
+            "healthy_backends": 3, "backends": 3,
+        },
+        "gossip": {
+            "members": {
+                "127.0.0.1:8500": {"state": "serving"},
+                "127.0.0.1:8501": {"state": "draining"},
+                'we"ird\\id\n2': {"state": "quarantined"},
+            },
+            "member_count": 3,
+            "counters": {
+                "exchanges_ok": 40, "exchanges_failed": 2,
+                "records_accepted": 38, "records_stale": 5,
+                "records_expired": 1,
+            },
+        },
+        "rollout": {
+            "state": {"seq": 6, "canary_version": 3, "fraction": 0.25,
+                      "leader": "127.0.0.1:8500", "blacklist": [2]},
+            "counters": {"adoptions": 5, "blacklists": 1, "clears": 1},
+        },
+        "follower": {"applied_seq": 6, "applies": 5,
+                     "blacklists_applied": 1, "last_actions": {}},
+    }
     return m.prometheus_text(
         stats,
         cache=cache.snapshot(),
@@ -194,6 +226,7 @@ def _fully_armed_text() -> str:
         kernels=kern.snapshot(),
         mesh=mesh,
         elastic=elastic,
+        fleet=fleet,
     )
 
 
@@ -214,6 +247,10 @@ def test_fully_armed_snapshot_passes_lint():
         "dts_tpu_mesh_", "dts_tpu_mesh_device_busy_fraction",
         "dts_tpu_elastic_", "dts_tpu_elastic_switches_total",
         "dts_tpu_elastic_split_in_flight",
+        "dts_tpu_fleet_", "dts_tpu_fleet_members_by_state",
+        "dts_tpu_fleet_gossip_exchanges_total",
+        "dts_tpu_fleet_rollout_seq",
+        "dts_tpu_fleet_router_requests_total",
     ):
         assert marker in text
 

@@ -218,4 +218,24 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_LIFECYCLE_SMOKE:-0}" = "1" ]; then
         python tools/soak.py | tee "$LIFECYCLE_LINE" || rc=1
     python tools/check_lifecycle_smoke.py "$LIFECYCLE_LINE" || rc=1
 fi
+
+# Fleet smoke (TIER1_FLEET_SMOKE=1): a SOAK_FLEET=1 chaos soak — 3
+# serving-replica subprocesses (shared versioned base dir, lifecycle +
+# gossip armed) behind the fleet.router subprocess, edge traffic dialing
+# ONLY the router. SIGKILL one replica mid-traffic (zero edge-visible
+# errors, per-1s goodput >= half the steady median), restart it (must
+# rejoin the rotation via gossip), publish a canary into the shared base
+# dir, then one replica's operator rollback must blacklist the version
+# FLEET-WIDE within ~one gossip interval of the router's state change —
+# with scores through the router bit-identical to a direct backend call
+# before and after (tools/check_fleet_smoke.py). Longer budget: the run
+# boots four processes and three of them compile a bucket ladder.
+if [ "$rc" -eq 0 ] && [ "${TIER1_FLEET_SMOKE:-0}" = "1" ]; then
+    FLEET_LINE="${TIER1_FLEET_LINE:-/tmp/tier1_fleet_soak.json}"
+    echo "tier1: fleet smoke (SOAK_FLEET=1, line $FLEET_LINE)"
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_FLEET_SECONDS:-20}" SOAK_FLEET=1 \
+        python tools/soak.py | tee "$FLEET_LINE" || rc=1
+    python tools/check_fleet_smoke.py "$FLEET_LINE" || rc=1
+fi
 exit $rc

@@ -146,6 +146,32 @@ surfaces. The JSON line gains a `recovery` block gated in CI by
 tools/check_recovery_smoke.py (quarantine + replay observed, MTTR
 bounded, zero non-poison failures, bisection isolating the poison).
 
+Fleet mode (SOAK_FLEET=1): the fleet robustness plane (ISSUE 17,
+fleet/) as REAL PROCESSES — SOAK_FLEET_REPLICAS (default 3) serving
+replicas, each a full `serving.server` subprocess with a version watcher
++ lifecycle controller over ONE shared versioned base dir and an armed
+[fleet] gossip agent, behind one `fleet.router` subprocess (embedded
+ShardedPredictClient: scoreboard + jump-hash affinity + failover,
+gossip-fed steering, grpc.health.v1 Watch subscriptions, rollout
+coordinator). Edge traffic dials ONLY the router. The kill/restart
+chaos script, all mid-traffic: steady window → bit-identity probe
+(router response vs a direct backend call on the same payload) →
+SIGKILL one replica (the router must absorb it: zero edge-visible
+errors, per-1s goodput ≥ half the steady median) → restart it (it must
+rejoin the rotation via gossip, measured) → publish a canary version
+into the shared base dir (every replica's watcher hot-loads it, every
+lifecycle starts its ramp) → POST /lifecyclez/rollback on ONE replica —
+the router's rollout coordinator must blacklist the version FLEET-WIDE
+(every replica's rolled_back_version flips) within about one gossip
+interval of the router's state change, measured → closing bit-identity
+probe. The JSON line gains a `fleet` block — request/error counts,
+per-1s goodput windows, rejoin/propagation timings, both bit-identity
+probes, router /fleetz counters, dts_tpu_fleet_* series counts from the
+router's gossip-port /metrics and a replica's REST exposition — gated
+in CI by tools/check_fleet_smoke.py. Knobs: SOAK_FLEET_REPLICAS,
+SOAK_FLEET_GOSSIP_INTERVAL_S (0.25), SOAK_FLEET_FIELDS (8),
+SOAK_CANDIDATES (24 here), SOAK_GRPC_WORKERS (4 here).
+
 Tracing (SOAK_TRACE_OUT=/path/trace.json): per-request span tracing runs
 for the whole soak (utils/tracing.py; SOAK_TRACE_SAMPLE sets the tail-
 sampling rate, default 0.05 — errors/fault-annotated/slowest-N traces are
@@ -177,7 +203,565 @@ def rss_gb() -> float:
     return 0.0
 
 
+def _fleet_soak(seconds: float) -> None:
+    """SOAK_FLEET=1: the kill/restart chaos soak against a real
+    multi-process fleet (module docstring, "Fleet mode"). Self-contained:
+    the in-process soak stack below is the wrong shape for a scenario
+    whose whole point is processes dying."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import grpc
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distributed_tf_serving_tpu.client import (
+        ShardedPredictClient,
+        make_payload,
+    )
+    from distributed_tf_serving_tpu.models import (
+        ModelConfig,
+        Servable,
+        build_model,
+        ctr_signatures,
+    )
+    from distributed_tf_serving_tpu.proto import health as health_proto
+    from distributed_tf_serving_tpu.train.checkpoint import save_servable
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fields = int(os.environ.get("SOAK_FLEET_FIELDS", "8"))
+    replicas = int(os.environ.get("SOAK_FLEET_REPLICAS", "3"))
+    candidates = int(os.environ.get("SOAK_CANDIDATES", "24"))
+    workers = int(os.environ.get("SOAK_GRPC_WORKERS", "4"))
+    gossip_interval = float(
+        os.environ.get("SOAK_FLEET_GOSSIP_INTERVAL_S", "0.25")
+    )
+    ttl_s = max(gossip_interval * 6, 1.5)
+    start_rss = rss_gb()
+    t_start = time.time()
+
+    tmp = tempfile.mkdtemp(prefix="soak_fleet_")
+    base = os.path.join(tmp, "models")
+    os.makedirs(base)
+
+    # Tiny servable: the soak measures the fleet plane, not the forward.
+    config = ModelConfig(
+        name="DCN", num_fields=fields, vocab_size=1 << 12, embed_dim=8,
+        mlp_dims=(16,), num_cross_layers=1, cross_full_matrix=True,
+    )
+    model = build_model("dcn_v2", config)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    servable = Servable(
+        name="DCN", version=1, model=model, params=params,
+        signatures=ctr_signatures(fields),
+    )
+    save_servable(os.path.join(base, "1"), servable, kind="dcn_v2")
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    grpc_ports = [free_port() for _ in range(replicas)]
+    rest_ports = [free_port() for _ in range(replicas)]
+    gossip_ports = [free_port() for _ in range(replicas)]
+    router_port = free_port()
+    router_gossip = free_port()
+    backend_addrs = [f"127.0.0.1:{p}" for p in grpc_ports]
+    router_addr = f"127.0.0.1:{router_port}"
+
+    # Star topology: every replica gossips with the router only; push-pull
+    # through the common peer converges the full membership view.
+    for i in range(replicas):
+        with open(os.path.join(tmp, f"replica{i}.toml"), "w") as f:
+            f.write(
+                f'[server]\n'
+                f'host = "127.0.0.1"\n'
+                f'port = {grpc_ports[i]}\n'
+                f'model_kind = "dcn_v2"\n'
+                f'model_name = "DCN"\n'
+                f'num_fields = {fields}\n'
+                f'buckets = [8, 16, 32]\n'
+                f'max_workers = 8\n'
+                f'file_system_poll_wait_seconds = 0.5\n'
+                f'\n'
+                f'[lifecycle]\n'
+                f'enabled = true\n'
+                f'tick_interval_s = 0.2\n'
+                f'canary_probe_only_s = 0.5\n'
+                f'canary_initial_fraction = 0.25\n'
+                f'canary_ramp_step = 0.05\n'
+                f'canary_step_dwell_s = 30.0\n'
+                f'canary_max_fraction = 0.3\n'
+                f'promote_after_s = 3600.0\n'
+                f'rollback_hold_s = 60.0\n'
+                f'\n'
+                f'[fleet]\n'
+                f'enabled = true\n'
+                f'self_id = "{backend_addrs[i]}"\n'
+                f'gossip_port = {gossip_ports[i]}\n'
+                f'peers = ["127.0.0.1:{router_gossip}"]\n'
+                f'gossip_interval_s = {gossip_interval}\n'
+                f'record_ttl_s = {ttl_s}\n'
+            )
+    router_toml = os.path.join(tmp, "router.toml")
+    with open(router_toml, "w") as f:
+        f.write(
+            f'[server]\n'
+            f'host = "127.0.0.1"\n'
+            f'port = {router_port}\n'
+            f'\n'
+            f'[client]\n'
+            f'hosts = {json.dumps(backend_addrs)}\n'
+            f'model_name = "DCN"\n'
+            f'num_fields = {fields}\n'
+            f'timeout_s = 5.0\n'
+            f'health_scoreboard = true\n'
+            f'ejection_failures = 1\n'
+            f'ejection_interval_s = 1.0\n'
+            f'failover_attempts = 2\n'
+            f'backoff_initial_ms = 10\n'
+            f'partial_results = false\n'
+            f'placement = "affinity"\n'
+            f'\n'
+            f'[fleet]\n'
+            f'enabled = true\n'
+            f'self_id = "router"\n'
+            f'gossip_port = {router_gossip}\n'
+            f'peers = {json.dumps([f"127.0.0.1:{p}" for p in gossip_ports])}\n'
+            f'gossip_interval_s = {gossip_interval}\n'
+            f'record_ttl_s = {ttl_s}\n'
+            f'rollout_writer = true\n'
+            f'rollout_state_file = "{os.path.join(tmp, "rollout.json")}"\n'
+        )
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+
+    def _log_tails(note: str) -> None:
+        print(f"# fleet soak FAILED: {note}", file=sys.stderr)
+        for name in sorted(os.listdir(tmp)):
+            if name.endswith(".log"):
+                with open(os.path.join(tmp, name), "rb") as f:
+                    tail = f.read()[-4000:].decode("utf-8", "replace")
+                print(f"# ---- {name} tail ----\n{tail}", file=sys.stderr)
+
+    def spawn_replica(i: int) -> subprocess.Popen:
+        lf = open(os.path.join(tmp, f"replica{i}.log"), "ab")
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_tf_serving_tpu.serving.server",
+             "--config", os.path.join(tmp, f"replica{i}.toml"),
+             "--model-base-path", base,
+             "--rest-port", str(rest_ports[i])],
+            stdout=lf, stderr=lf, env=env, cwd=repo_root,
+        )
+
+    def wait_serving(addr: str, proc, timeout: float) -> None:
+        deadline = time.time() + timeout
+        last = "<no attempt>"
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"server {addr} exited rc={proc.returncode}"
+                )
+            # Fresh channel per attempt: a channel created before the
+            # server listens can sit out a reconnect backoff long after
+            # the port is up; boot-time probing wants the connect NOW.
+            ch = grpc.insecure_channel(addr)
+            stub = health_proto.HealthStub(ch)
+            try:
+                resp = stub.Check(
+                    health_proto.HealthCheckRequest(""), timeout=1.0
+                )
+                last = f"status={resp.status}"
+                if resp.status == health_proto.SERVING:
+                    return
+            except grpc.RpcError as e:
+                last = f"{e.code()} {e.details()!r}"
+            finally:
+                ch.close()
+            time.sleep(0.3)
+        raise RuntimeError(
+            f"server {addr} not SERVING in {timeout}s (last: {last})"
+        )
+
+    def http_json(url: str, payload=None, timeout: float = 3.0):
+        data = (
+            json.dumps(payload).encode("utf-8")
+            if payload is not None else None
+        )
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def http_text(url: str, timeout: float = 3.0) -> str:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode("utf-8")
+
+    def router_fleetz() -> dict:
+        return http_json(f"http://127.0.0.1:{router_gossip}/fleetz")
+
+    def poll_until(fn, timeout: float, what: str, poll_s: float = 0.05):
+        """fn() -> truthy value | falsy; returns (value, elapsed_s)."""
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            try:
+                v = fn()
+            except Exception:  # noqa: BLE001 — surfaces settle async
+                v = None
+            if v:
+                return v, round(time.time() - t0, 3)
+            time.sleep(poll_s)
+        raise RuntimeError(f"timed out ({timeout}s) waiting for {what}")
+
+    # Traffic runs on its own thread + event loop for the whole scenario;
+    # the main thread drives the chaos script.
+    events: list = []  # (wall_t, ok, error_repr)
+    stop_traffic = threading.Event()
+    payloads = [make_payload(candidates, fields, seed=s) for s in range(8)]
+
+    def traffic_thread() -> None:
+        async def run() -> None:
+            edge = ShardedPredictClient(
+                [router_addr], "DCN", timeout_s=5.0, failover_attempts=1,
+                backoff_initial_s=0.02,
+            )
+
+            async def worker(wid: int) -> None:
+                n = 0
+                while not stop_traffic.is_set():
+                    n += 1
+                    try:
+                        await edge.predict(payloads[(wid + n) % len(payloads)])
+                        events.append((time.time(), True, ""))
+                    except Exception as e:  # noqa: BLE001 — counted, gated
+                        events.append((time.time(), False, repr(e)[:200]))
+                    await asyncio.sleep(0.02)
+
+            await asyncio.gather(*(worker(w) for w in range(workers)))
+            await edge.close()
+
+        asyncio.run(run())
+
+    def probe_bit_identity() -> bool:
+        """The same payload through the router and direct to one backend
+        must score bit-identically (the router re-encodes through the
+        same codec; affinity sub-batching must not perturb scores)."""
+        async def run():
+            probe = make_payload(candidates, fields, seed=99)
+            edge = ShardedPredictClient([router_addr], "DCN", timeout_s=10.0)
+            direct = ShardedPredictClient(
+                [backend_addrs[0]], "DCN", timeout_s=10.0
+            )
+            try:
+                via_router = await edge.predict(probe)
+                direct_hit = await direct.predict(probe)
+            finally:
+                await edge.close()
+                await direct.close()
+            return via_router, direct_hit
+
+        a, b = asyncio.run(run())
+        return bool(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        )
+
+    procs: list = []
+    router_proc = None
+    rfd = None
+    traffic = None
+    try:
+        # ---- boot the fleet -------------------------------------------
+        procs = [spawn_replica(i) for i in range(replicas)]
+        for i in range(replicas):
+            wait_serving(backend_addrs[i], procs[i], 120.0)
+        rfd, wfd = os.pipe()
+        router_log = open(os.path.join(tmp, "router.log"), "ab")
+        router_proc = subprocess.Popen(
+            [sys.executable, "-m", "distributed_tf_serving_tpu.fleet.router",
+             "--config", router_toml, "--ready-fd", str(wfd)],
+            stdout=router_log, stderr=router_log, env=env, cwd=repo_root,
+            pass_fds=(wfd,),
+        )
+        os.close(wfd)
+        import select
+
+        ready_raw = b""
+        deadline = time.time() + 60.0
+        while b"\n" not in ready_raw and time.time() < deadline:
+            if router_proc.poll() is not None:
+                raise RuntimeError(
+                    f"router exited rc={router_proc.returncode}"
+                )
+            r, _, _ = select.select([rfd], [], [], 0.5)
+            if r:
+                chunk = os.read(rfd, 4096)
+                if not chunk:
+                    break
+                ready_raw += chunk
+        if b"\n" not in ready_raw:
+            raise RuntimeError("router never wrote its readiness line")
+        ready = json.loads(ready_raw.decode("utf-8").splitlines()[0])
+        # Membership converges through the star: router sees everyone.
+        _, converge_s = poll_until(
+            lambda: router_fleetz()["gossip"]["member_count"]
+            >= replicas + 1,
+            timeout=30.0, what="gossip membership convergence",
+        )
+
+        # ---- steady traffic + reference probe -------------------------
+        traffic = threading.Thread(target=traffic_thread, daemon=True)
+        traffic_start = time.time()
+        traffic.start()
+        steady_s = max(seconds * 0.25, 3.0)
+        time.sleep(steady_s)
+        bit_identical_pre = probe_bit_identity()
+
+        # ---- chaos: SIGKILL one replica mid-traffic -------------------
+        victim = 1 % replicas
+        procs[victim].kill()
+        procs[victim].wait()
+        kill_t = time.time()
+        time.sleep(max(seconds * 0.15, 2.0))
+
+        # ---- restart it: rejoin is a gossip event, measured -----------
+        procs[victim] = spawn_replica(victim)
+        restart_t = time.time()
+        wait_serving(backend_addrs[victim], procs[victim], 120.0)
+
+        def rejoined():
+            fz = router_fleetz()
+            members = fz.get("gossip", {}).get("members", {})
+            rec = members.get(backend_addrs[victim])
+            return (
+                fz
+                if rec is not None and rec.get("state") == "serving"
+                and fz.get("healthy_backends") == replicas
+                else None
+            )
+
+        fz_rejoin, rejoin_poll_s = poll_until(rejoined, 60.0, "fleet rejoin")
+        rejoin_s = round(time.time() - restart_t, 3)
+
+        # ---- canary publish into the SHARED base dir ------------------
+        # (After the rejoin on purpose: a replica booting onto a dir that
+        # already holds the canary adopts LATEST as stable — the fleet
+        # could then never blacklist it out. Same params as v1, so the
+        # closing bit-identity probe holds straight through the ramp.)
+        servable2 = Servable(
+            name="DCN", version=2, model=model, params=params,
+            signatures=ctr_signatures(fields),
+        )
+        save_servable(os.path.join(base, "2"), servable2, kind="dcn_v2")
+        publish_t = time.time()
+        for i in range(replicas):
+            poll_until(
+                lambda i=i: http_json(
+                    f"http://127.0.0.1:{rest_ports[i]}/lifecyclez"
+                ).get("canary_version") == 2,
+                timeout=30.0, what=f"replica {i} canary live",
+            )
+        canary_live_s = round(time.time() - publish_t, 3)
+
+        # ---- fleet-coordinated rollback -------------------------------
+        # One replica's operator rollback; the router's coordinator must
+        # blacklist v2 for the WHOLE fleet within ~a gossip interval.
+        def post_rollback():
+            try:
+                return http_json(
+                    f"http://127.0.0.1:{rest_ports[0]}/lifecyclez/rollback",
+                    {"reason": "fleet-soak-chaos"},
+                )
+            except urllib.error.HTTPError:
+                return None  # 409: canary not live yet — retried
+
+        rollback_resp, _ = poll_until(
+            post_rollback, 20.0, "operator rollback accepted"
+        )
+        rollback_post_t = time.time()
+        _, router_blacklist_s = poll_until(
+            lambda: 2 in (
+                router_fleetz().get("rollout", {})
+                .get("state", {}).get("blacklist", [])
+            ),
+            timeout=15.0, what="router fleet blacklist",
+        )
+        router_blacklist_t = time.time()
+
+        def all_rolled_back():
+            states = [
+                http_json(f"http://127.0.0.1:{rest_ports[i]}/lifecyclez")
+                for i in range(replicas)
+            ]
+            return (
+                states
+                if all(s.get("rolled_back_version") == 2 for s in states)
+                else None
+            )
+
+        lifecycle_states, propagation_s = poll_until(
+            all_rolled_back, 15.0, "fleet-wide rollback"
+        )
+        post_to_all_s = round(time.time() - rollback_post_t, 3)
+
+        # ---- post-chaos traffic + closing probe -----------------------
+        time.sleep(max(seconds * 0.2, 3.0))
+        stop_traffic.set()
+        traffic.join(timeout=15.0)
+        traffic_stop = time.time()
+        bit_identical_post = probe_bit_identity()
+
+        fz_final = router_fleetz()
+        router_prom = http_text(
+            f"http://127.0.0.1:{router_gossip}/metrics"
+        )
+        replica_prom = http_text(
+            f"http://127.0.0.1:{rest_ports[0]}"
+            f"/monitoring/prometheus/metrics"
+        )
+
+        # ---- goodput windows ------------------------------------------
+        ok_times = sorted(t for t, ok, _ in events if ok)
+        errors = [e for _, ok, e in events if not ok]
+
+        from bisect import bisect_left as _bisect_left
+
+        def windows(t0: float, t1: float) -> list:
+            out, w = [], t0
+            while w + 1.0 <= t1:
+                lo = _bisect_left(ok_times, w)
+                hi = _bisect_left(ok_times, w + 1.0)
+                out.append(hi - lo)
+                w += 1.0
+            return out
+
+        steady_windows = windows(traffic_start + 1.0, kill_t - 0.2)
+        # The goodput gate covers the KILL/RESTART phase only: from the
+        # SIGKILL until the canary publish. The rollout phase that follows
+        # dips for a different, expected reason — every replica
+        # orbax-restores and warmup-compiles v2 at once, and on a CPU host
+        # three concurrent compile ladders starve the serving threads.
+        # That phase is gated on zero errors + bounded propagation instead;
+        # its windows are reported separately for eyeballing.
+        chaos_windows = windows(kill_t, publish_t - 0.2)
+        rollout_windows = windows(publish_t, traffic_stop - 0.2)
+        steady_median = (
+            sorted(steady_windows)[len(steady_windows) // 2]
+            if steady_windows else 0
+        )
+        min_ratio = (
+            round(min(chaos_windows) / steady_median, 3)
+            if chaos_windows and steady_median else None
+        )
+
+        taxonomy: dict = {}
+        for e in errors:
+            taxonomy[e] = taxonomy.get(e, 0) + 1
+
+        line = {
+            "mode": "fleet",
+            "seconds": seconds,
+            "wall_s": round(time.time() - t_start, 1),
+            "rss_gb": {"start": start_rss, "end": rss_gb()},
+            "fleet": {
+                "replicas": replicas,
+                "router": ready,
+                "gossip_interval_s": gossip_interval,
+                "converge_s": converge_s,
+                "requests": len(events),
+                "ok": len(ok_times),
+                "errors": len(errors),
+                "error_taxonomy": dict(list(taxonomy.items())[:5]),
+                "steady_window_median": steady_median,
+                "steady_windows": steady_windows,
+                "chaos_windows": chaos_windows,
+                "rollout_windows": rollout_windows,
+                "min_chaos_window_ratio": min_ratio,
+                "bit_identical_pre": bit_identical_pre,
+                "bit_identical_post": bit_identical_post,
+                "kill": {
+                    "victim": backend_addrs[victim],
+                    "rejoin_s": rejoin_s,
+                    "rejoin_poll_s": rejoin_poll_s,
+                    "healthy_backends": fz_rejoin.get("healthy_backends"),
+                },
+                "rollout": {
+                    "canary_version": 2,
+                    "canary_live_s": canary_live_s,
+                    "rollback_origin": backend_addrs[0],
+                    "rollback_accepted": bool(
+                        rollback_resp.get("rolled_back")
+                    ),
+                    "router_blacklist_s": router_blacklist_s,
+                    "propagation_s": propagation_s,
+                    "post_to_all_s": post_to_all_s,
+                    "per_replica_rolled_back": [
+                        s.get("rolled_back_version")
+                        for s in lifecycle_states
+                    ],
+                },
+                "router_counters": fz_final.get("counters", {}),
+                "router_healthy_backends": fz_final.get(
+                    "healthy_backends"
+                ),
+                "prom_router_series": sum(
+                    1 for ln in router_prom.splitlines()
+                    if ln.startswith("dts_tpu_fleet_")
+                ),
+                "prom_replica_series": sum(
+                    1 for ln in replica_prom.splitlines()
+                    if ln.startswith("dts_tpu_fleet_")
+                ),
+            },
+        }
+        print(json.dumps(line))
+    except BaseException as e:
+        _log_tails(repr(e))
+        raise
+    finally:
+        stop_traffic.set()
+        if traffic is not None and traffic.is_alive():
+            traffic.join(timeout=10.0)
+        if rfd is not None:
+            with contextlib.suppress(OSError):
+                os.close(rfd)
+        for p in [router_proc, *procs]:
+            if p is not None and p.poll() is None:
+                with contextlib.suppress(OSError):
+                    p.terminate()
+        deadline = time.time() + 15.0
+        for p in [router_proc, *procs]:
+            if p is None:
+                continue
+            with contextlib.suppress(Exception):
+                p.wait(timeout=max(deadline - time.time(), 0.1))
+            if p.poll() is None:
+                with contextlib.suppress(OSError):
+                    p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
+    if os.environ.get("SOAK_FLEET", "0") == "1":
+        _fleet_soak(float(os.environ.get("SOAK_SECONDS", "30")))
+        return
+
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
