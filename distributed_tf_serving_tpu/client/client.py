@@ -170,6 +170,10 @@ _HEALTH_REASON_KEY = "x-dts-health-reason"
 # storm-suppression intent survives the hop.
 _RETRY_BUDGET_KEY = "x-dts-retry-budget"
 
+# Initial-metadata key traced servers answer with so client.rpc spans can
+# label the resolved peer (router vs replica) — ISSUE 18 satellite.
+_PEER_ROLE_KEY = "x-dts-peer-role"
+
 
 # Per-request override channel (ISSUE 17): the fleet router serves many
 # edge requests through ONE embedded ShardedPredictClient, and each
@@ -512,6 +516,10 @@ class ShardedPredictClient:
         # without the plane ignore the metadata and answer normally.
         self.score_wire_int8 = bool(score_wire_int8)
         self._first_score_ms: list[float] = []
+        # Per-backend rolling latency windows (ISSUE 18: the router's
+        # /monitoring parity surface). None until enable_backend_windows
+        # — the hot path pays one attribute read when disabled.
+        self._backend_windows: dict[str, "object"] | None = None
         self.counters = ResilienceCounters()
         self._health_stubs: list[object | None] = [None] * len(self.hosts)
         # Long-lived plaintext channels per host, created once and shared
@@ -572,9 +580,11 @@ class ShardedPredictClient:
         embedded client). Contextvar-scoped: every shard/hedge task of
         the wrapped call inherits the values; concurrent requests on the
         same client see only their own. None = keep the client-level
-        attribute. `traceparent` is only attached when tracing is not
-        already supplying a span of its own (a live span's id wins — it
-        joined the inbound trace at start_root)."""
+        attribute. With tracing on, `traceparent` remote-parents the
+        wrapped call's `client.predict` root (the router's embedded
+        client joins the `router.route` trace, ISSUE 18); with tracing
+        off it forwards verbatim on the wire, so a router hop never
+        breaks the edge's trace either way."""
         return _OverrideScope({
             "criticality": criticality,
             "timeout_s": timeout_s,
@@ -657,7 +667,23 @@ class ShardedPredictClient:
                 # i of request r lands on channel (r + i) % k: consecutive
                 # requests stripe every host's channels even when the shard
                 # count divides k.
-                resp = await invoke(stubs[(rr + i) % len(stubs)], metadata)
+                call = invoke(stubs[(rr + i) % len(stubs)], metadata)
+                resp = await call
+                if span is not None:
+                    # Peer-role attribution (ISSUE 18 satellite): traced
+                    # servers stamp x-dts-peer-role on their INITIAL
+                    # metadata, so stitched trees label each hop
+                    # router/replica without guessing from ports. The
+                    # streamed invoke is a plain coroutine (no call
+                    # object) — getattr-guarded, advisory only.
+                    get_initial = getattr(call, "initial_metadata", None)
+                    if get_initial is not None:
+                        try:
+                            for k, v in (await get_initial()) or ():
+                                if k == _PEER_ROLE_KEY and isinstance(v, str):
+                                    span.attrs["peer.role"] = v
+                        except Exception:  # noqa: BLE001
+                            pass
             except asyncio.CancelledError:
                 if self.scoreboard is not None:
                     # The attempt resolved neither way: free any half-open
@@ -748,8 +774,11 @@ class ShardedPredictClient:
                 raise _ShardAttemptError(
                     host_idx, code, e.details(), retry_after_ms=retry_after_ms
                 ) from e
+            elapsed = time.perf_counter() - t0
             if self.scoreboard is not None:
-                self.scoreboard.record_success(host_idx, time.perf_counter() - t0)
+                self.scoreboard.record_success(host_idx, elapsed)
+            if self._backend_windows is not None:
+                self._backend_windows[host].record(elapsed)
             return resp
 
     def _hedge_target(self, used: list[int]) -> int | None:
@@ -1095,6 +1124,23 @@ class ShardedPredictClient:
             self.hosts[last.host_idx], last.code, last.details
         ) from last
 
+    def enable_backend_windows(self, window_s: float = 60.0) -> None:
+        """Arm per-backend rolling latency windows: every successful RPC
+        records into its host's WindowedLatency (the fleet router turns
+        this on so its /monitoring can show per-replica latency AS
+        STEERED — hedges and failovers land on the host that answered)."""
+        from ..utils.metrics import WindowedLatency
+
+        self._backend_windows = {
+            h: WindowedLatency(window_s=window_s) for h in self.hosts
+        }
+
+    def backend_window_snapshots(self) -> dict:
+        """Per-backend window snapshots ({} until enabled)."""
+        if self._backend_windows is None:
+            return {}
+        return {h: w.snapshot() for h, w in self._backend_windows.items()}
+
     def resilience_counters(self) -> dict:
         """Client-side resilience events + per-backend scoreboard state —
         the block bench.py and tools/soak.py report."""
@@ -1290,6 +1336,7 @@ class ShardedPredictClient:
         )
         with tracing.start_root(
             "client.predict",
+            traceparent=self._override("traceparent"),
             attrs={"model": self.model_name, "candidates": n,
                    "shards": len(shards)},
         ):
@@ -1327,6 +1374,7 @@ class ShardedPredictClient:
         n = next(iter(arrays.values())).shape[0]
         with tracing.start_root(
             "client.predict",
+            traceparent=self._override("traceparent"),
             attrs={"model": self.model_name, "candidates": n,
                    "shards": len(groups), "placement": "affinity"},
         ):
@@ -1493,6 +1541,7 @@ class ShardedPredictClient:
             groups = affinity_groups(arrays, len(self.hosts))
             with tracing.start_root(
                 "client.predict",
+                traceparent=self._override("traceparent"),
                 attrs={"model": self.model_name, "candidates": n,
                        "shards": len(groups), "streamed": True,
                        "placement": "affinity"},
@@ -1512,6 +1561,7 @@ class ShardedPredictClient:
         )
         with tracing.start_root(
             "client.predict",
+            traceparent=self._override("traceparent"),
             attrs={"model": self.model_name, "candidates": n,
                    "shards": len(shards), "streamed": True},
         ):
@@ -1580,6 +1630,7 @@ class ShardedPredictClient:
         if prep.homes is not None:
             with tracing.start_root(
                 "client.predict",
+                traceparent=self._override("traceparent"),
                 attrs={"model": self.model_name,
                        "candidates": prep.candidates,
                        "shards": len(prep.shard_blobs), "prepared": True,
@@ -1601,6 +1652,7 @@ class ShardedPredictClient:
         )
         with tracing.start_root(
             "client.predict",
+            traceparent=self._override("traceparent"),
             attrs={"model": self.model_name, "candidates": prep.candidates,
                    "shards": len(prep.shard_blobs), "prepared": True},
         ):

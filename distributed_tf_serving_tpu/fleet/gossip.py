@@ -68,6 +68,12 @@ class HealthRecord:
     canary_fraction: float = 0.0
     rolled_back: int | None = None
     rollout: dict | None = None
+    # Cheap observability digest (ISSUE 18): {"addr": gossip listen addr,
+    # "qps", "p50_ms", "p99_ms", "requests", "errors", "trace_export"} —
+    # the router's fleet aggregator falls back to these self-reported
+    # numbers when a member's /monitoring scrape fails, and learns where
+    # (and whether) to pull the member's span-tree export.
+    obs: dict | None = None
     wall_ts: float = 0.0
 
     def to_dict(self) -> dict:
@@ -139,6 +145,10 @@ class GossipAgent:
     rollout follower applies coordinator state). `extra_routes` maps GET
     paths to zero-arg callables returning a JSON-able body — the router
     mounts /metrics there so one port serves gossip and scrape.
+    `query_routes` is the same for routes that take URL query parameters
+    (called with a {key: first value} dict — the trace-export pull's
+    `?since=` cursor), and `post_routes` maps POST paths to callables
+    taking the decoded JSON body (the router's /tracez/ingest push).
     """
 
     def __init__(
@@ -155,6 +165,8 @@ class GossipAgent:
         record_fn=None,
         on_update=None,
         extra_routes: dict | None = None,
+        query_routes: dict | None = None,
+        post_routes: dict | None = None,
         clock=time.time,
         seq_fn=time.time_ns,
         dial_timeout_s: float = 2.0,
@@ -167,6 +179,8 @@ class GossipAgent:
         self.record_fn = record_fn or (lambda: {})
         self.on_update = on_update
         self.extra_routes = dict(extra_routes or {})
+        self.query_routes = dict(query_routes or {})
+        self.post_routes = dict(post_routes or {})
         self._clock = clock
         self._seq = seq_fn
         self._dial_timeout_s = dial_timeout_s
@@ -318,26 +332,55 @@ class GossipAgent:
                 self.wfile.write(body)
 
             def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
-                if self.path != "/gossip":
-                    self._json(404, {"error": "not found"})
-                    return
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    agent.merge(payload.get("records"))
-                except (ValueError, KeyError):
-                    self._json(400, {"error": "bad gossip payload"})
+                except ValueError:
+                    self._json(400, {"error": "bad payload"})
                     return
-                self._json(200, agent.wire_view())
+                if self.path == "/gossip":
+                    try:
+                        agent.merge(payload.get("records"))
+                    except (ValueError, KeyError, AttributeError):
+                        self._json(400, {"error": "bad gossip payload"})
+                        return
+                    self._json(200, agent.wire_view())
+                    return
+                route = agent.post_routes.get(self.path)
+                if route is None:
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    self._json(200, route(payload) or {})
+                except Exception:  # noqa: BLE001 — a sick route must not
+                    log.exception("gossip post route %s failed", self.path)
+                    self._json(500, {"error": "route failed"})
 
             def do_GET(self):  # noqa: N802
                 # Extra routes first: the router overrides /fleetz with
-                # its richer fleet snapshot on the same port.
-                route = agent.extra_routes.get(self.path)
-                if route is None and self.path == "/gossip":
+                # its richer fleet snapshot on the same port. Query
+                # strings are split off so `/route?k=v` matches the
+                # `/route` key; query_routes receive the parsed params.
+                path, _, qs = self.path.partition("?")
+                route = agent.query_routes.get(path)
+                if route is not None:
+                    import urllib.parse
+
+                    query = {
+                        k: v[0]
+                        for k, v in urllib.parse.parse_qs(qs).items()
+                    }
+                    try:
+                        self._json(200, route(query))
+                    except Exception:  # noqa: BLE001
+                        log.exception("gossip query route %s failed", path)
+                        self._json(500, {"error": "route failed"})
+                    return
+                route = agent.extra_routes.get(path)
+                if route is None and path == "/gossip":
                     self._json(200, agent.wire_view())
                     return
-                if route is None and self.path == "/fleetz":
+                if route is None and path == "/fleetz":
                     self._json(200, agent.snapshot())
                     return
                 if route is not None:

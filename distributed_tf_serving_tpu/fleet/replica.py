@@ -22,7 +22,10 @@ class ReplicaFleetPlane:
     state arriving in ANY peer's record (the router's, usually) applies
     to `lifecycle` through a RolloutFollower exactly once per seq."""
 
-    def __init__(self, cfg, *, record_fn, lifecycle=None, clock=time.time):
+    def __init__(
+        self, cfg, *, record_fn, lifecycle=None, clock=time.time,
+        extra_routes=None, query_routes=None, post_routes=None,
+    ):
         self.config = cfg
         self_id = cfg.self_id or cfg.advertise_addr
         self.follower = (
@@ -40,6 +43,12 @@ class ReplicaFleetPlane:
             ttl_s=cfg.record_ttl_s,
             record_fn=record_fn,
             on_update=self._on_update,
+            # ISSUE 18: the serving build mounts its /monitoring wire +
+            # /tracez/export surfaces on the gossip port so the router's
+            # aggregator scrapes members without touching the REST tier.
+            extra_routes=extra_routes,
+            query_routes=query_routes,
+            post_routes=post_routes,
             clock=clock,
         )
 

@@ -66,9 +66,12 @@ from ..proto.service_grpc import (
     LARGE_MESSAGE_CHANNEL_OPTIONS,
     add_PredictionServiceServicer_to_server,
 )
+from ..utils import tracing
 from ..utils.config import load_config
+from ..utils.metrics import WindowedLatency
 from . import gossip as gossip_mod
 from .gossip import GossipAgent
+from .observability import FleetObservabilityPlane
 from .rollout import RolloutCoordinator
 
 log = logging.getLogger("dts_tpu.fleet.router")
@@ -76,6 +79,7 @@ log = logging.getLogger("dts_tpu.fleet.router")
 _CRITICALITY_KEY = "x-dts-criticality"
 _RETRY_BUDGET_KEY = "x-dts-retry-budget"
 _DEGRADED_KEY = "x-dts-degraded"
+_PEER_ROLE_KEY = "x-dts-peer-role"
 
 
 def _metadata_of(context) -> dict[str, str]:
@@ -100,18 +104,50 @@ class Router:
     def __init__(self, cfgs: dict, *, clock=time.time):
         self.client = client_from_config(cfgs["client"])
         self.fleet_cfg = cfgs.get("fleet")
+        self.obs_cfg = cfgs.get("observability")
+        self.slo_cfg = cfgs.get("slo")
         self._clock = clock
+        # Router-side rolling latency window (always on — the /monitoring
+        # parity surface needs "what is the router doing NOW" even with
+        # tracing off; one histogram record per forward).
+        self.window = WindowedLatency(
+            window_s=(
+                self.obs_cfg.window_seconds
+                if self.obs_cfg is not None else 60.0
+            )
+        )
+        # Per-backend windows on the embedded client (the /monitoring
+        # parity satellite: windowed latency per replica as steered).
+        self.client.enable_backend_windows(self.window.window_s)
         # Gossip record id -> backend index in the client's host list.
         # Convention: a replica's [fleet] self_id is its SERVING address
         # exactly as the router's [client] hosts lists it.
         self._backend_idx = {h: i for i, h in enumerate(self.client.hosts)}
         self.coordinator: RolloutCoordinator | None = None
         self.gossip: GossipAgent | None = None
+        self.plane: FleetObservabilityPlane | None = None
         if self.fleet_cfg is not None and self.fleet_cfg.enabled:
             if self.fleet_cfg.rollout_writer:
                 self.coordinator = RolloutCoordinator(
                     self.fleet_cfg.rollout_state_file, clock=clock
                 )
+            # The aggregation half (ISSUE 18): member scrape + trace
+            # stitch + SLO burn. Created with gossip — member discovery
+            # rides the gossip view — and ticked by its own daemon thread
+            # once run_router starts it.
+            self.plane = FleetObservabilityPlane(
+                members_fn=self._members,
+                self_source=self.fleet_cfg.self_id or "router",
+                local_export=(
+                    lambda since: tracing.recorder().export_since(since)
+                ),
+                slo_cfg=self.slo_cfg,
+                interval_s=(
+                    self.obs_cfg.trace_export_interval_s
+                    if self.obs_cfg is not None else 1.0
+                ),
+                clock=clock,
+            )
             self.gossip = GossipAgent(
                 self.fleet_cfg.self_id or "router",
                 role="router",
@@ -126,6 +162,16 @@ class Router:
                 extra_routes={
                     "/fleetz": self.fleetz,
                     "/metrics": self.prometheus_text,
+                    "/monitoring": self.monitoring,
+                    "/fleet/monitoring": self.plane.aggregate_snapshot,
+                    "/sloz": self.plane.slo_snapshot,
+                },
+                query_routes={
+                    "/tracez": self._tracez_route,
+                    "/tracez/export": self._trace_export_route,
+                },
+                post_routes={
+                    "/tracez/ingest": self.plane.ingest_push,
                 },
                 clock=clock,
             )
@@ -138,6 +184,40 @@ class Router:
         self.watch_updates = 0
         self._started_t = clock()
         self._watch_tasks: list[asyncio.Task] = []
+
+    # ------------------------------------------------------ observability
+
+    def _members(self) -> dict:
+        return (
+            self.gossip.view(include_self=False)
+            if self.gossip is not None else {}
+        )
+
+    def _tracez_route(self, query: dict):
+        """GET /tracez on the router's gossip port: the STITCHED
+        cross-process view (json default; ?format=chrome for the
+        multi-pid Perfetto export)."""
+        if not tracing.enabled() or self.plane is None:
+            return {"enabled": False, "traces": []}
+        limit = 50
+        try:
+            limit = max(1, int(query.get("limit", limit)))
+        except (TypeError, ValueError):
+            pass
+        if query.get("format") == "chrome":
+            return self.plane.collector.chrome_trace(limit)
+        return self.plane.collector.tracez(limit)
+
+    def _trace_export_route(self, query: dict) -> dict:
+        """GET /tracez/export on the router: the router's OWN local span
+        trees (a higher-tier collector could stitch routers too)."""
+        if not tracing.enabled():
+            return {"enabled": False, "cursor": 0, "spans": []}
+        try:
+            since = int(query.get("since", 0) or 0)
+        except (TypeError, ValueError):
+            since = 0
+        return tracing.recorder().export_since(since)
 
     # ------------------------------------------------------------- gossip
 
@@ -266,13 +346,65 @@ class Router:
         except ValueError:
             budget = None
         self.requests += 1
-        with self.client.request_overrides(
-            criticality=md.get(_CRITICALITY_KEY),
-            timeout_s=_deadline_of(context),
-            traceparent=md.get("traceparent"),
-            max_attempts_total=budget,
-        ):
-            result = await self.client.predict(arrays)
+        # Root router span (ISSUE 18): adopts the edge's traceparent, so
+        # the edge client / router / replica trees share one trace id; the
+        # embedded client re-roots ITS spans under this one (the override
+        # traceparent below), so per-attempt/hedge `client.rpc` children
+        # stitch in as grandchildren. One enabled() read when tracing is
+        # off — the disabled path is the pre-ISSUE code shape.
+        span_cm = (
+            tracing.start_root(
+                "router.route",
+                traceparent=md.get("traceparent"),
+                attrs={
+                    "backends": len(self.client.hosts),
+                    "healthy_backends": self.healthy_backends(),
+                    "criticality": md.get(_CRITICALITY_KEY) or "default",
+                },
+            )
+            if tracing.enabled() else None
+        )
+        if span_cm is not None:
+            # Peer-role attribution for the EDGE's client.rpc span
+            # (ISSUE 18 satellite): answered on initial metadata —
+            # trailing metadata already carries the degraded marker.
+            try:
+                await context.send_initial_metadata(
+                    ((_PEER_ROLE_KEY, "router"),)
+                )
+            except Exception:  # noqa: BLE001 — advisory only
+                pass
+        t0 = time.perf_counter()
+        try:
+            if span_cm is None:
+                with self.client.request_overrides(
+                    criticality=md.get(_CRITICALITY_KEY),
+                    timeout_s=_deadline_of(context),
+                    traceparent=md.get("traceparent"),
+                    max_attempts_total=budget,
+                ):
+                    result = await self.client.predict(arrays)
+            else:
+                with span_cm as span:
+                    with self.client.request_overrides(
+                        criticality=md.get(_CRITICALITY_KEY),
+                        timeout_s=_deadline_of(context),
+                        traceparent=tracing.make_traceparent(
+                            span.trace_id, span.span_id
+                        ),
+                        max_attempts_total=budget,
+                    ):
+                        result = await self.client.predict(arrays)
+                    if self.plane is not None and self.plane.slo_breached:
+                        # Burn-rate breach in progress: mark the span so
+                        # the tail sampler force-keeps it — the traces
+                        # that EXPLAIN the breach survive sampling.
+                        span.annotate(
+                            "slo.burn",
+                            breaches=self.plane.slo.breaches,
+                        )
+        finally:
+            self.window.record(time.perf_counter() - t0)
         if isinstance(result, PredictResult):
             if result.degraded:
                 self.degraded += 1
@@ -326,6 +458,41 @@ class Router:
             out["rollout"] = self.coordinator.snapshot()
         return out
 
+    def monitoring(self) -> dict:
+        """GET /monitoring parity for the router (ISSUE 18 satellite):
+        the steering scoreboard, per-backend windowed latency, and
+        gossip/rollout counters in ONE JSON — the replica's /monitoring
+        sibling, so fleet dashboards scrape both roles the same way."""
+        resilience = self.client.resilience_counters()
+        out = {
+            "role": "router",
+            "model": self.client.model_name,
+            "uptime_s": round(self._clock() - self._started_t, 3),
+            "window": self.window.snapshot(),
+            "counters": {
+                "requests": self.requests,
+                "errors": self.errors,
+                "degraded": self.degraded,
+                "gossip_steers": self.gossip_steers,
+                "gossip_rejoins": self.gossip_rejoins,
+                "watch_updates": self.watch_updates,
+            },
+            "healthy_backends": self.healthy_backends(),
+            "scoreboard": resilience.get("scoreboard"),
+            "backend_windows": self.client.backend_window_snapshots(),
+            "resilience": resilience,
+        }
+        if self.gossip is not None:
+            out["gossip"] = self.gossip.snapshot()
+        if self.coordinator is not None:
+            out["rollout"] = self.coordinator.snapshot()
+        if self.plane is not None:
+            out["fleet_aggregate"] = self.plane.agg_block()
+            slo = self.plane.slo_block()
+            if slo is not None:
+                out["slo"] = slo
+        return out
+
     def fleet_stats(self) -> dict:
         """The shape utils.metrics._fleet_prometheus_lines consumes (the
         replica side builds the same shape in service.fleet_stats)."""
@@ -346,6 +513,13 @@ class Router:
             stats["gossip"] = self.gossip.snapshot()
         if self.coordinator is not None:
             stats["rollout"] = self.coordinator.snapshot()
+        if self.plane is not None:
+            agg = self.plane.agg_block()
+            if agg:
+                stats["agg"] = agg
+            slo = self.plane.slo_block()
+            if slo is not None:
+                stats["slo"] = slo
         return stats
 
     def prometheus_text(self) -> str:
@@ -518,6 +692,11 @@ async def run_router(
 ) -> None:
     """Build and serve a router until cancelled/SIGTERM. `ready_cb(port,
     router)` fires after bind (tests + the soak's readiness line)."""
+    obs = cfgs.get("observability")
+    if obs is not None:
+        # Same process-level arming the replica server does: enables the
+        # router's own span plane when [observability] tracing=true.
+        obs.apply()
     router = Router(cfgs)
     server = grpc.aio.server(
         options=list(LARGE_MESSAGE_CHANNEL_OPTIONS)
@@ -552,6 +731,8 @@ async def run_router(
     await server.start()
     if router.gossip is not None:
         router.gossip.start()
+    if router.plane is not None:
+        router.plane.start()
     await router.watch_backends()
     log.info(
         "fleet router up on %s:%d -> %d backends%s", bind_host, bound,
@@ -571,6 +752,8 @@ async def run_router(
         await stop_evt.wait()
     finally:
         router.stop_watchers()
+        if router.plane is not None:
+            router.plane.stop()
         if router.gossip is not None:
             router.gossip.stop()
         await server.stop(grace=2.0)

@@ -164,6 +164,10 @@ class RestGateway:
             # ?format=chrome exports Perfetto-loadable trace-event JSON).
             web.get("/monitoring", self.monitoring),
             web.get("/tracez", self.tracez),
+            # Fleet trace export (ISSUE 18): incremental kept-span pull
+            # for a router-side TraceCollector (also mounted on the
+            # gossip port when the fleet plane is armed).
+            web.get("/tracez/export", self.tracez_export),
             # Cache plane (ISSUE 4): per-model hit/miss/coalesced/eviction
             # counters + occupancy/config, and the operator flush control.
             web.get("/cachez", self.cachez),
@@ -691,6 +695,23 @@ class RestGateway:
         body = rec.tracez(limit=limit)
         body["enabled"] = tracing.enabled()
         return web.json_response(body, dumps=dumps)
+
+    async def tracez_export(self, request: web.Request) -> web.Response:
+        """GET /tracez/export?since=CURSOR: kept span trees after the
+        cursor, with this process's clock anchor (the fleet stitcher's
+        pull surface). `{"enabled": false}` while tracing is off."""
+        if not tracing.enabled():
+            return web.json_response(
+                {"enabled": False, "cursor": 0, "spans": []}
+            )
+        try:
+            since = int(request.query.get("since", "0") or 0)
+        except ValueError:
+            return _json_error("INVALID_ARGUMENT", "since must be an integer")
+        return web.json_response(
+            tracing.recorder().export_since(since),
+            dumps=lambda obj: json.dumps(obj, default=str),
+        )
 
     async def utilz(self, request: web.Request) -> web.Response:
         """GET /utilz[?window=S]: the utilization-attribution surface —

@@ -144,6 +144,23 @@ def _push_overload_metadata(context, exc: ServiceError | None) -> None:
         pass
 
 
+# Initial-metadata peer-role stamp (ISSUE 18 satellite): traced callers
+# label their client.rpc span's resolved peer (router vs replica) from
+# this, so stitched fleet trees name each hop without guessing from
+# ports. INITIAL metadata — trailing already carries the overload and
+# degraded markers. Only sent on traced requests: the disabled hot path
+# stays one enabled() read.
+_PEER_ROLE_KEY = "x-dts-peer-role"
+
+
+def _send_peer_role(context) -> None:
+    """Sync-transport stamp (aio contexts need `await` — inlined there)."""
+    try:
+        context.send_initial_metadata(((_PEER_ROLE_KEY, "replica"),))
+    except Exception:  # noqa: BLE001 — advisory only
+        pass
+
+
 class _SyncServicerBase:
     """Shared adapter plumbing for sync servicers: ServiceError -> grpc
     status mapping + per-RPC metrics (+ the per-request server root span
@@ -172,6 +189,7 @@ class _SyncServicerBase:
                 traceparent=_traceparent_of(context),
                 attrs={"entrypoint": name, **({"model": model} if model else {})},
             )
+            _send_peer_role(context)
         else:
             span_ctx = None
         try:
@@ -215,6 +233,7 @@ class _SyncServicerBase:
                 traceparent=_traceparent_of(context),
                 attrs={"entrypoint": name, **({"model": model} if model else {})},
             )
+            _send_peer_role(context)
         else:
             span_ctx = None
         try:
@@ -589,6 +608,12 @@ class _AioServicerBase:
                 traceparent=_traceparent_of(context),
                 attrs={"entrypoint": name, **({"model": model} if model else {})},
             )
+            try:
+                await context.send_initial_metadata(
+                    ((_PEER_ROLE_KEY, "replica"),)
+                )
+            except Exception:  # noqa: BLE001 — advisory only
+                pass
         else:
             span_ctx = None
         try:
@@ -738,6 +763,12 @@ class AioGrpcPredictionService(_AioServicerBase):
                 attrs={"entrypoint": "PredictStream",
                        **({"model": model} if model else {})},
             )
+            try:
+                await context.send_initial_metadata(
+                    ((_PEER_ROLE_KEY, "replica"),)
+                )
+            except Exception:  # noqa: BLE001 — advisory only
+                pass
         else:
             span_ctx = None
         try:
@@ -2356,12 +2387,36 @@ def serve(argv=None) -> None:
                 rec["pressure"] = str(ov.get("state") or "")
             if impl.lifecycle is not None:
                 rec.update(impl.lifecycle.fleet_record())
+            # Observability digest (ISSUE 18): qps/latency summary +
+            # scrape address piggybacked on every gossip record, so the
+            # router's fleet aggregate degrades to these numbers instead
+            # of dropping this member when the /monitoring scrape fails.
+            plane = impl.fleet
+            rec["obs"] = {
+                **metrics.fleet_summary(),
+                "addr": plane.agent.listen_addr if plane is not None else "",
+                "trace_export": bool(obs.tracing and obs.trace_export),
+            }
             return rec
+
+        def _trace_export_route(query: dict) -> dict:
+            # GET /tracez/export?since=CURSOR on the gossip port: kept
+            # span trees for the router's TraceCollector. Gated on the
+            # [observability] trace_export knob (off by default).
+            if not (obs.tracing and obs.trace_export) or not tracing.enabled():
+                return {"enabled": False, "cursor": 0, "spans": []}
+            try:
+                since = int(query.get("since", 0) or 0)
+            except (TypeError, ValueError):
+                since = 0
+            return tracing.recorder().export_since(since)
 
         fleet_plane = ReplicaFleetPlane(
             dataclasses.replace(fleet_config, self_id=fleet_self_id),
             record_fn=_fleet_record,
             lifecycle=impl.lifecycle,
+            extra_routes={"/monitoring": metrics.fleet_wire},
+            query_routes={"/tracez/export": _trace_export_route},
         )
         impl.fleet = fleet_plane
         shutdown.fleet = fleet_plane

@@ -409,6 +409,26 @@ class ObservabilityConfig:
     trace_slowest_n: int = 32
     # Rolling-window horizon for sliding QPS + windowed p50/p99.
     window_seconds: float = 60.0
+    # Fleet trace export (ISSUE 18): when on (and tracing is on), the
+    # replica serves its kept span trees incrementally at
+    # GET /tracez/export?since= — the pull surface the router-side
+    # TraceCollector stitches cross-process traces from. Off by
+    # default; costs nothing when off (the route answers
+    # {"enabled": false}).
+    trace_export: bool = False
+    # How often the router's fleet observability plane ticks: scrapes
+    # member /monitoring wires, pulls trace exports, advances the SLO
+    # monitor.
+    trace_export_interval_s: float = 1.0
+
+    def __post_init__(self):
+        v = self.trace_export_interval_s
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v <= 0:
+            raise ValueError(
+                "[observability] trace_export_interval_s must be a "
+                f"positive number, got {v!r}"
+            )
 
     def apply(self):
         """Flip the global tracing plane to this config; returns the
@@ -971,6 +991,60 @@ class FleetConfig:
             )
 
 
+@dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """SLO burn-rate monitor knobs (fleet/observability.py, ISSUE 18):
+    the router's multi-window error-budget monitor over aggregated
+    fleet telemetry, served at GET /sloz and as dts_tpu_slo_* series.
+    Off by default; when on, a breach annotates in-flight router spans
+    (`slo.burn`) so the tail sampler force-keeps explaining traces."""
+
+    enabled: bool = False
+    # Latency SLO: fraction of requests under latency_target_ms must
+    # meet latency_objective.
+    latency_target_ms: float = 50.0
+    latency_objective: float = 0.99
+    # Availability SLO: fraction of non-error requests.
+    availability_objective: float = 0.999
+    # Multi-window burn rates (Google SRE workbook shape): a page fires
+    # only when BOTH the short and long window burn fast — short alone
+    # is noise, long alone is stale.
+    short_window_s: float = 300.0
+    long_window_s: float = 3600.0
+    # burn = bad_fraction / error_budget. 14.4x exhausts a 30-day
+    # budget in 2 days (page); 6x in 5 days (ticket/warn).
+    burn_threshold_fast: float = 14.4
+    burn_threshold_slow: float = 6.0
+
+    def __post_init__(self):
+        for name in (
+            "latency_target_ms", "short_window_s", "long_window_s",
+            "burn_threshold_fast", "burn_threshold_slow",
+        ):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v <= 0:
+                raise ValueError(
+                    f"[slo] {name} must be a positive number, got {v!r}"
+                )
+        for name in ("latency_objective", "availability_objective"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not (0.0 < v < 1.0):
+                raise ValueError(
+                    f"[slo] {name} must be in (0, 1), got {v!r} — an "
+                    "objective of 1.0 leaves zero error budget and "
+                    "every burn rate divides by zero"
+                )
+        if self.long_window_s <= self.short_window_s:
+            raise ValueError(
+                "[slo] long_window_s must exceed short_window_s "
+                f"(got long={self.long_window_s!r} <= "
+                f"short={self.short_window_s!r}) — multi-window burn "
+                "alerting needs distinct horizons"
+            )
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -993,6 +1067,7 @@ _SECTIONS = {
     "recovery": RecoveryConfig,
     "kernels": KernelsConfig,
     "fleet": FleetConfig,
+    "slo": SloConfig,
 }
 
 

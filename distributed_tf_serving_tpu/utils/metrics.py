@@ -233,6 +233,91 @@ class WindowedLatency:
             "p99_ms": round(_percentile_ms(counts, total, min_us, max_us, 99), 3),
         }
 
+    # ------------------------------------------------------------ wire form
+    # The fleet aggregator (ISSUE 18) ships merged windows between
+    # processes as JSON: sparse bucket counts keyed by bucket index (the
+    # edges are a shared constant on both sides), so a member's whole
+    # window is a few dozen ints, and the router can re-merge any number
+    # of members' wires into one fleet histogram with exact counts.
+
+    def to_dict(self) -> dict:
+        counts, total, sum_us, min_us, max_us = self._merged()
+        return {
+            "window_s": self.window_s,
+            "effective_window_s": round(self.effective_window_s(), 3),
+            "total": total,
+            "sum_us": round(sum_us, 1),
+            "min_us": None if total == 0 else round(min_us, 1),
+            "max_us": round(max_us, 1),
+            "buckets": {str(i): c for i, c in enumerate(counts) if c},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> tuple[list[int], int, float, float, float]:
+        """Wire dict back to a merged-histogram state tuple — the same
+        shape `_merged()` returns, so `_percentile_ms` works on it."""
+        counts = [0] * _NUM_BUCKETS
+        for k, c in (d.get("buckets") or {}).items():
+            i = int(k)
+            if 0 <= i < _NUM_BUCKETS:
+                counts[i] += int(c)
+        total = int(d.get("total") or 0)
+        sum_us = float(d.get("sum_us") or 0.0)
+        min_us = d.get("min_us")
+        min_us = math.inf if min_us is None else float(min_us)
+        max_us = float(d.get("max_us") or 0.0)
+        return counts, total, sum_us, min_us, max_us
+
+    @staticmethod
+    def merge_dicts(wires: list[dict]) -> dict:
+        """Sum several wire dicts into one (the fleet aggregate). The
+        merged rate uses each member's own effective window — members
+        report their local qps; the aggregate is the sum."""
+        counts = [0] * _NUM_BUCKETS
+        total, sum_us = 0, 0.0
+        min_us, max_us = math.inf, 0.0
+        window_s, eff_s, qps = 0.0, 0.0, 0.0
+        for w in wires:
+            c, t, s, mn, mx = WindowedLatency.from_dict(w)
+            for i, v in enumerate(c):
+                if v:
+                    counts[i] += v
+            total += t
+            sum_us += s
+            min_us = min(min_us, mn)
+            max_us = max(max_us, mx)
+            window_s = max(window_s, float(w.get("window_s") or 0.0))
+            e = float(w.get("effective_window_s") or 0.0)
+            eff_s = max(eff_s, e)
+            if e > 0:
+                qps += t / e
+        return {
+            "window_s": window_s,
+            "effective_window_s": round(eff_s, 3),
+            "total": total,
+            "sum_us": round(sum_us, 1),
+            "min_us": None if total == 0 else round(min_us, 1),
+            "max_us": round(max_us, 1),
+            "qps": round(qps, 3),
+            "buckets": {str(i): c for i, c in enumerate(counts) if c},
+        }
+
+    @staticmethod
+    def wire_stats(wire: dict) -> dict:
+        """Human-facing summary of a wire dict (member or merged)."""
+        counts, total, sum_us, min_us, max_us = WindowedLatency.from_dict(wire)
+        eff = float(wire.get("effective_window_s") or 0.0)
+        qps = wire.get("qps")
+        if qps is None:
+            qps = total / eff if eff > 0 else 0.0
+        return {
+            "count": total,
+            "qps": round(float(qps), 3),
+            "mean_ms": round(sum_us / total / 1e3 if total else 0.0, 3),
+            "p50_ms": round(_percentile_ms(counts, total, min_us, max_us, 50), 3),
+            "p99_ms": round(_percentile_ms(counts, total, min_us, max_us, 99), 3),
+        }
+
 
 @dataclasses.dataclass
 class RpcMetrics:
@@ -399,6 +484,45 @@ _HELP = {
     "dts_tpu_elastic_split_in_flight":
         "Batches currently executing or awaiting readback per ladder "
         "rung (the switch drain barrier reads the old rung's gauge)",
+    "dts_tpu_fleet_agg_qps":
+        "Fleet-aggregated rolling request rate: the sum of member-"
+        "reported windowed qps (scraped /monitoring wires; gossip-"
+        "piggybacked summaries when a member is scrape-unreachable)",
+    "dts_tpu_fleet_agg_latency_ms":
+        "Fleet windowed latency quantiles from the merged member bucket "
+        "counts (an exact histogram merge, not an average of member "
+        "percentiles)",
+    "dts_tpu_fleet_agg_requests":
+        "Sum of member-reported lifetime requests (gauge: member churn "
+        "and restarts can lower it)",
+    "dts_tpu_fleet_agg_errors":
+        "Sum of member-reported lifetime errors (gauge: member churn "
+        "and restarts can lower it)",
+    "dts_tpu_fleet_agg_members":
+        "Members contributing to the current fleet aggregate",
+    "dts_tpu_fleet_agg_members_degraded":
+        "Members whose contribution fell back to the gossip-piggybacked "
+        "summary because the /monitoring scrape failed",
+    "dts_tpu_fleet_agg_member_qps":
+        "Per-member windowed request rate as the router aggregated it",
+    "dts_tpu_slo_latency_target_ms":
+        "Configured latency SLO target: a request is `good` for the "
+        "latency SLI when it completes under this",
+    "dts_tpu_slo_objective":
+        "Configured good-fraction objective per SLO",
+    "dts_tpu_slo_burn_rate":
+        "Error-budget burn rate per SLO and window: bad fraction over "
+        "the window divided by the budget (1 - objective); 1.0 consumes "
+        "the budget exactly at the sustainable rate",
+    "dts_tpu_slo_budget_remaining":
+        "Fraction of the long-window error budget not yet consumed",
+    "dts_tpu_slo_breached":
+        "1 while BOTH burn windows of some SLO exceed the fast "
+        "threshold (the multi-window page condition; breaching traces "
+        "are force-kept via the slo.burn span annotation)",
+    "dts_tpu_slo_breaches_total":
+        "Breach episodes since the monitor started (0->1 transitions "
+        "of dts_tpu_slo_breached)",
 }
 
 
@@ -571,6 +695,69 @@ class ServerMetrics:
                 ),
             }
         return out
+
+    # -------------------------------------------------------- fleet wire
+    # The fleet aggregator's member-side surfaces (ISSUE 18): a full wire
+    # snapshot served on the gossip port's /monitoring route, and a cheap
+    # digest piggybacked on every gossip record so the router's aggregate
+    # degrades gracefully when the scrape fails.
+
+    def _window_wires_and_counters(self) -> tuple[dict, int, int]:
+        with self._lock:
+            items = sorted(self._rpcs.items())
+        window = WindowedLatency.merge_dicts(
+            [m.window.to_dict() for _, m in items]
+        )
+        ok = sum(m.ok for _, m in items)
+        errors = sum(m.errors for _, m in items)
+        return window, ok, errors
+
+    def fleet_wire(self) -> dict:
+        """Every entrypoint's rolling window merged into ONE wire
+        histogram (the router re-merges members' wires with exact bucket
+        counts), plus lifetime ok/error counters and the lifetime latency
+        bucket counts the SLO monitor diffs — monotonic within a process,
+        so the router clamps per-member deltas across restarts."""
+        window, ok, errors = self._window_wires_and_counters()
+        with self._lock:
+            items = sorted(self._rpcs.items())
+        life_counts = [0] * _NUM_BUCKETS
+        life_total, life_sum = 0, 0.0
+        for _, m in items:
+            c, t, s, _mn, _mx = m.latency._state()
+            for i, v in enumerate(c):
+                if v:
+                    life_counts[i] += v
+            life_total += t
+            life_sum += s
+        return {
+            "uptime_s": round(self._clock() - self._start, 1),
+            "ok": ok,
+            "errors": errors,
+            "window": window,
+            "lifetime": {
+                "total": life_total,
+                "sum_us": round(life_sum, 1),
+                "buckets": {
+                    str(i): c for i, c in enumerate(life_counts) if c
+                },
+            },
+        }
+
+    def fleet_summary(self) -> dict:
+        """Digest of fleet_wire() small enough to ride every gossip
+        record: qps + quantiles only, no mergeable histogram — a
+        gossip-only member contributes its self-reported numbers to the
+        aggregate instead of exact bucket counts."""
+        window, ok, errors = self._window_wires_and_counters()
+        stats = WindowedLatency.wire_stats(window)
+        return {
+            "qps": stats["qps"],
+            "p50_ms": stats["p50_ms"],
+            "p99_ms": stats["p99_ms"],
+            "requests": ok + errors,
+            "errors": errors,
+        }
 
     def prometheus_text(
         self, batcher_stats=None, cache=None, row_cache=None, overload=None,
@@ -1397,6 +1584,61 @@ def _fleet_prometheus_lines(fleet: dict) -> list[str]:
         tb = "dts_tpu_fleet_router_backends"
         _family_lines(lines, tb, "gauge")
         lines.append(f"{tb} {router.get('backends', 0)}")
+    # Fleet aggregate + SLO blocks (ISSUE 18): present only on a router
+    # whose observability plane is armed — fleet_stats() attaches them.
+    agg = fleet.get("agg") or {}
+    if agg:
+        aq = "dts_tpu_fleet_agg_qps"
+        _family_lines(lines, aq, "gauge")
+        lines.append(f"{aq} {agg.get('qps', 0.0)}")
+        al = "dts_tpu_fleet_agg_latency_ms"
+        _family_lines(lines, al, "gauge")
+        for q in ("p50", "p99"):
+            lines.append(
+                f'{al}{{quantile="{q}"}} {agg.get(f"{q}_ms", 0.0)}'
+            )
+        for metric, value in (
+            ("dts_tpu_fleet_agg_requests", agg.get("requests", 0)),
+            ("dts_tpu_fleet_agg_errors", agg.get("errors", 0)),
+            ("dts_tpu_fleet_agg_members", agg.get("members", 0)),
+            ("dts_tpu_fleet_agg_members_degraded",
+             agg.get("members_degraded", 0)),
+        ):
+            _family_lines(lines, metric, "gauge")
+            lines.append(f"{metric} {value}")
+        per = agg.get("member_qps") or {}
+        if per:
+            mq = "dts_tpu_fleet_agg_member_qps"
+            _family_lines(lines, mq, "gauge")
+            for member, v in sorted(per.items()):
+                lines.append(f'{mq}{{member="{esc(member)}"}} {v}')
+    slo = fleet.get("slo") or {}
+    if slo:
+        lt = "dts_tpu_slo_latency_target_ms"
+        _family_lines(lines, lt, "gauge")
+        lines.append(f"{lt} {slo.get('latency_target_ms', 0.0)}")
+        ob = "dts_tpu_slo_objective"
+        _family_lines(lines, ob, "gauge")
+        for name, v in sorted((slo.get("objectives") or {}).items()):
+            lines.append(f'{ob}{{slo="{esc(name)}"}} {v}')
+        br = "dts_tpu_slo_burn_rate"
+        _family_lines(lines, br, "gauge")
+        for name, wins in sorted((slo.get("burn") or {}).items()):
+            for win in ("short", "long"):
+                lines.append(
+                    f'{br}{{slo="{esc(name)}",window="{win}"}} '
+                    f'{(wins or {}).get(win, 0.0)}'
+                )
+        bu = "dts_tpu_slo_budget_remaining"
+        _family_lines(lines, bu, "gauge")
+        for name, v in sorted((slo.get("budget_remaining") or {}).items()):
+            lines.append(f'{bu}{{slo="{esc(name)}"}} {v}')
+        bd = "dts_tpu_slo_breached"
+        _family_lines(lines, bd, "gauge")
+        lines.append(f"{bd} {1 if slo.get('breached') else 0}")
+        bt = "dts_tpu_slo_breaches_total"
+        _family_lines(lines, bt, "counter")
+        lines.append(f"{bt} {slo.get('breaches', 0)}")
     return lines
 
 

@@ -169,6 +169,19 @@ def _new_span_id() -> str:
     return os.urandom(8).hex()
 
 
+def clock_anchor() -> dict:
+    """Pair this process's span clock (perf_counter) with the wall clock,
+    plus the pid, so an exported span tree can be placed on a shared
+    fleet timeline: unix_us(span) = start_us - perf_us + unix_us. The two
+    reads are not atomic; the fleet stitcher refines residual error from
+    RPC send/recv pairs, so sub-millisecond anchor noise is acceptable."""
+    return {
+        "perf_us": int(time.perf_counter() * 1e6),
+        "unix_us": time.time_ns() // 1000,
+        "pid": os.getpid(),
+    }
+
+
 # --------------------------------------------------------------------------
 # Spans.
 
@@ -483,6 +496,13 @@ class TraceRecorder:
         self._seq = itertools.count()
         self.recorded = 0
         self.dropped = 0
+        # Export ring (the fleet trace-export surface): every KEPT span
+        # gets a monotonically increasing export sequence number, so a
+        # remote collector can pull incrementally with a `since` cursor.
+        # Bounded like the retention rings — a collector that falls more
+        # than a ring behind misses spans, by design.
+        self._export: deque[tuple[int, Span]] = deque(maxlen=self.buffer_size)
+        self._export_seq = 0
 
     # ------------------------------------------------------------ ingestion
 
@@ -510,6 +530,9 @@ class TraceRecorder:
             ):
                 self._recent.append(span)
                 kept = True
+            if kept:
+                self._export_seq += 1
+                self._export.append((self._export_seq, span))
             # dropped is APPROXIMATE: spans retained nowhere at record
             # time, plus heap evictions that had no tail claim when the
             # sampler was keeping less than everything. (An exact count
@@ -530,6 +553,7 @@ class TraceRecorder:
             self._recent.clear()
             self._errors.clear()
             self._slow.clear()
+            self._export.clear()
             self.recorded = 0
             self.dropped = 0
 
@@ -607,6 +631,27 @@ class TraceRecorder:
             "num_retained": len(roots),
             "slowest": [s.to_dict() for s in slow_sorted],
             "traces": self._traces_from(roots)[: max(1, int(limit))],
+        }
+
+    def export_since(self, since: int = 0, limit: int = 64) -> dict:
+        """Incremental span-tree export for a remote TraceCollector
+        (`GET /tracez/export?since=CURSOR`): every kept local root after
+        `since`, as `Span.to_dict` trees, with this process's clock
+        anchor so the collector can map perf_counter timestamps onto the
+        shared wall-clock timeline. The returned `cursor` feeds the next
+        call. A cursor AHEAD of the ring (this process restarted and the
+        sequence reset) replays from the start instead of going silent."""
+        since = max(0, int(since))
+        with self._lock:
+            if since > self._export_seq:
+                since = 0
+            pending = [(seq, sp) for seq, sp in self._export if seq > since]
+        pending = pending[: max(1, int(limit))]
+        return {
+            "enabled": True,
+            "clock": clock_anchor(),
+            "cursor": pending[-1][0] if pending else since,
+            "spans": [sp.to_dict() for _, sp in pending],
         }
 
     # ------------------------------------------------------------ exporters

@@ -212,6 +212,29 @@ def _fully_armed_text() -> str:
         },
         "follower": {"applied_seq": 6, "applies": 5,
                      "blacklists_applied": 1, "last_actions": {}},
+        # Fleet observability plane (ISSUE 18): the aggregate + SLO
+        # blocks a router with FleetObservabilityPlane armed attaches.
+        "agg": {
+            "qps": 123.4, "p50_ms": 2.1, "p99_ms": 9.7,
+            "requests": 4100, "errors": 3,
+            "members": 3, "members_degraded": 1,
+            "member_qps": {
+                "127.0.0.1:8500": 61.7, "127.0.0.1:8501": 61.7,
+                'we"ird\\id\n2': 0.0,
+            },
+        },
+        "slo": {
+            "enabled": True,
+            "latency_target_ms": 50.0,
+            "objectives": {"latency": 0.99, "availability": 0.999},
+            "burn": {
+                "latency": {"short": 1.2, "long": 0.8},
+                "availability": {"short": 0.0, "long": 0.1},
+            },
+            "budget_remaining": {"latency": 0.2, "availability": 0.9},
+            "breached": True,
+            "breaches": 2,
+        },
     }
     return m.prometheus_text(
         stats,
@@ -251,6 +274,11 @@ def test_fully_armed_snapshot_passes_lint():
         "dts_tpu_fleet_gossip_exchanges_total",
         "dts_tpu_fleet_rollout_seq",
         "dts_tpu_fleet_router_requests_total",
+        "dts_tpu_fleet_agg_qps", "dts_tpu_fleet_agg_latency_ms",
+        "dts_tpu_fleet_agg_member_qps",
+        "dts_tpu_fleet_agg_members_degraded",
+        "dts_tpu_slo_burn_rate", "dts_tpu_slo_budget_remaining",
+        "dts_tpu_slo_breached", "dts_tpu_slo_breaches_total",
     ):
         assert marker in text
 

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""CI gate for the fleet observability smoke (ISSUE 18).
+
+Usage: python tools/check_fleetobs_smoke.py SOAK_LINE_JSON
+
+Reads the JSON line a SOAK_FLEET=1 SOAK_TRACE_OUT=... soak printed
+(tools/ci_tier1.sh TIER1_FLEETOBS_SMOKE=1 tees it to a file) and
+asserts the fleet observability plane's acceptance criteria:
+
+- the router's TraceCollector stitched >= 1 cross-process trace that
+  spans at least THREE processes (edge client + router + replica) —
+  the whole point of trace stitching;
+- the hop waterfall on a stitched trace CLOSES: the components plus the
+  reported `other` residual sum to the root duration within 2% (the
+  decomposition partitions by construction, so a miss means the export
+  mangled it);
+- the fleet aggregate qps equals the sum of the per-member qps within
+  5% (the aggregate must be an honest sum, not a resample);
+- the SLO monitor answered with sane burn rates: enabled, every
+  short/long burn value a finite number >= 0, and the breach flag a
+  bool (a CPU-host soak may legitimately breach a 100ms target — the
+  gate checks sanity, not greenness);
+- the Chrome multi-pid artifact is non-empty (its schema + multi-pid
+  invariants are gated separately by check_trace.py --require-multi-pid).
+
+Exits 0 on success; prints every failure and exits 1 — the CI step
+uploads the soak line + trace artifact on failure.
+"""
+
+import json
+import math
+import sys
+
+WATERFALL_CLOSE_TOL = 0.02
+QPS_AGG_TOL = 0.05
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        print("usage: check_fleetobs_smoke.py SOAK_LINE_JSON", file=sys.stderr)
+        sys.exit(2)
+    path = sys.argv[1]
+    line = None
+    try:
+        with open(path) as f:
+            for raw in reversed(f.read().strip().splitlines()):
+                try:
+                    parsed = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(parsed, dict) and "fleetobs" in parsed:
+                    line = parsed
+                    break
+    except OSError as e:
+        print(
+            f"check_fleetobs_smoke: FAIL: cannot read {path}: {e}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    if line is None or not isinstance(line.get("fleetobs"), dict):
+        print(
+            f"check_fleetobs_smoke: FAIL: no JSON line with a `fleetobs` "
+            f"block in {path}", file=sys.stderr,
+        )
+        sys.exit(1)
+
+    fo = line["fleetobs"]
+    failures = []
+
+    if fo.get("three_proc_traces", 0) < 1:
+        failures.append(
+            f"no stitched >=3-process trace "
+            f"(three_proc_traces={fo.get('three_proc_traces')}, "
+            f"stitched_traces={fo.get('stitched_traces')}) — the "
+            "collector never joined client + router + replica"
+        )
+    wf = fo.get("waterfall")
+    if not isinstance(wf, dict):
+        failures.append(
+            "no hop waterfall on any stitched 3-process trace"
+        )
+    else:
+        total = wf.get("total_us") or 0
+        comps = wf.get("components_us") or {}
+        other = wf.get("other_us", 0)
+        closed = sum(comps.values()) + other
+        if total <= 0:
+            failures.append(f"waterfall total_us={total} (must be > 0)")
+        elif abs(closed - total) > max(WATERFALL_CLOSE_TOL * total, 1):
+            failures.append(
+                f"hop waterfall does not close: components + other = "
+                f"{closed} vs total_us = {total} (tolerance "
+                f"{WATERFALL_CLOSE_TOL:.0%}) — a residual was hidden"
+            )
+    agg_qps = fo.get("agg_qps")
+    member_sum = fo.get("member_qps_sum")
+    if not isinstance(agg_qps, (int, float)) or \
+            not isinstance(member_sum, (int, float)) or member_sum <= 0:
+        failures.append(
+            f"aggregate qps unusable (agg_qps={agg_qps!r}, "
+            f"member_qps_sum={member_sum!r})"
+        )
+    elif abs(agg_qps - member_sum) > QPS_AGG_TOL * member_sum:
+        failures.append(
+            f"aggregate qps {agg_qps} vs member sum {member_sum} "
+            f"diverges past {QPS_AGG_TOL:.0%}"
+        )
+    slo = fo.get("slo")
+    if not isinstance(slo, dict) or not slo.get("enabled"):
+        failures.append(f"SLO monitor did not answer enabled (slo={slo!r})")
+    else:
+        burn = slo.get("burn") or {}
+        if not burn:
+            failures.append("SLO snapshot carries no burn rates")
+        for name, windows in burn.items():
+            for w, v in (windows or {}).items():
+                if not isinstance(v, (int, float)) or \
+                        isinstance(v, bool) or not math.isfinite(v) or v < 0:
+                    failures.append(
+                        f"burn rate {name}.{w} = {v!r} is not a finite "
+                        "number >= 0"
+                    )
+        if not isinstance(slo.get("breached"), bool):
+            failures.append(
+                f"SLO breached flag is {slo.get('breached')!r}, not a bool"
+            )
+    if fo.get("trace_events", 0) < 3:
+        failures.append(
+            f"Chrome export holds only {fo.get('trace_events')} events — "
+            "a stitched 3-process trace emits at least its process "
+            "metadata + spans"
+        )
+    if not fo.get("trace_out"):
+        failures.append("no trace artifact path recorded")
+
+    if failures:
+        print("check_fleetobs_smoke: FAIL", file=sys.stderr)
+        for f_ in failures:
+            print(f"  - {f_}", file=sys.stderr)
+        sys.exit(1)
+    print(
+        "check_fleetobs_smoke: OK "
+        f"(three_proc_traces={fo.get('three_proc_traces')} "
+        f"waterfall_total_us={(wf or {}).get('total_us')} "
+        f"agg_qps={agg_qps} member_qps_sum={member_sum} "
+        f"slo_breached={(slo or {}).get('breached')} "
+        f"trace_events={fo.get('trace_events')})"
+    )
+
+
+if __name__ == "__main__":
+    main()

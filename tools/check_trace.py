@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Schema validation for an exported Chrome-trace-event JSON artifact.
 
-Usage: python tools/check_trace.py PATH [--min-events N] [--require-counter-track]
+Usage: python tools/check_trace.py PATH [--min-events N]
+       [--require-counter-track] [--require-multi-pid]
 
 Asserts what Perfetto / chrome://tracing need to load the file — and what
 the CI smoke step (tools/ci_tier1.sh TIER1_TRACE_SMOKE=1, on a
@@ -20,6 +21,13 @@ SOAK_CHAOS=1 traced soak) promises about the tracing plane:
   every counter's (pid, tid) must have a thread_name metadata event with
   a non-empty name (the device label). `--require-counter-track` makes
   the track's presence mandatory (the SOAK_UTIL=1 smoke).
+- `--require-multi-pid` (the TIER1_FLEETOBS_SMOKE=1 fleet soak): the
+  file holds at least one STITCHED cross-process trace — every
+  args.trace_id group spans >= 2 distinct pids, span ts are
+  non-decreasing within each (pid, tid) track, and any hop-waterfall
+  args (`wf_*_us`) are numeric and sum to the root event's dur within
+  2% (the residual component `wf_other_us` is part of the sum, so an
+  honest export closes exactly).
 
 Exits 0 on success; prints the failure and exits 1 otherwise — the CI
 step uploads the artifact on failure so the broken file is inspectable.
@@ -38,6 +46,7 @@ def main() -> None:
     argv = sys.argv[1:]
     min_events = 1
     require_counters = False
+    require_multi_pid = False
     positional = []
     i = 0
     while i < len(argv):
@@ -52,13 +61,18 @@ def main() -> None:
             min_events = int(a.split("=", 1)[1])
         elif a == "--require-counter-track":
             require_counters = True
+        elif a == "--require-multi-pid":
+            require_multi_pid = True
         elif a.startswith("--"):
             fail(f"unknown flag {a!r}")
         else:
             positional.append(a)
         i += 1
     if not positional:
-        fail("usage: check_trace.py PATH [--min-events N] [--require-counter-track]")
+        fail(
+            "usage: check_trace.py PATH [--min-events N] "
+            "[--require-counter-track] [--require-multi-pid]"
+        )
     path = positional[0]
     try:
         with open(path) as f:
@@ -78,6 +92,8 @@ def main() -> None:
     counters = 0
     track_names: dict[tuple, str] = {}  # (pid, tid) -> thread_name
     counter_last_ts: dict[tuple, int] = {}  # (pid, tid, name) -> last ts
+    trace_pids: dict[str, set] = {}  # args.trace_id -> {pid}
+    span_last_ts: dict[tuple, int] = {}  # (pid, tid) -> last span ts
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
             fail(f"event {i} is not an object")
@@ -101,6 +117,37 @@ def main() -> None:
             for key in ("trace_id", "span_id"):
                 if not args_blk.get(key):
                     fail(f"span event {i} ({ev['name']!r}) missing args.{key}")
+            trace_pids.setdefault(str(args_blk["trace_id"]), set()).add(
+                ev["pid"]
+            )
+            track = (ev["pid"], ev["tid"])
+            if require_multi_pid and ev["ts"] < span_last_ts.get(track, 0):
+                fail(
+                    f"span event {i} ({ev['name']!r}) ts={ev['ts']} goes "
+                    f"BACKWARD on track {track} (last "
+                    f"{span_last_ts[track]}) — the stitched export must "
+                    "sort per-track"
+                )
+            span_last_ts[track] = ev["ts"]
+            wf = {
+                k: v for k, v in args_blk.items() if k.startswith("wf_")
+            }
+            if wf:
+                for key, val in wf.items():
+                    if not isinstance(val, (int, float)) or \
+                            isinstance(val, bool):
+                        fail(
+                            f"span event {i} ({ev['name']!r}) waterfall "
+                            f"arg {key}={val!r} must be numeric"
+                        )
+                total = sum(wf.values())
+                dur = ev["dur"]
+                if abs(total - dur) > max(0.02 * dur, 1):
+                    fail(
+                        f"span event {i} ({ev['name']!r}) hop waterfall "
+                        f"sums to {total} but dur={dur} — components + "
+                        "wf_other_us must close within 2%"
+                    )
         if ev["ph"] == "C":
             counters += 1
             ts = ev.get("ts")
@@ -142,9 +189,24 @@ def main() -> None:
             "no counter ('C') events — the device-occupancy counter track "
             "is required (--require-counter-track)"
         )
+    multi_pid = sum(1 for pids in trace_pids.values() if len(pids) >= 2)
+    if require_multi_pid:
+        if not trace_pids:
+            fail("--require-multi-pid: no traces in the file")
+        single = {
+            tid: pids for tid, pids in trace_pids.items() if len(pids) < 2
+        }
+        if single:
+            tid, pids = next(iter(single.items()))
+            fail(
+                f"--require-multi-pid: trace {tid!r} spans only "
+                f"{sorted(pids)} — every exported trace must stitch "
+                f">= 2 processes ({len(single)}/{len(trace_pids)} failed)"
+            )
     print(
         f"check_trace: OK: {len(events)} events, {spans} spans, "
-        f"{counters} counter events ({path})"
+        f"{counters} counter events, {multi_pid}/{len(trace_pids)} "
+        f"multi-process traces ({path})"
     )
 
 

@@ -238,4 +238,27 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_FLEET_SMOKE:-0}" = "1" ]; then
         python tools/soak.py | tee "$FLEET_LINE" || rc=1
     python tools/check_fleet_smoke.py "$FLEET_LINE" || rc=1
 fi
+
+# Fleet observability smoke (TIER1_FLEETOBS_SMOKE=1, ISSUE 18): the
+# fleet chaos soak re-run with the observability plane armed fleet-wide
+# (SOAK_TRACE_OUT triggers it in fleet mode) — tracing + trace export
+# on every replica and the router, [slo] on the router, tracing in the
+# edge process. Gated on: >= 1 stitched trace spanning client + router
+# + replica, the hop waterfall closing within 2%, aggregate qps within
+# 5% of the member sum, sane SLO burn rates
+# (tools/check_fleetobs_smoke.py), and the multi-pid Chrome artifact
+# passing tools/check_trace.py --require-multi-pid.
+if [ "$rc" -eq 0 ] && [ "${TIER1_FLEETOBS_SMOKE:-0}" = "1" ]; then
+    FLEETOBS_LINE="${TIER1_FLEETOBS_LINE:-/tmp/tier1_fleetobs_soak.json}"
+    FLEETOBS_TRACE="${TIER1_FLEETOBS_TRACE:-/tmp/tier1_fleetobs_trace.json}"
+    echo "tier1: fleet observability smoke (SOAK_FLEET=1 +" \
+        "SOAK_TRACE_OUT=$FLEETOBS_TRACE, line $FLEETOBS_LINE)"
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_FLEETOBS_SECONDS:-20}" SOAK_FLEET=1 \
+        SOAK_TRACE_OUT="$FLEETOBS_TRACE" \
+        python tools/soak.py | tee "$FLEETOBS_LINE" || rc=1
+    python tools/check_fleetobs_smoke.py "$FLEETOBS_LINE" || rc=1
+    python tools/check_trace.py "$FLEETOBS_TRACE" --min-events 10 \
+        --require-multi-pid || rc=1
+fi
 exit $rc

@@ -172,6 +172,23 @@ in CI by tools/check_fleet_smoke.py. Knobs: SOAK_FLEET_REPLICAS,
 SOAK_FLEET_GOSSIP_INTERVAL_S (0.25), SOAK_FLEET_FIELDS (8),
 SOAK_CANDIDATES (24 here), SOAK_GRPC_WORKERS (4 here).
 
+Fleet observability mode (SOAK_FLEET=1 + SOAK_TRACE_OUT=/path, ISSUE
+18): the fleet soak additionally arms the fleet observability plane —
+[observability] tracing + trace_export on every replica AND the router
+(sample rate 1.0 so every request is kept), [slo] on the router with
+soak-scale windows, and tracing enabled in THIS edge process. After the
+chaos script settles, the edge recorder's span trees are POSTed to the
+router's /tracez/ingest (source "client"), the router's /tracez is
+polled until it serves >= 1 STITCHED trace spanning client + router +
+replica, the multi-pid Chrome export (/tracez?format=chrome) is written
+to SOAK_TRACE_OUT, and /fleet/monitoring + /sloz + /monitoring are
+probed. The JSON line gains a `fleetobs` block (stitched/3-process
+trace counts, the hop waterfall, aggregate-vs-member qps, the SLO
+snapshot, Chrome event count + artifact path) — gated in CI
+(TIER1_FLEETOBS_SMOKE=1) by tools/check_fleetobs_smoke.py plus
+tools/check_trace.py --require-multi-pid on the artifact. The plain
+fleet smoke (no SOAK_TRACE_OUT) is unchanged.
+
 Tracing (SOAK_TRACE_OUT=/path/trace.json): per-request span tracing runs
 for the whole soak (utils/tracing.py; SOAK_TRACE_SAMPLE sets the tail-
 sampling rate, default 0.05 — errors/fault-annotated/slowest-N traces are
@@ -245,6 +262,14 @@ def _fleet_soak(seconds: float) -> None:
         os.environ.get("SOAK_FLEET_GOSSIP_INTERVAL_S", "0.25")
     )
     ttl_s = max(gossip_interval * 6, 1.5)
+    # Fleet observability mode (ISSUE 18): SOAK_TRACE_OUT in fleet mode
+    # arms tracing + trace export fleet-wide and the SLO monitor on the
+    # router; the Chrome multi-pid export lands at this path.
+    trace_out = os.environ.get("SOAK_TRACE_OUT", "")
+    fleetobs = bool(trace_out)
+    if fleetobs:
+        from distributed_tf_serving_tpu.utils import tracing as edge_tracing
+        edge_tracing.enable(buffer_size=512, sample_rate=1.0)
     start_rss = rss_gb()
     t_start = time.time()
 
@@ -314,6 +339,14 @@ def _fleet_soak(seconds: float) -> None:
                 f'gossip_interval_s = {gossip_interval}\n'
                 f'record_ttl_s = {ttl_s}\n'
             )
+            if fleetobs:
+                f.write(
+                    '\n'
+                    '[observability]\n'
+                    'tracing = true\n'
+                    'trace_sample_rate = 1.0\n'
+                    'trace_export = true\n'
+                )
     router_toml = os.path.join(tmp, "router.toml")
     with open(router_toml, "w") as f:
         f.write(
@@ -344,6 +377,23 @@ def _fleet_soak(seconds: float) -> None:
             f'rollout_writer = true\n'
             f'rollout_state_file = "{os.path.join(tmp, "rollout.json")}"\n'
         )
+        if fleetobs:
+            # Soak-scale SLO windows: short/long must both fill within
+            # the run so the burn rates carry real deltas.
+            f.write(
+                '\n'
+                '[observability]\n'
+                'tracing = true\n'
+                'trace_sample_rate = 1.0\n'
+                'trace_export = true\n'
+                'trace_export_interval_s = 0.5\n'
+                '\n'
+                '[slo]\n'
+                'enabled = true\n'
+                'latency_target_ms = 100.0\n'
+                'short_window_s = 2.0\n'
+                'long_window_s = 8.0\n'
+            )
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env["PYTHONPATH"] = repo_root + (
@@ -636,6 +686,79 @@ def _fleet_soak(seconds: float) -> None:
             f"/monitoring/prometheus/metrics"
         )
 
+        # ---- fleet observability probes (ISSUE 18) --------------------
+        fleetobs_block = None
+        if fleetobs:
+            # Push the edge recorder's span trees — the first hop of
+            # every stitched trace. Loop the cursor until drained.
+            cursor = 0
+            pushed = 0
+            while True:
+                export = edge_tracing.recorder().export_since(cursor)
+                if not export.get("spans"):
+                    break
+                resp = http_json(
+                    f"http://127.0.0.1:{router_gossip}/tracez/ingest",
+                    {"source": "client", **export},
+                )
+                pushed += int(resp.get("accepted") or 0)
+                cursor = int(export.get("cursor") or cursor)
+
+            def stitched_three():
+                tz = http_json(
+                    f"http://127.0.0.1:{router_gossip}/tracez?limit=100"
+                )
+                three = [
+                    t for t in tz.get("traces") or []
+                    if t.get("num_processes", 0) >= 3
+                    and t.get("stitched_hops", 0) >= 2
+                ]
+                return (tz, three) if three else None
+
+            (tz, three), _ = poll_until(
+                stitched_three, 30.0,
+                "a stitched trace spanning client + router + replica",
+            )
+            chrome = http_json(
+                f"http://127.0.0.1:{router_gossip}"
+                f"/tracez?format=chrome&limit=100"
+            )
+            with open(trace_out, "w") as f:
+                json.dump(chrome, f)
+            fleet_mon = http_json(
+                f"http://127.0.0.1:{router_gossip}/fleet/monitoring"
+            )
+            slo = http_json(f"http://127.0.0.1:{router_gossip}/sloz")
+            router_mon = http_json(
+                f"http://127.0.0.1:{router_gossip}/monitoring"
+            )
+            agg = fleet_mon.get("aggregate") or {}
+            member_qps_sum = sum(
+                float(st.get("qps") or 0.0)
+                for st in (fleet_mon.get("members") or {}).values()
+            )
+            wf = next(
+                (t["waterfall"] for t in three if t.get("waterfall")),
+                None,
+            )
+            fleetobs_block = {
+                "client_spans_pushed": pushed,
+                "stitched_traces": sum(
+                    1 for t in tz.get("traces") or []
+                    if t.get("num_processes", 0) >= 2
+                ),
+                "three_proc_traces": len(three),
+                "waterfall": wf,
+                "waterfall_window": fleet_mon.get("waterfall"),
+                "agg_qps": agg.get("qps"),
+                "member_qps_sum": round(member_qps_sum, 3),
+                "agg": agg,
+                "slo": slo,
+                "router_monitoring_keys": sorted(router_mon),
+                "trace_events": len(chrome.get("traceEvents") or []),
+                "trace_out": trace_out,
+            }
+
         # ---- goodput windows ------------------------------------------
         ok_times = sorted(t for t, ok, _ in events if ok)
         errors = [e for _, ok, e in events if not ok]
@@ -730,6 +853,8 @@ def _fleet_soak(seconds: float) -> None:
                 ),
             },
         }
+        if fleetobs_block is not None:
+            line["fleetobs"] = fleetobs_block
         print(json.dumps(line))
     except BaseException as e:
         _log_tails(repr(e))
