@@ -1221,6 +1221,14 @@ def _overload_flag() -> bool:
     return "--overload" in sys.argv[1:]
 
 
+def _cascade_flag() -> bool:
+    """--cascade: run the multi-stage cascade A/B phase (the identical
+    seeded candidate stream, DCN-only then retrieval->rank through the
+    two-executable cascade). Skipped by default — the phase serves
+    through its own small-rung batcher, not the headline ladder."""
+    return "--cascade" in sys.argv[1:]
+
+
 def _skew_flag() -> float | None:
     """--skew[=EXPONENT]: run the cache-plane A/B phase on a seeded
     zipfian workload (client/bench.py make_zipfian_payloads +
@@ -2259,6 +2267,132 @@ def child_main() -> None:
             finally:
                 await server.stop(0)
 
+        async def serve_cascade_ab():
+            nonlocal stage
+            stage = "cascade_ab"
+            # Cascade A/B (ISSUE 19 acceptance): the IDENTICAL seeded
+            # candidate stream, full-model-only then retrieval->rank
+            # through the in-server two-executable cascade (two_tower
+            # stage 1, on-device prune, DCN over the survivors). Serves
+            # through its OWN batcher: the cascade's win is survivor
+            # traffic landing in a smaller rung, so the ladder must hold
+            # a survivor-sized bucket (256 for 25% of 1000) the headline
+            # ladder does not carry. Reports rows_ranked/rows_requested,
+            # the survivor-bucket histogram, the goodput delta, and a
+            # survivor bit-identity probe (cascade survivor scores vs the
+            # same rows in a full DCN pass).
+            from distributed_tf_serving_tpu.models import build_model
+            from distributed_tf_serving_tpu.serving.cascade import (
+                STAGE2,
+                CascadeOrchestrator,
+            )
+
+            s1_config = dataclasses.replace(config, name="stage1")
+            s1_model = build_model("two_tower", s1_config)
+            s1_params = jax.jit(s1_model.init)(jax.random.PRNGKey(3))
+            stage1 = Servable(
+                name="stage1", version=1, model=s1_model, params=s1_params,
+                signatures=ctr_signatures(config.num_fields),
+            )
+            registry.load(stage1)
+            ab_batcher = DynamicBatcher(
+                buckets=(256, 1024),
+                max_wait_us=2000,
+                completion_workers=12,
+                output_wire_dtype="bfloat16",
+                async_readback=True,
+                pipelined_dispatch=True,
+            ).start()
+            ab_batcher.max_batch_candidates = 1024
+            ab_impl = PredictionServiceImpl(registry, ab_batcher)
+            server, port = create_server_async(ab_impl, "127.0.0.1:0")
+            await server.start()
+            try:
+                log(stage, "warmup: DCN on both rungs, stage1 on 1024")
+                ab_batcher.warmup(servable)
+                ab_batcher.warmup(stage1, buckets=(1024,))
+                pool_n = 8
+                pool = [
+                    make_payload(
+                        candidates=CANDIDATES, num_fields=NUM_FIELDS,
+                        seed=700 + i,
+                    )
+                    for i in range(pool_n)
+                ]
+                conc = scale.unique_concurrency
+                rpw = 20 if scale.tpu else 8
+                sched = np.arange(conc * rpw) % pool_n
+
+                async def cascade_loop():
+                    async with ShardedPredictClient(
+                        [f"127.0.0.1:{port}"], "DCN",
+                        channels_per_host=scale.channels_per_host,
+                    ) as client:
+                        return await run_closed_loop(
+                            client, pool[0], concurrency=conc,
+                            requests_per_worker=rpw, sort_scores=True,
+                            warmup_requests=3, payload_pool=pool,
+                            schedule=sched,
+                        )
+
+                log(stage, f"{conc}x{rpw}: cascade OFF pass (DCN only)")
+                rep_off = await cascade_loop()
+                casc = CascadeOrchestrator(
+                    registry, ab_batcher, stage1_model="stage1",
+                    survivor_fraction=0.25,
+                )
+                ab_impl.cascade = casc
+                try:
+                    log(stage, "cascade ON pass (identical stream)")
+                    rep_on = await cascade_loop()
+                    # Survivor bit-identity: the cascade's stage-2 scores
+                    # must be byte-equal to the same rows of a cascade-off
+                    # full pass, and its pruned rows byte-equal to a
+                    # stage-1-only pass — or the cascade is changing
+                    # answers, not saving work.
+                    probe = pool[0]
+                    sk = servable.model.score_output
+                    s1k = s1_model.score_output
+                    out = casc.run(ab_impl, servable, probe, (sk,), None, None)
+                    ab_impl.cascade = None
+                    ref = ab_impl._run(servable, probe, output_keys=(sk,))
+                    ref1 = ab_impl._run(stage1, probe, output_keys=(s1k,))
+                    surv = out["cascade_stage"] == STAGE2
+                    bit_identical = bool(
+                        np.array_equal(out[sk][surv], ref[sk][surv])
+                        and np.array_equal(
+                            out[sk][~surv],
+                            ref1[s1k].astype(np.float32)[~surv],
+                        )
+                    )
+                    snap = casc.snapshot()
+                finally:
+                    ab_impl.cascade = None
+                qps_off = rep_off.summary()["qps"]
+                qps_on = rep_on.summary()["qps"]
+                res["cascade"] = {
+                    "requests_each_pass": conc * rpw,
+                    "survivor_fraction": 0.25,
+                    "qps_cascade_off": round(qps_off, 1),
+                    "qps_cascade_on": round(qps_on, 1),
+                    "goodput_delta": round(qps_on / max(qps_off, 1e-9), 3),
+                    "p50_ms_cascade_off": round(rep_off.summary()["p50_ms"], 3),
+                    "p50_ms_cascade_on": round(rep_on.summary()["p50_ms"], 3),
+                    "rows_requested": snap["rows_requested"],
+                    "rows_ranked": snap["rows_ranked"],
+                    "rank_fraction": snap["rank_fraction"],
+                    "survivor_buckets": {
+                        str(b): c for b, c in snap["survivor_buckets"].items()
+                    },
+                    "fallbacks": snap["fallbacks"],
+                    "host_prunes": snap["host_prunes"],
+                    "scores_bit_identical": bit_identical,
+                }
+                log(stage, json.dumps(res["cascade"]))
+            finally:
+                ab_batcher.stop()
+                await server.stop(0)
+
         async def serve_lifecycle():
             nonlocal stage
             stage = "lifecycle_hot_swap"
@@ -2628,6 +2762,8 @@ def child_main() -> None:
             asyncio.run(serve_cache_ab(skew))
         if _overload_flag():
             asyncio.run(serve_overload_ab())
+        if _cascade_flag():
+            asyncio.run(serve_cascade_ab())
         if os.environ.get("DTS_BENCH_LIFECYCLE", "0") == "1":
             asyncio.run(serve_lifecycle())
         if os.environ.get("DTS_BENCH_RECOVERY", "0") == "1":
@@ -2743,6 +2879,12 @@ def child_main() -> None:
             # across runs, and the emulated-vs-live flag. Absent when
             # off (default).
             "elastic": res.get("elastic"),
+            # Multi-stage cascade A/B (ISSUE 19, --cascade): the same
+            # seeded candidate stream DCN-only vs retrieval->rank through
+            # the two-executable cascade — rows_ranked/rows_requested,
+            # the survivor-bucket histogram, the goodput delta, and the
+            # survivor bit-identity gate. Absent when off (default).
+            "cascade": res.get("cascade"),
             # Output-transfer pipeline attribution (ISSUE 1): wire bytes
             # fetched vs. the full-fp32 all-outputs baseline, and the
             # fraction of the in-flight D2H window the completers never

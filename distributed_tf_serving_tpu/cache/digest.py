@@ -80,7 +80,7 @@ def row_label_keys(arrays: dict[str, np.ndarray]) -> list[str]:
     ]
 
 
-def features_digest(arrays: dict[str, np.ndarray]) -> bytes:
+def features_digest(arrays: dict[str, np.ndarray], salt: bytes = b"") -> bytes:
     """Stable 16-byte digest of a request's decoded feature tensors.
 
     Same identity contract as canonical_rows — exact decoded bytes per
@@ -91,8 +91,17 @@ def features_digest(arrays: dict[str, np.ndarray]) -> bytes:
     fold, so identical raw bytes under a different tensor structure (an
     int64 id re-read as eight weight bytes, a reshaped batch) can never
     share a digest.
+
+    `salt` folds an execution-mode discriminator into the digest itself:
+    a cascade stage-1 prune submit produces survivor pairs, not a score
+    vector, so the same (model, version, outputs, features) identity must
+    never share a digest with a full-vector run — the salt keeps the two
+    result shapes apart at the key level rather than trusting every
+    consumer to know about modes.
     """
     h = hashlib.blake2b(digest_size=16)
+    if salt:
+        h.update(salt)
     for k in sorted(arrays):
         a = arrays[k]
         h.update(f"{k}:{a.dtype.str}:{a.shape};".encode())

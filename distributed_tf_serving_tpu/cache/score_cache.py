@@ -171,12 +171,17 @@ class ScoreCache:
             m[field] += n
 
     @staticmethod
-    def make_key(model: str, version, output_keys, arrays: dict) -> tuple:
+    def make_key(
+        model: str, version, output_keys, arrays: dict, salt: bytes = b""
+    ) -> tuple:
         """(model, version, output-selection, canonical digest). version and
         output_keys are any hashables the caller resolves requests by (the
         batcher uses servable.version + the fetch-key tuple; the client its
-        version label + output key)."""
-        return (model, version, output_keys, features_digest(arrays))
+        version label + output key). `salt` rides the digest fold — the
+        cascade prune mode keys apart from full-vector runs there (see
+        features_digest) — and the digest stays the LAST tuple element
+        (_shard_of addresses key[-1])."""
+        return (model, version, output_keys, features_digest(arrays, salt=salt))
 
     # ------------------------------------------------------------ hot path
 
@@ -226,7 +231,7 @@ class ScoreCache:
 
     def begin(
         self, model: str, version, output_keys, arrays: dict,
-        stale_s: float = 0.0,
+        stale_s: float = 0.0, salt: bytes = b"",
     ) -> CacheHandle:
         """One-stop submit-path entry: digest + lookup + single-flight join.
         Returns a handle where exactly one of these holds:
@@ -237,7 +242,7 @@ class ScoreCache:
           will resolve (hand it to the caller, done);
         - handle.leader is True: compute, then complete(handle, future).
         """
-        key = self.make_key(model, version, output_keys, arrays)
+        key = self.make_key(model, version, output_keys, arrays, salt=salt)
         gen = self._gen_of(model)
         hit, stale = self._get_within(key, stale_s)
         if hit is not None:

@@ -1734,6 +1734,31 @@ def _credentials_from_config(cfg):
     )
 
 
+# Per-row stage provenance output a cascade-armed server appends to the
+# response (serving/cascade.py): 1 = the row was pruned after stage 1 and
+# carries its stage-1 score; 2 = the row survived and carries the full
+# model's score. Rides the response like the int8-wire sidecars — an
+# extra tensor beyond the signature, absent when the cascade is off.
+CASCADE_STAGE_KEY = "cascade_stage"
+
+
+def cascade_stage(response) -> "np.ndarray | None":
+    """Per-row cascade provenance from a Predict response — accepts the
+    raw PredictResponse proto or a decoded outputs dict (predict_sync's
+    return). None when the server ran no cascade for this request.
+
+    Note the fleet router tier merges SCORES across replica shards and
+    re-encodes, so provenance survives only on direct replica responses.
+    """
+    outputs = getattr(response, "outputs", response)
+    if CASCADE_STAGE_KEY not in outputs:
+        return None
+    value = outputs[CASCADE_STAGE_KEY]
+    if isinstance(value, np.ndarray):
+        return value
+    return codec.to_ndarray(value)
+
+
 def predict_sync(
     host: str,
     arrays: dict[str, np.ndarray],

@@ -238,6 +238,38 @@ def topk_compact_device(scores: jnp.ndarray, n_valid, k: int, wire_dt) -> dict:
     return {"topk_scores": vals, "topk_indices": idx.astype(jnp.int32)}
 
 
+def cascade_prune_device(scores: jnp.ndarray, n_valid, k: int, wire_dt) -> dict:
+    """Stage-1 prune for the multi-stage cascade, traced into the jitted
+    entry: the k best (score, index) survivor pairs PLUS the full stage-1
+    score vector cross the wire — the vector because cascade responses
+    fill non-survivor positions from stage-1 scores, so it must come back
+    anyway, and shipping it at wire dtype alongside the pairs is one
+    readback instead of a second submit. Padding rows are masked to -inf
+    for the selection exactly like topk_compact_device (they can never
+    survive); the returned vector is unmasked because the completer
+    slices it to the request's n rows before anything user-visible sees
+    it. `n_valid` is a traced scalar — one executable per (bucket, k)."""
+    import jax
+
+    mask = jnp.arange(scores.shape[0]) < n_valid
+    masked = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
+    vals, idx = jax.lax.top_k(masked, k)
+    full = scores.astype(jnp.float32)
+    if wire_dt is not None:
+        if wire_dt == np.dtype(np.int8):
+            # Same call as the top-k wire: int8 would drag quantization
+            # sidecars through the survivor scatter for a handful of
+            # bytes; bf16 keeps the compaction without the machinery.
+            wire_dt = np.dtype(ml_dtypes.bfloat16)
+        vals = vals.astype(wire_dt)
+        full = full.astype(wire_dt)
+    return {
+        "survivor_scores": vals,
+        "survivor_indices": idx.astype(jnp.int32),
+        "stage1_scores": full,
+    }
+
+
 def topk_restore_host(vals, idx, n: int, score_key: str) -> dict[str, np.ndarray]:
     """Host-side inverse of topk_compact_device: scatter the k pairs back
     into a full-length float32 vector with 0.0 off the head. Sigmoid CTR

@@ -65,6 +65,7 @@ from ..cache.digest import canonical_rows
 from ..models.base import Model
 from ..models.registry import Servable
 from ..ops.transfer import (
+    cascade_prune_device,
     combined_layout,
     combined_supported,
     compact_outputs_device,
@@ -600,6 +601,11 @@ class _WorkItem:
     replays: int = 0
     device_kills: int = 0
     bisect_key: int | None = None
+    # Cascade stage-1 prune (ISSUE 19): > 0 asks the jitted entry to
+    # return the k best (score, index) survivor pairs plus the stage-1
+    # score vector instead of full outputs. Prune submits are forced
+    # solo — the survivor indices address the request's own rows.
+    prune_k: int = 0
 
 
 def _replay_group_phases(group: list["_WorkItem"], phases: list) -> None:
@@ -628,6 +634,13 @@ class BatcherStats:
     # Batches whose outputs rode the top-k compaction (only k (score, idx)
     # pairs crossed the D2H link instead of the full score vector).
     topk_batches: int = 0
+    # Cascade stage-1 prune batches (ISSUE 19): the jitted entry returned
+    # survivor (score, index) pairs + the wire-dtype stage-1 vector, and
+    # the batches where the prune could not arm (needs_x64, custom
+    # run_fn, coalesced group) so the orchestrator fell back to a host
+    # argpartition over the full score vector.
+    prune_batches: int = 0
+    prune_fallback_batches: int = 0
     max_queue_depth: int = 0
     # Times coalescing waited past max_wait because the dispatch pipeline
     # was saturated (the wait was latency-free; see _coalesce_next).
@@ -1027,6 +1040,7 @@ class DynamicBatcher:
         criticality: str | None = None,
         _warmup: bool = False,
         _solo: bool = False,
+        _prune_k: int = 0,
     ) -> Future:
         """Enqueue one request's arrays; returns a Future of output arrays
         (sliced back to the request's own candidate count). output_keys limits
@@ -1045,7 +1059,17 @@ class DynamicBatcher:
         limit — the static queue_capacity_candidates bound, or the
         adaptive overload controller's self-tuned limit when armed — is
         refused (QueueOverloadError / AdmissionRefusedError) instead of
-        queueing work no deadline survives."""
+        queueing work no deadline survives.
+
+        _prune_k (cascade stage-1, ISSUE 19): > 0 turns this submit into a
+        prune — the result dict carries survivor (score, index) pairs plus
+        the stage-1 score vector instead of full outputs. Forced solo
+        (survivor indices address the request's own rows), and the score-
+        cache key is salted with the mode+k so a prune result can never be
+        served to a full-vector request for the same features (or vice
+        versa)."""
+        if _prune_k:
+            _solo = True
         if self._stopping:
             raise RuntimeError("batcher is stopped")
         if self._dead is not None:
@@ -1080,6 +1104,7 @@ class DynamicBatcher:
                 handle = cache.begin(
                     servable.name, servable.version, output_keys, arrays,
                     stale_s=stale_s,
+                    salt=b"prune:%d" % _prune_k if _prune_k else b"",
                 )
             if handle.hit is not None:
                 if handle.stale:
@@ -1101,7 +1126,7 @@ class DynamicBatcher:
         try:
             return self._submit_miss(
                 servable, arrays, n, output_keys, deadline_s, span, _warmup,
-                handle, cache, criticality, _solo,
+                handle, cache, criticality, _solo, _prune_k,
             )
         except BaseException as exc:
             if handle is not None and handle.leader:
@@ -1113,7 +1138,7 @@ class DynamicBatcher:
 
     def _submit_miss(
         self, servable, arrays, n, output_keys, deadline_s, span, _warmup,
-        handle, cache=None, criticality=None, solo=False,
+        handle, cache=None, criticality=None, solo=False, prune_k=0,
     ) -> Future:
         """The no-cache-hit tail of submit(): admission, prepare, enqueue
         (exactly the pre-cache-plane submit body). The cache handle, when
@@ -1196,6 +1221,7 @@ class DynamicBatcher:
                 span=span if tracing.enabled() else None,
                 criticality=criticality,
                 solo=solo,
+                prune_k=prune_k,
             )
         except BaseException:
             with self._cv:
@@ -1217,12 +1243,14 @@ class DynamicBatcher:
             # re-dispatched instead of inheriting its deadline fate.
             fut.add_done_callback(
                 lambda f, h=handle, c=cache, sv=servable, a=arrays,
-                ok=output_keys: self._cache_complete(c, h, f, sv, a, ok)
+                ok=output_keys, pk=prune_k:
+                self._cache_complete(c, h, f, sv, a, ok, pk)
             )
         return fut
 
     def _cache_complete(
-        self, cache, handle, fut: Future, servable, arrays, output_keys
+        self, cache, handle, fut: Future, servable, arrays, output_keys,
+        prune_k: int = 0,
     ) -> None:
         """Close a single-flight leader's computation into the cache:
         successful results fill (and wake coalesced waiters), failures fan
@@ -1242,7 +1270,10 @@ class DynamicBatcher:
             if not waiters:
                 return
             try:
-                retry = self.submit(servable, arrays, output_keys=output_keys)
+                retry = self.submit(
+                    servable, arrays, output_keys=output_keys,
+                    _prune_k=prune_k,
+                )
             except BaseException as exc:  # stopped/wedged/overloaded batcher
                 for w in waiters:
                     try:
@@ -1750,7 +1781,8 @@ class DynamicBatcher:
             # before.
             def fn(
                 params, buf, layout, out_keys=None, donate=False,
-                topk=0, n_valid=None, k_apply=None, _cache=variants,
+                topk=0, n_valid=None, k_apply=None, prune=False,
+                _cache=variants,
             ):
                 # k_apply (kernel plane, ISSUE 12): an alternate apply
                 # callable — the fused Pallas serving kernel — swapped in
@@ -1758,16 +1790,19 @@ class DynamicBatcher:
                 # the variant key so the Pallas and XLA executables
                 # coexist; quantized params need no key (jax.jit retraces
                 # on the distinct param-tree structure).
-                key = (layout, out_keys, donate, topk, k_apply)
+                key = (layout, out_keys, donate, topk, k_apply, prune)
                 jfn = _cache.get(key)
                 if jfn is None:
                     donargs = (1,) if donate else ()
                     ap = k_apply or apply
                     if topk:
-                        def run(p, b, nv, _l=layout, _k=topk, _ap=ap):
+                        select = cascade_prune_device if prune \
+                            else topk_compact_device
+                        def run(p, b, nv, _l=layout, _k=topk, _ap=ap,
+                                _sel=select):
                             out = _ap(p, unpack_device_combined(b, _l))
                             finish(out, None)  # records the baseline
-                            return topk_compact_device(out[score_key], nv, _k, wire)
+                            return _sel(out[score_key], nv, _k, wire)
                     else:
                         def run(p, b, _l=layout, _ok=out_keys, _ap=ap):
                             return finish(_ap(p, unpack_device_combined(b, _l)), _ok)
@@ -1776,18 +1811,21 @@ class DynamicBatcher:
         else:
             def fn(
                 params, packed, out_keys=None, donate=False,
-                topk=0, n_valid=None, k_apply=None, _cache=variants,
+                topk=0, n_valid=None, k_apply=None, prune=False,
+                _cache=variants,
             ):
-                key = (out_keys, topk, k_apply)
+                key = (out_keys, topk, k_apply, prune)
                 jfn = _cache.get(key)
                 if jfn is None:
                     ap = k_apply or apply
                     if topk:
-                        def run(p, b, nv, _k=topk, _ap=ap):
+                        select = cascade_prune_device if prune \
+                            else topk_compact_device
+                        def run(p, b, nv, _k=topk, _ap=ap, _sel=select):
                             batch = unpack_device(b, spec) if spec else b
                             out = _ap(p, batch)
                             finish(out, None)
-                            return topk_compact_device(out[score_key], nv, _k, wire)
+                            return _sel(out[score_key], nv, _k, wire)
                     else:
                         def run(p, b, _ok=out_keys, _ap=ap):
                             # Transfer decompression is traced into the
@@ -1880,6 +1918,7 @@ class DynamicBatcher:
     def _execute_fused(
         self, ctx: dict, bucket: int,
         out_keys: tuple[str, ...] | None, topk: int, n_valid,
+        prune: bool = False,
     ):
         """Device stage of the fused path: content cache / native pack /
         upload / jit call (cache+pack+jitcall spans match the generic
@@ -1933,7 +1972,7 @@ class DynamicBatcher:
             return fn(
                 k_params, buf, layout,
                 out_keys=out_keys, donate=donate, topk=topk, n_valid=n_valid,
-                k_apply=k_apply,
+                k_apply=k_apply, prune=prune,
             )
 
     def _kernel_variant(self, servable: Servable, rows: int, override=None):
@@ -1977,6 +2016,7 @@ class DynamicBatcher:
         out_keys: tuple[str, ...] | None = None,
         topk: int = 0,
         n_valid: int | None = None,
+        prune: bool = False,
         _force_donate: bool = False,
         _kernel_override=None,
     ):
@@ -2040,6 +2080,7 @@ class DynamicBatcher:
                         k_params, buf, layout,
                         out_keys=out_keys, donate=donate,
                         topk=topk, n_valid=n_valid, k_apply=k_apply,
+                        prune=prune,
                     )
             if self.input_cache is not None and not _force_donate:
                 # Digest BEFORE packing: a content hit skips both the upload
@@ -2057,14 +2098,14 @@ class DynamicBatcher:
                     return fn(
                         k_params, inputs,
                         out_keys=out_keys, topk=topk, n_valid=n_valid,
-                        k_apply=k_apply,
+                        k_apply=k_apply, prune=prune,
                     )
             packed = pack_host(arrays, spec) if spec else arrays
             with request_trace.span("batch.jitcall"):
                 return fn(
                     k_params, packed,
                     out_keys=out_keys, topk=topk, n_valid=n_valid,
-                    k_apply=k_apply,
+                    k_apply=k_apply, prune=prune,
                 )
 
     def _shed_expired_locked(self, it: _WorkItem) -> bool:
@@ -2289,7 +2330,7 @@ class DynamicBatcher:
             # batches whose caller asked for exactly the score vector. A
             # coalesced group cannot ride it (top-k over concatenated
             # requests would mix candidates across requests).
-            topk, n_valid = 0, None
+            topk, n_valid, prune = 0, None, False
             if (
                 self.output_top_k
                 and self._run_fn is None
@@ -2300,6 +2341,26 @@ class DynamicBatcher:
                 and not first.servable.model.needs_x64
             ):
                 topk, n_valid = self.output_top_k, first.n
+            # Cascade stage-1 prune (ISSUE 19): a prune submit rides the
+            # same on-device selection machinery as top-k compaction (and
+            # reuses its k/n_valid plumbing) but returns the survivor
+            # pairs PLUS the wire-dtype stage-1 vector. Prune items are
+            # solo, so the group is single-request by construction; when
+            # the variant cannot arm (custom run_fn, x64 model, k >= n)
+            # the batch runs as a normal full-vector execution and the
+            # orchestrator selects survivors on host — counted so the
+            # fallback rate is visible.
+            if first.prune_k and not first.warmup:
+                if (
+                    self._run_fn is None
+                    and len(group) == 1
+                    and 0 < first.prune_k < first.n
+                    and wanted_key == (first.servable.model.score_output,)
+                    and not first.servable.model.needs_x64
+                ):
+                    topk, n_valid, prune = first.prune_k, first.n, True
+                else:
+                    self.stats.prune_fallback_batches += 1
             # Intra-batch duplicate collapse (cache/dedup.py): exact-bytes
             # duplicate rows across the combined batch execute ONCE; the
             # completer scatters the unique rows' scores back into every
@@ -2445,7 +2506,7 @@ class DynamicBatcher:
             self._run_stage(
                 None, group, total, bucket, wanted, wanted_key,
                 topk, n_valid, fused, batched, phases, scatter, ring_bufs,
-                row_ctx,
+                row_ctx, prune,
             )
             return
         with self._cv:
@@ -2457,7 +2518,7 @@ class DynamicBatcher:
         self._dispatcher.submit(
             self._run_stage, sid, group, total, bucket, wanted, wanted_key,
             topk, n_valid, fused, batched, phases, scatter, ring_bufs,
-            row_ctx,
+            row_ctx, prune,
         ).add_done_callback(
             # Thread-death guard: _run_stage catches Exception broadly,
             # so only a BaseException (or a bug in its own finally) can
@@ -2705,6 +2766,7 @@ class DynamicBatcher:
         scatter: "np.ndarray | None" = None,
         ring_bufs: list | None = None,
         row_ctx: "_RowContext | None" = None,
+        prune: bool = False,
     ) -> None:
         """Device stage for one assembled batch: execute, issue the async
         D2H readback, register in flight, hand off to a completer. Runs on
@@ -2845,13 +2907,15 @@ class DynamicBatcher:
                 with request_trace.span("batch.dispatch"):
                     if fused is not None:
                         outputs = self._execute_fused(
-                            fused, bucket, wanted_key, topk, n_valid
+                            fused, bucket, wanted_key, topk, n_valid,
+                            prune=prune,
                         )
                         self.stats.fused_batches += 1
                     else:
                         outputs = self._execute(  # async dispatch
                             servable, batched,
                             out_keys=wanted_key, topk=topk, n_valid=n_valid,
+                            prune=prune,
                         )
             if run_fn_cap is not None and getattr(run_fn_cap, "elastic", False):
                 # Same thread, synchronous: the token names the split the
@@ -2860,8 +2924,11 @@ class DynamicBatcher:
                 # pre-handoff failure).
                 run_token = run_fn_cap.take_issue_token()
             if topk:
-                self.stats.topk_batches += 1
-                # Top-k outputs ARE the fetch (the score vector is
+                if prune:
+                    self.stats.prune_batches += 1
+                else:
+                    self.stats.topk_batches += 1
+                # Top-k / prune outputs ARE the fetch (the score vector is
                 # reconstructed host-side from the pairs).
                 fetch = dict(outputs)
             else:
@@ -2906,11 +2973,12 @@ class DynamicBatcher:
             self.stats.padded_candidates += bucket
             self.stats.bytes_download_full_f32 += int(full_bytes)
 
-            meta = (
-                {"topk_n": n_valid, "score_key": servable.model.score_output}
-                if topk
-                else None
-            )
+            meta = None
+            if topk:
+                meta = {
+                    ("prune_n" if prune else "topk_n"): n_valid,
+                    "score_key": servable.model.score_output,
+                }
             # Readback + distribution off-thread: this thread moves on to
             # the next batch immediately, pipelining device work. The batch
             # is registered in-flight first so a readback that never
@@ -3079,7 +3147,20 @@ class DynamicBatcher:
                 self.stats.readback_blocked_s += (
                     waited if self.async_readback else window
                 )
-            if meta is not None:
+            if meta is not None and "prune_n" in meta:
+                # Cascade stage-1 prune: widen the wire-dtype arrays to
+                # f32 and hand all three through — the orchestrator does
+                # the survivor gather/scatter. The per-item slice below
+                # passes the k-length pairs through untouched (k < n) and
+                # trims the bucket-length stage-1 vector to the request's
+                # own rows (single solo request by construction).
+                host = {
+                    "survivor_scores":
+                        host["survivor_scores"].astype(np.float32),
+                    "survivor_indices": host["survivor_indices"],
+                    "stage1_scores": host["stage1_scores"].astype(np.float32),
+                }
+            elif meta is not None:
                 # Top-k reconstruction: scatter the k (score, index) pairs
                 # back into a full-length f32 vector (single-request group
                 # by construction).

@@ -212,6 +212,10 @@ class RestGateway:
             # the current split, switch history ring, and per-split
             # serve counters.
             web.get("/meshz", self.meshz),
+            # Multi-stage ranking cascade (ISSUE 19): stage-1/prune/
+            # stage-2 counters, row dispositions, observed survivor and
+            # rank fractions, and the survivor-bucket histogram.
+            web.get("/cascadez", self.cascadez),
         ])
 
     # ------------------------------------------------------------- helpers
@@ -589,6 +593,7 @@ class RestGateway:
                 mesh=mesh,
                 elastic=self.impl.elastic_stats(mesh=mesh),
                 fleet=self.impl.fleet_stats(),
+                cascade=self.impl.cascade_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -625,6 +630,7 @@ class RestGateway:
             "mesh": self.impl.mesh_stats,
             "elastic": self.impl.elastic_stats,
             "fleet": self.impl.fleet_stats,
+            "cascade": self.impl.cascade_stats,
             "versions": self.impl.versions_stats,
             "pipeline": self.impl.pipeline_stats,
             "request_log": request_log,
@@ -659,7 +665,7 @@ class RestGateway:
         # waterfall merge).
         for name in ("cache", "row_cache", "overload", "utilization",
                      "quality", "lifecycle", "recovery", "kernels", "mesh",
-                     "elastic", "fleet", "versions", "pipeline"):
+                     "elastic", "fleet", "cascade", "versions", "pipeline"):
             if name == "mesh":
                 block = self.impl.mesh_stats(
                     utilization=snap.get("utilization")
@@ -869,6 +875,20 @@ class RestGateway:
         if plane is None:
             return web.json_response({"enabled": False})
         return web.json_response({"enabled": True, **plane.snapshot()})
+
+    async def cascadez(self, request: web.Request) -> web.Response:
+        """GET /cascadez: the multi-stage ranking cascade surface —
+        config echo (stage-1 model, survivor policy), request/fallback/
+        stage-1-failure counters, row dispositions (requested/survivor/
+        pruned), per-stage wall time, observed survivor- and rank-
+        fractions, and the survivor-bucket histogram (which padded rungs
+        the stage-2 submits landed in). `{"enabled": false}` when the
+        cascade is not armed ([cascade] enabled=false), so probes need
+        no config knowledge."""
+        stats = self.impl.cascade_stats()
+        return web.json_response(
+            stats if stats is not None else {"enabled": False}
+        )
 
     async def recoveryz(self, request: web.Request) -> web.Response:
         """GET /recoveryz: the device-failure recovery surface — the

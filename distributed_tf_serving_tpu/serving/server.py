@@ -1273,6 +1273,9 @@ class GracefulShutdown:
                 self.lifecycle.stop()
             if self.watcher is not None:
                 self.watcher.stop()
+            cascade_watcher = getattr(self.impl, "cascade_watcher", None)
+            if cascade_watcher is not None:
+                cascade_watcher.stop()
             # 2.5. Abort any in-flight recovery cycle BEFORE the drain:
             # its watchdog stops, captured-but-unreplayed work fails
             # UNAVAILABLE (clients reroute — this replica is going away),
@@ -1326,6 +1329,7 @@ def build_stack(
     kernels_config=None,
     mesh_config=None,
     elastic_config=None,
+    cascade_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -1445,6 +1449,26 @@ def build_stack(
             "ladder's rungs must factorize its device count). Arm both, "
             "or drop [elastic]"
         )
+    cascade_armed = cascade_config is not None and cascade_config.enabled
+    if cascade_armed:
+        if cfg.output_top_k:
+            raise ValueError(
+                "[cascade] enabled conflicts with output_top_k: the "
+                "top-k wire replaces the score vector with (score, "
+                "index) pairs, but the cascade's scatter needs the full "
+                "vector to fill non-survivors from stage-1 scores — the "
+                "two selections cannot both own the response shape. "
+                "The cascade IS the retrieval-style compaction; drop "
+                "output_top_k"
+            )
+        if mesh_armed:
+            raise ValueError(
+                "[cascade] enabled conflicts with [mesh] (and [elastic]):"
+                " the stage-1 prune is a single-chip jitted-entry "
+                "variant the sharded run_fn does not provide, so the "
+                "cascade could only ever run its host fallback — "
+                "disable one of them"
+            )
     model_configs = None
     if cfg.model_config_file:
         if model_base_path or checkpoint or savedmodel:
@@ -1764,6 +1788,73 @@ def build_stack(
     # probes and the client's half-open probing key off this).
     impl.warmup_complete = False
 
+    if cascade_armed:
+        # Multi-stage ranking cascade (serving/cascade.py, ISSUE 19): the
+        # first-stage servable is a NORMAL registry entry under its own
+        # model name — published/hot-swapped through the same versioned-
+        # dir machinery as any other model when stage1_base_path is set,
+        # else built in-process from the primary architecture (towers
+        # share the feature layout; two_tower's user/item split must stay
+        # a real split).
+        from .cascade import CascadeOrchestrator
+
+        base_mc = model_config or ModelConfig(
+            name=cfg.model_name, num_fields=cfg.num_fields
+        )
+        s1_overrides = {"name": cascade_config.stage1_model}
+        if (
+            cascade_config.stage1_kind == "two_tower"
+            and base_mc.num_user_fields >= base_mc.num_fields
+        ):
+            s1_overrides["num_user_fields"] = max(1, base_mc.num_fields // 2)
+        stage1_mc = dataclasses.replace(base_mc, **s1_overrides)
+        if cascade_config.stage1_base_path:
+            from .version_watcher import VersionWatcher, VersionWatcherConfig
+
+            impl.cascade_watcher = VersionWatcher(
+                cascade_config.stage1_base_path,
+                registry,
+                VersionWatcherConfig(
+                    model_name=cascade_config.stage1_model,
+                    model_kind=cascade_config.stage1_kind,
+                    poll_interval_s=cfg.file_system_poll_wait_seconds,
+                    max_load_attempts=cfg.max_num_load_retries + 1,
+                ),
+                warmup=batcher.warmup_via_queue if cfg.warmup else None,
+                model_config=stage1_mc,
+                on_servable_change=_servable_change_hook(
+                    score_cache, quality_monitor, row_cache=row_cache
+                ),
+            ).start()
+        else:
+            stage1_sv = load_demo_servable(
+                registry,
+                kind=cascade_config.stage1_kind,
+                name=cascade_config.stage1_model,
+                config=stage1_mc,
+            )
+            if cfg.warmup:
+                batcher.warmup(stage1_sv)
+        impl.cascade = CascadeOrchestrator(
+            registry, batcher,
+            stage1_model=cascade_config.stage1_model,
+            survivor_k=cascade_config.survivor_k,
+            survivor_fraction=cascade_config.survivor_fraction,
+            score_threshold=cascade_config.score_threshold,
+            min_candidates=cascade_config.min_candidates,
+        )
+        log.info(
+            "cascade on: stage1=%s (%s%s) survivors=%s threshold=%s "
+            "min_candidates=%d — GET /cascadez on the REST surface",
+            cascade_config.stage1_model, cascade_config.stage1_kind,
+            f" from {cascade_config.stage1_base_path}"
+            if cascade_config.stage1_base_path else " demo",
+            cascade_config.survivor_k or
+            f"{cascade_config.survivor_fraction:.0%}",
+            cascade_config.score_threshold or "<off>",
+            cascade_config.min_candidates,
+        )
+
     if model_configs is not None:
         watchers = _start_model_config_watchers(
             cfg, model_configs, registry, batcher, model_config, mesh,
@@ -1960,6 +2051,19 @@ def serve(argv=None) -> None:
         "(`elastic` block in /meshz//monitoring, dts_tpu_elastic_* "
         "series)",
     )
+    parser.add_argument(
+        "--cascade", action="store_true", default=None,
+        help="in-server multi-stage ranking cascade (ISSUE 19): score the "
+        "full candidate batch with a cheap first-stage servable (its own "
+        "registry entry — hot-swappable like any model), take the top "
+        "survivors ON DEVICE so only survivor rows cross the wire-dtype "
+        "D2H, then rank just the survivors with the primary model; "
+        "non-survivors keep their stage-1 scores and every row carries "
+        "stage provenance in the response. Equivalent to [cascade] "
+        "enabled=true (`cascade` block in /monitoring, GET /cascadez, "
+        "dts_tpu_cascade_* series). Refuses output_top_k and [mesh]/"
+        "[elastic] at build time",
+    )
     parser.add_argument("--mesh-devices", dest="mesh_devices", type=int)
     parser.add_argument("--model-parallel", dest="model_parallel", type=int)
     parser.add_argument(
@@ -2154,6 +2258,7 @@ def serve(argv=None) -> None:
     from ..utils.config import (
         BatchingConfig,
         CacheConfig,
+        CascadeConfig,
         ElasticConfig,
         FleetConfig,
         KernelsConfig,
@@ -2221,6 +2326,9 @@ def serve(argv=None) -> None:
             # auto-armed — a serving-topology change must never ride a
             # config omission; build_stack refuses it explicitly.
             mesh_config = dataclasses.replace(mesh_config, enabled=True)
+    cascade_config = cfgs.get("cascade") or CascadeConfig()
+    if args.cascade:
+        cascade_config = dataclasses.replace(cascade_config, enabled=True)
     if mesh_config.enabled:
         # With the mesh MODE armed, the CLI mesh-geometry flags configure
         # the [mesh] section (and are withheld from the legacy [server]
@@ -2306,6 +2414,7 @@ def serve(argv=None) -> None:
         kernels_config=kernels_config,
         mesh_config=mesh_config,
         elastic_config=elastic_config,
+        cascade_config=cascade_config,
     )
     if impl.lifecycle is not None:
         # The CLI server drives the controller with its background thread

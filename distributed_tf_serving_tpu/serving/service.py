@@ -34,6 +34,7 @@ from ..models.registry import (
 )
 from ..proto import serving_apis_pb2 as apis
 from ..proto import tf_framework_pb2 as fw
+from . import cascade as cascade_mod
 from .batcher import (
     BatchTooLargeError,
     DeviceWedgedError,
@@ -134,6 +135,13 @@ class PredictionServiceImpl:
         # series read through it; None (default) = static split (or no
         # mesh at all).
         self.elastic = None
+        # Multi-stage ranking cascade (serving/cascade.py, ISSUE 19):
+        # when a CascadeOrchestrator is set, score-only-filtered Predicts
+        # big enough to prune run retrieval->rank in one RPC — stage-1
+        # prune on device, full model over the survivors, provenance in
+        # the response. None (default) costs one attribute read per
+        # Predict.
+        self.cascade = None
         # Fleet robustness plane (fleet/replica.py, ISSUE 17): the
         # ReplicaFleetPlane (gossip membership + rollout follower) when
         # [fleet] armed it. GET /fleetz and the dts_tpu_fleet_*
@@ -301,6 +309,15 @@ class PredictionServiceImpl:
         armed ([recovery] enabled=false)."""
         rec = self.recovery
         return rec.snapshot() if rec is not None else None
+
+    def cascade_stats(self) -> dict | None:
+        """Cascade-plane snapshot (per-stage latency totals, pruned/
+        survivor/fallback counters, observed survivor fraction, survivor
+        bucket histogram) — the body of GET /cascadez, the `cascade`
+        block in /monitoring, and the dts_tpu_cascade_* Prometheus
+        series. None when the plane is off ([cascade] enabled=false)."""
+        casc = self.cascade
+        return casc.snapshot() if casc is not None else None
 
     def fleet_stats(self) -> dict | None:
         """Fleet-plane snapshot (gossip membership view + exchange
@@ -673,6 +690,7 @@ class PredictionServiceImpl:
         output_keys: tuple[str, ...] | None = None,
         deadline_s: float | None = None,
         criticality: str | None = None,
+        prune_k: int = 0,
     ) -> dict[str, np.ndarray]:
         timeout = self._effective_timeout(deadline_s)
         fut = None
@@ -683,7 +701,7 @@ class PredictionServiceImpl:
             fut = self.batcher.submit(
                 servable, arrays, output_keys=output_keys,
                 deadline_s=deadline_s, span=tracing.current_span(),
-                criticality=criticality,
+                criticality=criticality, _prune_k=prune_k,
             )
             out = fut.result(timeout=timeout)
             self._consume_future_degraded(fut)
@@ -698,6 +716,7 @@ class PredictionServiceImpl:
         output_keys: tuple[str, ...] | None = None,
         deadline_s: float | None = None,
         criticality: str | None = None,
+        prune_k: int = 0,
     ) -> dict[str, np.ndarray]:
         """_run for coroutine servers (server.create_server_async): the
         batcher Future is awaited instead of blocked on, so one event-loop
@@ -713,7 +732,7 @@ class PredictionServiceImpl:
             fut = self.batcher.submit(
                 servable, arrays, output_keys=output_keys,
                 deadline_s=deadline_s, span=tracing.current_span(),
-                criticality=criticality,
+                criticality=criticality, _prune_k=prune_k,
             )
             out = await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=timeout
@@ -793,12 +812,26 @@ class PredictionServiceImpl:
         servable, arrays, out_names, fetch_keys = self._predict_prepare(
             request, criticality
         )
-        with request_trace.span("predict.execute"):
-            outputs = self._run(
-                servable, arrays, output_keys=fetch_keys,
-                deadline_s=self._budget_left(deadline_t),
-                criticality=criticality,
-            )
+        casc = self.cascade
+        if casc is not None and casc.eligible(
+            servable, fetch_keys, next(iter(arrays.values())).shape[0]
+        ):
+            # Multi-stage cascade (ISSUE 19): retrieval->rank in one RPC.
+            # The provenance output rides the response like the int8-wire
+            # sidecars — an extra tensor beyond the signature.
+            with request_trace.span("predict.execute"):
+                outputs = casc.run(
+                    self, servable, arrays, fetch_keys, deadline_t,
+                    criticality,
+                )
+            out_names = [*out_names, cascade_mod.STAGE_OUTPUT]
+        else:
+            with request_trace.span("predict.execute"):
+                outputs = self._run(
+                    servable, arrays, output_keys=fetch_keys,
+                    deadline_s=self._budget_left(deadline_t),
+                    criticality=criticality,
+                )
         resp = self._predict_finish(
             request, servable, out_names, outputs, int8_wire=int8_wire
         )
@@ -819,12 +852,23 @@ class PredictionServiceImpl:
         servable, arrays, out_names, fetch_keys = self._predict_prepare(
             request, criticality
         )
-        with request_trace.span("predict.execute"):
-            outputs = await self._run_async(
-                servable, arrays, output_keys=fetch_keys,
-                deadline_s=self._budget_left(deadline_t),
-                criticality=criticality,
-            )
+        casc = self.cascade
+        if casc is not None and casc.eligible(
+            servable, fetch_keys, next(iter(arrays.values())).shape[0]
+        ):
+            with request_trace.span("predict.execute"):
+                outputs = await casc.run_async(
+                    self, servable, arrays, fetch_keys, deadline_t,
+                    criticality,
+                )
+            out_names = [*out_names, cascade_mod.STAGE_OUTPUT]
+        else:
+            with request_trace.span("predict.execute"):
+                outputs = await self._run_async(
+                    servable, arrays, output_keys=fetch_keys,
+                    deadline_s=self._budget_left(deadline_t),
+                    criticality=criticality,
+                )
         resp = self._predict_finish(
             request, servable, out_names, outputs, int8_wire=int8_wire
         )

@@ -1045,6 +1045,74 @@ class SloConfig:
             )
 
 
+@dataclasses.dataclass(frozen=True)
+class CascadeConfig:
+    """Multi-stage ranking cascade knobs (serving/cascade.py, ISSUE 19):
+    a cheap first-stage servable prunes the candidate set on-device and
+    the full model ranks only the survivors — retrieval->rank in one
+    RPC. Off by default (one attribute read per Predict when disabled).
+    Refused alongside output_top_k (its wire replaces the score vector
+    the cascade's scatter needs) and [mesh]/[elastic] (the sharded
+    executor has no prune entry)."""
+
+    enabled: bool = False
+    # Registry name the first-stage servable is published/resolved under
+    # — a NORMAL model name: the version watcher, lifecycle, and quality
+    # planes see it like any other servable.
+    stage1_model: str = "stage1"
+    # Registered model kind built for the demo stage-1 servable when no
+    # stage1_base_path supplies checkpoints (two_tower: the user-tower /
+    # item-tower dot product is the classic cheap retrieval scorer).
+    stage1_kind: str = "two_tower"
+    # Versioned base path for watcher-managed stage-1 rollouts; empty =
+    # build the demo stage-1 servable in-process.
+    stage1_base_path: str = ""
+    # Survivor budget: a fixed top-k when > 0, else ceil of this fraction
+    # of the request's candidates.
+    survivor_k: int = 0
+    survivor_fraction: float = 0.25
+    # Optional host-side filter on stage-1 survivor scores: survivors
+    # scoring below this are pruned too (0 disables; applied AFTER the
+    # top-k selection, so it only ever shrinks the ranked set).
+    score_threshold: float = 0.0
+    # Requests smaller than this skip the cascade outright — two device
+    # round trips cost more than ranking a tiny batch once.
+    min_candidates: int = 8
+
+    def __post_init__(self):
+        if not self.stage1_model:
+            raise ValueError("[cascade] stage1_model must be non-empty")
+        if not isinstance(self.survivor_k, int) or isinstance(
+            self.survivor_k, bool
+        ) or self.survivor_k < 0:
+            raise ValueError(
+                "[cascade] survivor_k must be a non-negative int, got "
+                f"{self.survivor_k!r}"
+            )
+        if not isinstance(self.survivor_fraction, (int, float)) or isinstance(
+            self.survivor_fraction, bool
+        ) or not (0.0 < self.survivor_fraction <= 1.0):
+            raise ValueError(
+                "[cascade] survivor_fraction must be in (0, 1], got "
+                f"{self.survivor_fraction!r}"
+            )
+        if not isinstance(self.min_candidates, int) or isinstance(
+            self.min_candidates, bool
+        ) or self.min_candidates < 2:
+            raise ValueError(
+                "[cascade] min_candidates must be an int >= 2, got "
+                f"{self.min_candidates!r} — a 1-candidate cascade prunes "
+                "nothing and pays two submits"
+            )
+        if not isinstance(self.score_threshold, (int, float)) or isinstance(
+            self.score_threshold, bool
+        ) or self.score_threshold < 0.0:
+            raise ValueError(
+                "[cascade] score_threshold must be >= 0, got "
+                f"{self.score_threshold!r}"
+            )
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -1068,6 +1136,7 @@ _SECTIONS = {
     "kernels": KernelsConfig,
     "fleet": FleetConfig,
     "slo": SloConfig,
+    "cascade": CascadeConfig,
 }
 
 

@@ -1,0 +1,481 @@
+"""Multi-stage ranking cascade (serving/cascade.py, ISSUE 19): per-request
+eligibility gating, device-prune bit-identity vs the full-pass and
+stage-1-only references, provenance scatter, host-prune fallback
+equivalence, the threshold/zero-survivor path, stage-1-missing and
+stage-1-failure full-pass fallbacks, async parity, prune cache-key
+salting, build_stack wiring + refusal matrix (output_top_k, [mesh]), and
+the rollout contract: a stage-1 version hot-swap mid-traffic never fails
+a request — a stale resolution degrades to a full ranking pass."""
+
+import asyncio
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_tf_serving_tpu import codec
+from distributed_tf_serving_tpu.cache import features_digest
+from distributed_tf_serving_tpu.client import (
+    build_predict_request,
+    cascade_stage,
+)
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.serving import (
+    DynamicBatcher,
+    PredictionServiceImpl,
+    VersionWatcher,
+    VersionWatcherConfig,
+)
+from distributed_tf_serving_tpu.serving.cascade import (
+    STAGE1,
+    STAGE2,
+    STAGE_OUTPUT,
+    CascadeOrchestrator,
+    publish_stage1,
+)
+
+F = 6
+CFG = ModelConfig(
+    name="DCN", num_fields=F, vocab_size=512, embed_dim=4, mlp_dims=(8,),
+    num_cross_layers=1, compute_dtype="float32", num_user_fields=3,
+)
+SCORE = "prediction_node"
+
+
+def make_arrays(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, 1 << 40, size=(n, F)).astype(np.int64),
+        "feat_wts": rng.rand(n, F).astype(np.float32),
+    }
+
+
+def _dcn_servable(version=1, seed=0):
+    model = build_model("dcn", CFG)
+    return Servable(
+        name="DCN", version=version, model=model,
+        params=model.init(jax.random.PRNGKey(seed)),
+        signatures=ctr_signatures(F),
+    )
+
+
+def _stage1_servable(version=1, seed=3):
+    cfg = dataclasses.replace(CFG, name="stage1")
+    model = build_model("two_tower", cfg)
+    return Servable(
+        name="stage1", version=version, model=model,
+        params=model.init(jax.random.PRNGKey(seed)),
+        signatures=ctr_signatures(F),
+    )
+
+
+class _Stack:
+    pass
+
+
+@pytest.fixture(scope="module")
+def stack():
+    s = _Stack()
+    s.registry = ServableRegistry()
+    s.dcn = _dcn_servable()
+    s.stage1 = _stage1_servable()
+    s.registry.load(s.dcn)
+    s.registry.load(s.stage1)
+    s.batcher = DynamicBatcher(buckets=(16, 64), max_wait_us=0).start()
+    s.impl = PredictionServiceImpl(s.registry, s.batcher)
+    yield s
+    s.batcher.stop()
+
+
+@pytest.fixture()
+def casc(stack):
+    """Fresh orchestrator per test: counter assertions stay isolated."""
+    c = CascadeOrchestrator(
+        stack.registry, stack.batcher, stage1_model="stage1",
+        survivor_fraction=0.25,
+    )
+    stack.impl.cascade = c
+    yield c
+    stack.impl.cascade = None
+
+
+def _predict(impl, arrays, model="DCN", filt=(SCORE,)):
+    resp = impl.predict(
+        build_predict_request(arrays, model, output_filter=filt)
+    )
+    return resp, codec.to_ndarray(resp.outputs[SCORE])
+
+
+# ------------------------------------------------------------ eligibility
+
+
+def test_eligibility_gates(stack, casc):
+    dcn, s1 = stack.dcn, stack.stage1
+    assert casc.eligible(dcn, (SCORE,), 64)
+    # Unfiltered requests fetch every signature output — mixed-stage
+    # values for non-score outputs would be meaningless, so no cascade.
+    assert not casc.eligible(dcn, None, 64)
+    assert not casc.eligible(dcn, ("logits",), 64)
+    assert not casc.eligible(dcn, (SCORE, "logits"), 64)
+    # Below min_candidates two device round trips cost more than ranking.
+    assert not casc.eligible(dcn, (SCORE,), casc.min_candidates - 1)
+    # The stage-1 model itself must never recurse into the cascade.
+    assert not casc.eligible(s1, (SCORE,), 64)
+    # A survivor budget that keeps everything prunes nothing.
+    wide = CascadeOrchestrator(
+        stack.registry, stack.batcher, stage1_model="stage1", survivor_k=100,
+    )
+    assert not wide.eligible(dcn, (SCORE,), 64)
+
+
+def test_plan_k(stack, casc):
+    assert casc.plan_k(64) == 16
+    assert casc.plan_k(8) == 2
+    assert casc.plan_k(3) == 1  # fraction floors at one survivor
+    fixed = CascadeOrchestrator(
+        stack.registry, stack.batcher, stage1_model="stage1", survivor_k=5,
+    )
+    assert fixed.plan_k(64) == 5 and fixed.plan_k(1000) == 5
+
+
+# ------------------------------------------- bit-identity and provenance
+
+
+def test_cascade_bit_identity_and_provenance(stack, casc):
+    impl = stack.impl
+    arrays = make_arrays(64, seed=1)
+    resp, scores = _predict(impl, arrays)
+    stage = cascade_stage(resp)
+    assert stage is not None and stage.shape == (64,)
+    assert stage.dtype == np.int32
+    assert int((stage == STAGE2).sum()) == 16
+    assert int((stage == STAGE1).sum()) == 48
+
+    full = impl._run(stack.dcn, arrays, output_keys=(SCORE,))[SCORE]
+    s1 = impl._run(stack.stage1, arrays, output_keys=(SCORE,))[SCORE]
+    surv = np.where(stage == STAGE2)[0]
+    pruned = np.where(stage == STAGE1)[0]
+    # The survivor set IS stage-1's top-k.
+    want = np.argsort(-np.asarray(s1, np.float32))[:16]
+    assert set(surv.tolist()) == set(want.tolist())
+    # Survivor rows: bit-identical to a full-pass DCN ranking; pruned
+    # rows: bit-identical to a stage-1-only pass. No tolerance — the
+    # cascade re-batches rows, it must not re-derive scores.
+    np.testing.assert_array_equal(
+        scores[surv], np.asarray(full, np.float32)[surv]
+    )
+    np.testing.assert_array_equal(
+        scores[pruned], np.asarray(s1, np.float32)[pruned]
+    )
+
+    snap = casc.snapshot()
+    assert snap["requests"] == 1
+    assert snap["host_prunes"] == 0  # the device prune armed
+    assert snap["fallbacks"] == 0
+    assert snap["rows_requested"] == 64 and snap["rows_ranked"] == 16
+    assert snap["pruned_rows"] == 48
+    assert snap["rank_fraction"] == pytest.approx(0.25)
+    # 16 survivors ride the 16 bucket rung.
+    assert snap["survivor_buckets"] == {16: 1}
+
+
+def test_async_predict_parity(stack, casc):
+    impl = stack.impl
+    arrays = make_arrays(64, seed=2)
+    _, want = _predict(impl, arrays)
+    req = build_predict_request(arrays, "DCN", output_filter=(SCORE,))
+    resp = asyncio.run(impl.predict_async(req))
+    np.testing.assert_array_equal(
+        codec.to_ndarray(resp.outputs[SCORE]), want
+    )
+    st = cascade_stage(resp)
+    assert st is not None and int((st == STAGE2).sum()) == 16
+    assert casc.snapshot()["requests"] == 2
+
+
+def test_bypass_paths_carry_no_provenance(stack, casc):
+    impl = stack.impl
+    # Unfiltered: all signature outputs, cascade ineligible.
+    resp = impl.predict(build_predict_request(make_arrays(64), "DCN"))
+    assert cascade_stage(resp) is None
+    assert STAGE_OUTPUT not in resp.outputs
+    # Too small.
+    resp, _ = _predict(impl, make_arrays(4))
+    assert cascade_stage(resp) is None
+    # Direct stage-1 scoring stays a plain predict.
+    resp, _ = _predict(impl, make_arrays(16), model="stage1")
+    assert cascade_stage(resp) is None
+    assert casc.snapshot()["requests"] == 0
+
+
+# ----------------------------------------- threshold / zero survivors
+
+
+def test_score_threshold_zero_survivors(stack):
+    impl = stack.impl
+    casc = CascadeOrchestrator(
+        stack.registry, stack.batcher, stage1_model="stage1",
+        survivor_fraction=0.25, score_threshold=1e9,
+    )
+    impl.cascade = casc
+    try:
+        arrays = make_arrays(64, seed=5)
+        resp, scores = _predict(impl, arrays)
+        stage = cascade_stage(resp)
+        # Nobody clears the bar: every row keeps its stage-1 score.
+        assert stage is not None and (stage == STAGE1).all()
+        s1 = impl._run(stack.stage1, arrays, output_keys=(SCORE,))[SCORE]
+        np.testing.assert_array_equal(scores, np.asarray(s1, np.float32))
+        snap = casc.snapshot()
+        assert snap["zero_survivor_requests"] == 1
+        assert snap["rows_ranked"] == 0
+        assert snap["survivor_buckets"] == {}
+    finally:
+        impl.cascade = None
+
+
+# ------------------------------------------------- host-prune fallback
+
+
+def test_host_prune_matches_device_prune(stack, casc):
+    impl = stack.impl
+    arrays = make_arrays(64, seed=7)
+    dev = impl._run(
+        stack.stage1, arrays, output_keys=(SCORE,), prune_k=16
+    )
+    # The jitted prune entry armed: survivor pairs + the stage-1 vector.
+    assert "survivor_indices" in dev and "survivor_scores" in dev
+    assert np.asarray(dev["survivor_indices"]).shape == (16,)
+
+    full = impl._run(stack.stage1, arrays, output_keys=(SCORE,))
+    h_idx, h_full = casc._finalize_prune(full, stack.stage1, 64, 16)
+    d_idx, d_full = casc._finalize_prune(dev, stack.stage1, 64, 16)
+    assert set(h_idx.tolist()) == set(d_idx.tolist())
+    np.testing.assert_array_equal(h_full, d_full)
+    # Only the full-vector path counts as a host prune.
+    assert casc.stats.host_prunes == 1
+
+
+# --------------------------------------------------- full-pass fallbacks
+
+
+def test_stage1_missing_full_fallback(stack):
+    impl = stack.impl
+    casc = CascadeOrchestrator(
+        stack.registry, stack.batcher, stage1_model="absent-retriever",
+    )
+    impl.cascade = casc
+    try:
+        arrays = make_arrays(64, seed=8)
+        resp, scores = _predict(impl, arrays)
+        stage = cascade_stage(resp)
+        # Full pass: every row ranked, honest provenance.
+        assert stage is not None and (stage == STAGE2).all()
+        full = impl._run(stack.dcn, arrays, output_keys=(SCORE,))[SCORE]
+        np.testing.assert_array_equal(scores, np.asarray(full, np.float32))
+        snap = casc.snapshot()
+        assert snap["fallbacks"] == 1 and snap["stage1_failures"] == 0
+    finally:
+        impl.cascade = None
+
+
+def test_stage1_failure_full_fallback(stack, casc, monkeypatch):
+    impl = stack.impl
+    orig = impl._run
+
+    def boom(servable, arrays, **kw):
+        if servable.name == "stage1":
+            raise RuntimeError("injected stage-1 device failure")
+        return orig(servable, arrays, **kw)
+
+    monkeypatch.setattr(impl, "_run", boom)
+    arrays = make_arrays(64, seed=9)
+    resp, scores = _predict(impl, arrays)
+    stage = cascade_stage(resp)
+    assert stage is not None and (stage == STAGE2).all()
+    full = orig(stack.dcn, arrays, output_keys=(SCORE,))[SCORE]
+    np.testing.assert_array_equal(scores, np.asarray(full, np.float32))
+    snap = casc.snapshot()
+    assert snap["fallbacks"] == 1 and snap["stage1_failures"] == 1
+
+
+# ------------------------------------------------------- cache-key salt
+
+
+def test_prune_submits_salt_the_request_digest():
+    """A prune result (survivor pairs) must never answer a full-vector
+    request from the score cache — the mode+k ride the digest itself."""
+    arrays = make_arrays(16, seed=11)
+    plain = features_digest(arrays)
+    assert features_digest(arrays, salt=b"prune:4") != plain
+    assert features_digest(arrays, salt=b"prune:4") != features_digest(
+        arrays, salt=b"prune:8"
+    )
+    # Deterministic per (features, salt).
+    assert features_digest(arrays, salt=b"prune:4") == features_digest(
+        arrays, salt=b"prune:4"
+    )
+
+
+# --------------------------------------------- build_stack wiring + refusals
+
+
+def _server_cfg(**over):
+    from distributed_tf_serving_tpu.utils.config import ServerConfig
+
+    return ServerConfig(
+        model_name="DCN", num_fields=F, buckets=(16, 64), warmup=False,
+        **over,
+    )
+
+
+def test_build_stack_refuses_output_top_k():
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import CascadeConfig
+
+    with pytest.raises(ValueError, match="output_top_k"):
+        build_stack(
+            _server_cfg(output_top_k=8), model_config=CFG,
+            cascade_config=CascadeConfig(enabled=True),
+        )
+
+
+def test_build_stack_refuses_mesh():
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import (
+        CascadeConfig,
+        MeshConfig,
+    )
+
+    with pytest.raises(ValueError, match=r"\[mesh\]"):
+        build_stack(
+            _server_cfg(), model_config=CFG,
+            mesh_config=MeshConfig(enabled=True, devices=8, model_parallel=2),
+            cascade_config=CascadeConfig(enabled=True),
+        )
+
+
+def test_build_stack_cascade_wiring_and_disabled_mode():
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import CascadeConfig
+
+    # Disabled (the default): one attribute, no stage-1 servable.
+    _r, b, impl, _s, _m, _w = build_stack(
+        _server_cfg(), model_config=CFG, cascade_config=CascadeConfig(),
+    )
+    try:
+        assert impl.cascade is None
+        assert impl.cascade_stats() is None
+        resp, _ = _predict(impl, make_arrays(16))
+        assert cascade_stage(resp) is None
+    finally:
+        b.stop()
+
+    reg, b, impl, _s, _m, _w = build_stack(
+        _server_cfg(), model_config=CFG,
+        cascade_config=CascadeConfig(enabled=True, survivor_fraction=0.25),
+    )
+    try:
+        # The demo stage-1 is a NORMAL registry entry under its own name.
+        assert "stage1" in reg.models()
+        snap = impl.cascade_stats()
+        assert snap is not None and snap["stage1_model"] == "stage1"
+        resp, _ = _predict(impl, make_arrays(16, seed=13))
+        stage = cascade_stage(resp)
+        assert stage is not None and int((stage == STAGE2).sum()) == 4
+    finally:
+        b.stop()
+
+
+# ------------------------------------------- stage-1 hot-swap mid-traffic
+
+
+def test_stage1_hot_swap_mid_traffic(tmp_path):
+    """The required rollout contract: the stage-1 model is watcher-managed
+    like any servable, a version flip lands mid-traffic without a single
+    failed request, and ripping stage-1 out entirely degrades every
+    in-flight cascade to a full ranking pass — never an error."""
+    registry = ServableRegistry()
+    registry.load(_dcn_servable())
+    batcher = DynamicBatcher(buckets=(16, 64), max_wait_us=0).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    impl.cascade = CascadeOrchestrator(
+        registry, batcher, stage1_model="stage1", survivor_fraction=0.25,
+    )
+    v1, _ = publish_stage1(str(tmp_path), _stage1_servable(seed=3),
+                           "two_tower")
+    watcher = VersionWatcher(
+        tmp_path, registry,
+        VersionWatcherConfig(
+            poll_interval_s=3600, model_name="stage1",
+            model_kind="two_tower",
+        ),
+    )
+    watcher.poll_once()
+    assert registry.resolve("stage1").version == v1
+
+    errors: list = []
+    ranked_counts: list = []
+    stop = threading.Event()
+    req = build_predict_request(
+        make_arrays(32, seed=17), "DCN", output_filter=(SCORE,)
+    )
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                resp = impl.predict(req)
+                st = cascade_stage(resp)
+                assert st is not None and st.shape == (32,)
+                ranked_counts.append(int((st == STAGE2).sum()))
+            except Exception as exc:  # noqa: BLE001 — the test's verdict
+                errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=traffic) for _ in range(2)]
+    for t in threads:
+        t.start()
+
+    def wait_more(n):
+        target = len(ranked_counts) + n
+        deadline = time.time() + 60
+        while (len(ranked_counts) < target and not errors
+               and time.time() < deadline):
+            time.sleep(0.005)
+        assert not errors, errors
+        assert len(ranked_counts) >= target
+
+    try:
+        wait_more(5)
+        # Hot-swap: publish v2 and poll while traffic flows.
+        v2, _ = publish_stage1(str(tmp_path), _stage1_servable(seed=4),
+                               "two_tower")
+        watcher.poll_once()
+        assert registry.resolve("stage1").version == v2
+        wait_more(5)
+        # Rip stage-1 out entirely: stale resolutions must fall back.
+        registry.unload("stage1")
+        wait_more(3)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        batcher.stop()
+
+    assert not errors, errors
+    snap = impl.cascade.snapshot()
+    assert snap["requests"] == len(ranked_counts)
+    # The cascade ran (8 of 32 ranked) before the unload, then degraded
+    # to full passes (32 of 32) — and nothing in between failed.
+    assert 8 in ranked_counts and 32 in ranked_counts
+    assert snap["fallbacks"] >= 3 and snap["stage1_failures"] == 0

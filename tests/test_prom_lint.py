@@ -236,6 +236,29 @@ def _fully_armed_text() -> str:
             "breaches": 2,
         },
     }
+    # Cascade plane (ISSUE 19, the fifteenth plane): the shape
+    # impl.cascade_stats() emits after mixed traffic — device prunes with
+    # one host fallback, a zero-survivor request, and two survivor
+    # bucket rungs.
+    cascade = {
+        "enabled": True,
+        "stage1_model": "stage1",
+        "requests": 55,
+        "fallbacks": 1,
+        "stage1_failures": 1,
+        "host_prunes": 2,
+        "zero_survivor_requests": 1,
+        "rows_requested": 56320,
+        "rows_ranked": 14080,
+        "survivor_rows": 14080,
+        "pruned_rows": 42240,
+        "survivor_fraction_observed": 0.25,
+        "rank_fraction": 0.25,
+        "stage1_seconds_total": 0.9,
+        "prune_seconds_total": 0.05,
+        "stage2_seconds_total": 1.4,
+        "survivor_buckets": {"256": 50, "1024": 5},
+    }
     return m.prometheus_text(
         stats,
         cache=cache.snapshot(),
@@ -250,6 +273,7 @@ def _fully_armed_text() -> str:
         mesh=mesh,
         elastic=elastic,
         fleet=fleet,
+        cascade=cascade,
     )
 
 
@@ -279,6 +303,10 @@ def test_fully_armed_snapshot_passes_lint():
         "dts_tpu_fleet_agg_members_degraded",
         "dts_tpu_slo_burn_rate", "dts_tpu_slo_budget_remaining",
         "dts_tpu_slo_breached", "dts_tpu_slo_breaches_total",
+        "dts_tpu_cascade_", "dts_tpu_cascade_rows_total",
+        "dts_tpu_cascade_stage_seconds_total",
+        "dts_tpu_cascade_survivor_bucket_total",
+        "dts_tpu_cascade_rank_fraction",
     ):
         assert marker in text
 

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Gate for the tier-1 cascade smoke (tools/ci_tier1.sh
+TIER1_CASCADE_SMOKE=1).
+
+Reads the SOAK_CASCADE=1 soak's JSON line and asserts the multi-stage
+cascade's acceptance conditions (ISSUE 19): NONZERO pruned rows from the
+worker traffic (workload counters — probe counts subtracted),
+rows_ranked/rows_requested strictly under 0.5 at the 25% survivor
+fraction (the cascade must actually save full-model work), the
+bit-identity probe reporting a match (survivor scores byte-equal to a
+full-pass reference, pruned rows byte-equal to stage-1-only), zero gRPC
+errors, the cascade spans + /cascadez + dts_tpu_cascade_* Prometheus
+series live, and zero fallbacks (a healthy stage-1 must never be
+bypassed). Exits nonzero with a reason otherwise, so CI fails with
+evidence instead of a silent green.
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tier1_cascade_soak.json"
+    lines = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    continue
+    if not lines:
+        print(f"cascade smoke: no JSON line in {path}", file=sys.stderr)
+        return 1
+    line = lines[-1]
+    casc = line.get("cascade") or {}
+    problems = []
+    if casc.get("workload_pruned_rows", 0) <= 0:
+        problems.append(
+            f"zero workload pruned rows (cascade block: {casc})"
+        )
+    req = casc.get("workload_rows_requested", 0)
+    ranked = casc.get("workload_rows_ranked", 0)
+    if req <= 0:
+        problems.append("zero rows entered the cascade")
+    elif ranked / req >= 0.5:
+        problems.append(
+            f"rank_fraction {ranked}/{req} = {ranked / req:.3f} >= 0.5: "
+            "the cascade saved no full-model work at survivor_fraction "
+            "0.25"
+        )
+    if casc.get("scores_match") is not True:
+        problems.append(
+            f"scores_match != True (got {casc.get('scores_match')!r}): "
+            "cascade survivor/pruned scores are not bit-identical to the "
+            "full-pass / stage-1-only references"
+        )
+    if casc.get("fallbacks", 0):
+        problems.append(
+            f"{casc.get('fallbacks')} full-pass fallbacks with a healthy "
+            "stage-1 (stage1_failures="
+            f"{casc.get('stage1_failures')})"
+        )
+    if casc.get("cascadez_live") is not True:
+        problems.append(
+            f"/cascadez probe not live (got {casc.get('cascadez_live')!r})"
+        )
+    if casc.get("prometheus_series", 0) <= 0:
+        problems.append("no dts_tpu_cascade_* Prometheus series")
+    if casc.get("spans_present") is not True:
+        problems.append(
+            "cascade.stage1/cascade.prune/cascade.stage2 spans missing "
+            "from the phase surface"
+        )
+    if line.get("grpc_err", 0):
+        problems.append(
+            f"gRPC errors during the cascade soak: {line.get('grpc_err')}"
+        )
+    if problems:
+        for p in problems:
+            print(f"cascade smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print(
+        "cascade smoke ok: rows_ranked/rows_requested={}/{} ({:.3f}) "
+        "pruned={} host_prunes={} survivor_buckets={} scores_match={} "
+        "prom_series={}".format(
+            ranked, req, ranked / req if req else 0.0,
+            casc.get("workload_pruned_rows"), casc.get("host_prunes"),
+            casc.get("survivor_buckets"), casc.get("scores_match"),
+            casc.get("prometheus_series"),
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

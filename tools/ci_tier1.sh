@@ -261,4 +261,23 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_FLEETOBS_SMOKE:-0}" = "1" ]; then
     python tools/check_trace.py "$FLEETOBS_TRACE" --min-events 10 \
         --require-multi-pid || rc=1
 fi
+
+# Cascade smoke (TIER1_CASCADE_SMOKE=1, ISSUE 19): a short SOAK_CASCADE=1
+# soak — every score-filtered gRPC request runs retrieval->rank through
+# the two-executable cascade (two_tower stage 1, on-device prune to 25%
+# survivors, DCN over the survivor rung) — must report nonzero pruned
+# rows, rows_ranked/rows_requested < 0.5, survivor scores bit-identical
+# to a full-pass reference, zero gRPC errors, zero fallbacks, and the
+# /cascadez + dts_tpu_cascade_* + cascade-span surfaces live
+# (tools/check_cascade_smoke.py). Default candidates (1000): the prune
+# must actually cross rungs (1024 -> 256).
+if [ "$rc" -eq 0 ] && [ "${TIER1_CASCADE_SMOKE:-0}" = "1" ]; then
+    CASCADE_LINE="${TIER1_CASCADE_LINE:-/tmp/tier1_cascade_soak.json}"
+    echo "tier1: cascade smoke (SOAK_CASCADE=1, line $CASCADE_LINE)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_SMOKE_SECONDS:-8}" SOAK_CASCADE=1 \
+        SOAK_GRPC_WORKERS=4 SOAK_REST_WORKERS=1 \
+        python tools/soak.py | tee "$CASCADE_LINE" || rc=1
+    python tools/check_cascade_smoke.py "$CASCADE_LINE" || rc=1
+fi
 exit $rc

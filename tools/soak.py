@@ -35,6 +35,14 @@ SOAK_ROWCACHE=1 (cache mode plus the ROW-GRANULAR cache, ISSUE 14: only
 cold rows execute; adds a `row_cache` block with per-row hit/miss
 counters, rows_executed vs rows_requested, and a row-path bit-identity
 probe — the TIER1_ROWCACHE_SMOKE gate reads it),
+SOAK_CASCADE=1 (multi-stage cascade armed, ISSUE 19: a two_tower stage-1
+servable joins the registry, every score-filtered gRPC request runs
+retrieval->rank through serving/cascade.py — stage-1 full batch,
+on-device prune to 25% survivors, DCN over the survivor rung only — with
+a pre-flight bit-identity probe (cascade survivor scores vs a full-pass
+reference; pruned rows vs stage-1-only) and live /cascadez + Prometheus
++ phase-span probes; the JSON line gains a `cascade` block the
+TIER1_CASCADE_SMOKE gate reads),
 SOAK_REQUEST_LOG_SAMPLING (default 0 = logging off; >0 stresses the
 bounded-queue request logger under the mixed load — note it adds a
 SerializeToString per sampled request, so A/Bs against logging-off soaks
@@ -972,6 +980,14 @@ def main() -> None:
     # bisection must fail exactly the poison with its distinct status
     # while the companions replay to success).
     recovery_mode = os.environ.get("SOAK_RECOVERY", "0") == "1"
+    # Cascade mode (SOAK_CASCADE=1): multi-stage retrieval->rank through
+    # serving/cascade.py on every score-filtered gRPC request — stage-1
+    # two_tower over the full candidate batch, on-device prune to 25%
+    # survivors, DCN over the survivor rung only. A pre-flight probe
+    # pins bit-identity (survivor scores vs a full-pass reference,
+    # pruned rows vs stage-1-only), and the JSON line gains a `cascade`
+    # block with row dispositions + live-route probe results.
+    cascade_mode = os.environ.get("SOAK_CASCADE", "0") == "1"
     if quality_mode or lifecycle_mode:
         candidates = int(os.environ.get("SOAK_CANDIDATES", "16"))
         grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "4"))
@@ -1174,6 +1190,11 @@ def main() -> None:
         # through the queue, and the recovery cycle must finish inside
         # the client retry horizon.
         buckets = (256,)
+    elif cascade_mode:
+        # A survivor rung BELOW the candidate rung: the cascade's win is
+        # stage-2 traffic landing in the smaller bucket (25% of 1000
+        # candidates packs into 256), so the ladder must carry one.
+        buckets = (256, 1024, 2048) if tpu else (256, 1024)
     else:
         buckets = (1024, 2048, 4096, 8192, 16384) if tpu else (1024, 2048)
     batcher_kw = {}
@@ -1424,6 +1445,60 @@ def main() -> None:
             k: score_cache.snapshot()[k]
             for k in ("hits", "misses", "coalesced")
         }
+    cascade_block: dict = {}
+    if cascade_mode:
+        import dataclasses
+
+        from distributed_tf_serving_tpu.models import build_model
+        from distributed_tf_serving_tpu.serving.cascade import (
+            STAGE2,
+            CascadeOrchestrator,
+        )
+
+        # The stage-1 servable is an ordinary registry entry under its
+        # own name — exactly how build_stack publishes it — scored over
+        # the candidate rung(s) while stage 2 runs the survivor rung.
+        s1_config = dataclasses.replace(config, name="stage1")
+        s1_model = build_model("two_tower", s1_config)
+        s1_params = jax.jit(s1_model.init)(jax.random.PRNGKey(3))
+        stage1 = Servable(
+            name="stage1", version=1, model=s1_model, params=s1_params,
+            signatures=ctr_signatures(NUM_FIELDS),
+        )
+        registry.load(stage1)
+        for b in buckets[1:]:
+            batcher.warmup(stage1, buckets=(b,))
+        impl.cascade = CascadeOrchestrator(
+            registry, batcher, stage1_model="stage1",
+            survivor_fraction=0.25,
+        )
+        # Pre-flight bit-identity probe (the gate's correctness bar):
+        # the cascade's survivor rows must be byte-equal to the SAME
+        # rows of a cascade-off full pass, and its pruned rows
+        # byte-equal to a stage-1-only pass — or the cascade is
+        # changing answers, not saving work.
+        probe = unique_pool[0]
+        sk = servable.model.score_output
+        s1k = s1_model.score_output
+        out = impl.cascade.run(impl, servable, probe, (sk,), None, None)
+        ref = impl._run(servable, probe, output_keys=(sk,))
+        ref1 = impl._run(stage1, probe, output_keys=(s1k,))
+        surv = out["cascade_stage"] == STAGE2
+        cascade_block["scores_match"] = bool(
+            np.array_equal(out[sk][surv], ref[sk][surv])
+            and np.array_equal(
+                out[sk][~surv], ref1[s1k].astype(np.float32)[~surv]
+            )
+        )
+        # Counter baseline AFTER the probe: the gate reads workload
+        # deltas, so the probe's guaranteed prune can never green-wash
+        # a cascade idle under load.
+        cascade_block["probe_snapshot"] = {
+            k: impl.cascade.snapshot()[k]
+            for k in ("requests", "rows_requested", "rows_ranked",
+                      "pruned_rows")
+        }
+
     rest_cols = {
         "feat_ids": wide["feat_ids"][:64].tolist(),
         "feat_wts": wide["feat_wts"][:64].tolist(),
@@ -1931,6 +2006,29 @@ def main() -> None:
             if ln.startswith("dts_tpu_utilization_")
         )
 
+    async def probe_cascade(session) -> None:
+        """Probe the LIVE cascade surfaces (the same bytes an operator's
+        curl would get): /cascadez liveness + moving counters, the
+        dts_tpu_cascade_* Prometheus series count, and the cascade phase
+        spans in /monitoring?section=phases."""
+        async with session.get("/cascadez") as r:
+            body = await r.json()
+            cascade_block["cascadez_live"] = (
+                r.status == 200 and body.get("requests", 0) > 0
+            )
+        async with session.get("/monitoring/prometheus/metrics") as r:
+            text = await r.text()
+        cascade_block["prometheus_series"] = sum(
+            1 for ln in text.splitlines()
+            if ln.startswith("dts_tpu_cascade_")
+        )
+        async with session.get("/monitoring?section=phases") as r:
+            phases = (await r.json()).get("phases") or {}
+        cascade_block["spans_present"] = all(
+            p in phases
+            for p in ("cascade.stage1", "cascade.prune", "cascade.stage2")
+        )
+
     async def export_trace(session) -> None:
         """Probe the LIVE /tracez surface (the same bytes an operator's
         curl would get) and persist the Chrome trace artifact."""
@@ -2089,6 +2187,11 @@ def main() -> None:
                             await probe_recovery(session)
                         except Exception as e:  # noqa: BLE001 — report, keep line
                             recovery_block["error"] = f"{type(e).__name__}: {e}"
+                    if cascade_mode:
+                        try:
+                            await probe_cascade(session)
+                        except Exception as e:  # noqa: BLE001 — report, keep line
+                            cascade_block["error"] = f"{type(e).__name__}: {e}"
                     if trace_out:
                         try:
                             await export_trace(session)
@@ -2281,6 +2384,26 @@ def main() -> None:
         # bisection evidence with live-route probes — the CI gate
         # (tools/check_recovery_smoke.py) reads this.
         "recovery": recovery_block if recovery_mode else None,
+        # Cascade plane (SOAK_CASCADE=1): the full snapshot (row
+        # dispositions, per-stage seconds, survivor-bucket histogram)
+        # plus the bit-identity probe verdict, live-route probe results,
+        # and workload-only deltas (probe counts subtracted) — the CI
+        # gate (tools/check_cascade_smoke.py) reads this.
+        "cascade": (
+            {
+                **impl.cascade.snapshot(),
+                **cascade_block,
+                **{
+                    f"workload_{k}": (
+                        impl.cascade.snapshot()[k]
+                        - cascade_block.get("probe_snapshot", {}).get(k, 0)
+                    )
+                    for k in ("requests", "rows_requested", "rows_ranked",
+                              "pruned_rows")
+                },
+            }
+            if cascade_mode else None
+        ),
         "chaos": None,
         "input_cache": (
             {
