@@ -109,6 +109,15 @@ class ResilienceCounters:
     # int8 score response wire (ISSUE 12): responses whose score tensor
     # arrived as DT_INT8 + sidecars and was dequantized locally.
     int8_responses: int = 0
+    # Integrity plane (ISSUE 20): responses whose score tensor failed
+    # the x-dts-score-crc verify — caught BEFORE the merge, recorded
+    # kind="corrupt" on the scoreboard, retried on another backend.
+    corrupt_responses: int = 0
+    # NaN scores encountered by the ranking sort and pushed to the
+    # deterministic worst-rank tail instead of floating arbitrarily
+    # through the comparison order (defense in depth for unscreened
+    # backends).
+    nan_scores_merged: int = 0
 
 
 class _AttemptBudget:
@@ -173,6 +182,26 @@ _RETRY_BUDGET_KEY = "x-dts-retry-budget"
 # Initial-metadata key traced servers answer with so client.rpc spans can
 # label the resolved peer (router vs replica) — ISSUE 18 satellite.
 _PEER_ROLE_KEY = "x-dts-peer-role"
+
+# Integrity-plane wire checksums (ISSUE 20; serving/integrity.py repeats
+# these — the jax-free-import rationale again): the client stamps
+# per-input CRC32C sidecars on requests, the server stamps score-tensor
+# checksums on responses for opted-in clients to verify before merge.
+_INPUT_CRC_KEY = codec.CRC_INPUT_MD
+_SCORE_CRC_KEY = codec.CRC_SCORE_MD
+
+
+def _flip_tensor_bytes(tp) -> None:
+    """Deterministic wire corruption (the wire_corrupt fault site): flip
+    one payload bit of a TensorProto so the CRC verify on the receiving
+    end MUST catch it — the shape/dtype stay valid, only the value
+    changes (the silent-corruption scenario, not a decode error)."""
+    if tp.tensor_content:
+        buf = bytearray(tp.tensor_content)
+        buf[len(buf) // 2] ^= 0x01
+        tp.tensor_content = bytes(buf)
+    elif len(tp.float_val):
+        tp.float_val[0] = tp.float_val[0] + 1.0
 
 
 # Per-request override channel (ISSUE 17): the fleet router serves many
@@ -397,6 +426,7 @@ class ShardedPredictClient:
         max_attempts_total: int = 0,
         score_wire_int8: bool = False,
         placement: str = "contiguous",
+        integrity_checksums: bool = False,
     ):
         if not hosts:
             raise ValueError("need at least one backend host")
@@ -515,6 +545,18 @@ class ShardedPredictClient:
         # against a server with [kernels] int8_score_wire on; servers
         # without the plane ignore the metadata and answer normally.
         self.score_wire_int8 = bool(score_wire_int8)
+        # Integrity wire checksums (ISSUE 20): stamp x-dts-input-crc
+        # CRC32C sidecars over each shard's tensor bytes (an
+        # [integrity]-armed server verifies at decode and fails ONLY the
+        # corrupted request), and verify the server's x-dts-score-crc
+        # response stamps BEFORE the merge — a mismatch is recorded
+        # kind="corrupt" on the scoreboard (steer + failover, never
+        # ejection on the first hit) and the shard retries elsewhere.
+        # Message-path predict() only; prepared-bytes requests skip the
+        # input stamp (their bytes are frozen at prepare()) but still
+        # verify responses. Servers without the plane ignore the
+        # metadata and stamp nothing — both directions are advisory.
+        self.integrity_checksums = bool(integrity_checksums)
         self._first_score_ms: list[float] = []
         # Per-backend rolling latency windows (ISSUE 18: the router's
         # /monitoring parity surface). None until enable_backend_windows
@@ -606,7 +648,7 @@ class ShardedPredictClient:
 
     async def _one_rpc(
         self, i: int, rr: int, host_idx: int, invoke,
-        attempt: int = 0, hedge: bool = False,
+        attempt: int = 0, hedge: bool = False, extra_md: tuple = (),
     ):
         """One attempt on one backend: fault site, scoreboard recording,
         error tagging. Raises _ShardAttemptError on failure. When tracing
@@ -644,6 +686,7 @@ class ShardedPredictClient:
                 md.append((_RETRY_BUDGET_KEY, str(self.max_attempts_total)))
             if self.score_wire_int8:
                 md.append((_SCORE_WIRE_KEY, "int8"))
+            md.extend(extra_md)
             metadata = tuple(md) or None
             t0 = time.perf_counter()
             try:
@@ -774,12 +817,75 @@ class ShardedPredictClient:
                 raise _ShardAttemptError(
                     host_idx, code, e.details(), retry_after_ms=retry_after_ms
                 ) from e
+            if self.integrity_checksums and hasattr(resp, "outputs"):
+                # Response-direction wire integrity (ISSUE 20): verify
+                # the server's score-CRC stamp BEFORE this shard's array
+                # reaches the merge. Raises _ShardAttemptError
+                # (UNAVAILABLE — a reroutable status) on mismatch, so
+                # the failover loop retries the shard elsewhere; the
+                # scoreboard takes the kind="corrupt" verdict inside.
+                resp = await self._verify_response_integrity(
+                    call, resp, host_idx
+                )
             elapsed = time.perf_counter() - t0
             if self.scoreboard is not None:
                 self.scoreboard.record_success(host_idx, elapsed)
             if self._backend_windows is not None:
                 self._backend_windows[host].record(elapsed)
             return resp
+
+    async def _verify_response_integrity(self, call, resp, host_idx: int):
+        """Verify the x-dts-score-crc trailing-metadata stamp against the
+        response's decoded tensor bytes. Absent stamp = server without
+        the plane: advisory, pass through. Mismatch (or a payload that no
+        longer decodes) = corrupt response: counted, recorded
+        kind="corrupt", raised as a reroutable _ShardAttemptError."""
+        # Named fault site (faults.py): response-direction wire
+        # corruption — one payload bit of the score tensor flips AFTER
+        # the server stamped its checksum, exactly what a bad NIC/switch
+        # would do. key="response" distinguishes the direction from the
+        # request-side per-input-name keys.
+        if faults.active() and faults.get().has_site("wire_corrupt"):
+            try:
+                faults.fire("wire_corrupt", key="response")
+            except faults.InjectedFaultError:
+                if self.output_key in resp.outputs:
+                    _flip_tensor_bytes(resp.outputs[self.output_key])
+        sidecar = None
+        get_trailing = getattr(call, "trailing_metadata", None)
+        if get_trailing is not None:
+            try:
+                for k, v in (await get_trailing()) or ():
+                    if k == _SCORE_CRC_KEY and isinstance(v, str):
+                        sidecar = v
+                        break
+            except Exception:  # noqa: BLE001 — advisory metadata
+                sidecar = None
+        if not sidecar:
+            return resp
+        bad: list[str]
+        try:
+            stamped = codec.parse_crc_sidecar(sidecar)
+            decoded = {
+                name: codec.to_ndarray(resp.outputs[name])
+                for name in stamped if name in resp.outputs
+            }
+            bad = codec.verify_crc_sidecar(decoded, sidecar)
+        except codec.CodecError as e:
+            # A stamped tensor that no longer decodes (or a mangled
+            # sidecar) IS corruption — it must fail the verify, never
+            # pass it.
+            bad = [f"undecodable: {e}"]
+        if bad:
+            self.counters.corrupt_responses += 1
+            if self.scoreboard is not None:
+                self.scoreboard.record_failure(host_idx, kind="corrupt")
+            raise _ShardAttemptError(
+                host_idx, grpc.StatusCode.UNAVAILABLE,
+                f"corrupt response: score checksum mismatch on {bad} "
+                "(integrity wire verify)",
+            )
+        return resp
 
     def _hedge_target(self, used: list[int]) -> int | None:
         """Extra host for a hedged attempt: the scoreboard's best healthy
@@ -821,6 +927,7 @@ class ShardedPredictClient:
     async def _attempt(
         self, i: int, rr: int, host_idx: int, invoke, used: list[int],
         attempt: int = 0, budget: "_AttemptBudget | None" = None,
+        extra_md: tuple = (),
     ):
         """One failover attempt, optionally hedged: the primary RPC runs on
         `host_idx`; after hedge_delay_s without an answer a second attempt
@@ -833,9 +940,13 @@ class ShardedPredictClient:
             # cancellation (gather's sibling-cancel on another shard's
             # failure, a caller timeout) cancels the RPC itself instead of
             # orphaning a detached task.
-            return await self._one_rpc(i, rr, host_idx, invoke, attempt=attempt)
+            return await self._one_rpc(
+                i, rr, host_idx, invoke, attempt=attempt, extra_md=extra_md
+            )
         primary = asyncio.ensure_future(
-            self._one_rpc(i, rr, host_idx, invoke, attempt=attempt)
+            self._one_rpc(
+                i, rr, host_idx, invoke, attempt=attempt, extra_md=extra_md
+            )
         )
         tasks: dict = {primary: host_idx}
         try:
@@ -856,7 +967,7 @@ class ShardedPredictClient:
                     hedge = asyncio.ensure_future(
                         self._one_rpc(
                             i, rr, hedge_idx, invoke,
-                            attempt=attempt, hedge=True,
+                            attempt=attempt, hedge=True, extra_md=extra_md,
                         )
                     )
                     tasks[hedge] = hedge_idx
@@ -953,7 +1064,8 @@ class ShardedPredictClient:
             self.scoreboard.note_retry_budget_exhausted()
 
     async def _shard_call(
-        self, i: int, rr: int, invoke, extract=None, budget=None
+        self, i: int, rr: int, invoke, extract=None, budget=None,
+        extra_md: tuple = (),
     ) -> np.ndarray:
         """One shard's RPC with failover: `invoke(stub, metadata)` issues
         the call on the chosen stub (message path uses stub.Predict,
@@ -968,10 +1080,13 @@ class ShardedPredictClient:
         on, the shard gets a span whose children are the individual
         attempts (failover hops and hedges as siblings)."""
         with tracing.start_span("client.shard", attrs={"shard": i}):
-            return await self._shard_call_impl(i, rr, invoke, extract, budget)
+            return await self._shard_call_impl(
+                i, rr, invoke, extract, budget, extra_md
+            )
 
     async def _shard_call_impl(
-        self, i: int, rr: int, invoke, extract=None, budget=None
+        self, i: int, rr: int, invoke, extract=None, budget=None,
+        extra_md: tuple = (),
     ) -> np.ndarray:
         n = len(self.hosts)
         used: list[int] = []
@@ -1095,7 +1210,7 @@ class ShardedPredictClient:
                         continue
                 resp = await self._attempt(
                     i, rr, host_idx, invoke, used, attempt=attempt,
-                    budget=budget,
+                    budget=budget, extra_md=extra_md,
                 )
             except asyncio.CancelledError:
                 if self.scoreboard is not None:
@@ -1169,12 +1284,28 @@ class ShardedPredictClient:
             version_label=self.version_label,
             use_tensor_content=self.use_tensor_content,
         )
+        extra_md: tuple = ()
+        if self.integrity_checksums:
+            # Stamp the CRC32C sidecar over the shard's TRUE tensor
+            # bytes first; the fault site below then corrupts the
+            # encoded proto AFTER stamping — exactly the wire-flip
+            # ordering the server-side verify exists to catch. key is
+            # the input tensor name, so a rule can corrupt one input of
+            # a multi-tensor request.
+            extra_md = ((_INPUT_CRC_KEY, codec.crc_sidecar(shard)),)
+            if faults.active() and faults.get().has_site("wire_corrupt"):
+                for name in list(req.inputs):
+                    try:
+                        faults.fire("wire_corrupt", key=name)
+                    except faults.InjectedFaultError:
+                        _flip_tensor_bytes(req.inputs[name])
         return await self._shard_call(
             i, rr,
             lambda stub, metadata=None: stub.Predict(
                 req, timeout=self._rpc_timeout(), metadata=metadata
             ),
             budget=budget,
+            extra_md=extra_md,
         )
 
     async def _fan_out(
@@ -1210,16 +1341,34 @@ class ShardedPredictClient:
                 raise
         return self._merge(list(results), sort_scores)
 
-    @staticmethod
-    def _merge(results: list, sort_scores: bool, degraded: bool = False):
+    def _merge(self, results: list, sort_scores: bool, degraded: bool = False):
         """ONE merge+optional-sort implementation (traced as client.merge)
         for the full and partial fan-out paths."""
         attrs = {"degraded": True} if degraded else None
         with tracing.start_span("client.merge", attrs=attrs):
             merged = merge_host_order(results)
             if sort_scores:
-                merged = np.sort(merged)
+                merged = self._rank_sort(merged)
         return merged
+
+    def _rank_sort(self, merged: np.ndarray) -> np.ndarray:
+        """Ranking sort with NaN pinned deterministically to the WORST
+        end (ISSUE 20 satellite). np.sort puts NaN LAST in ascending
+        order — the best-rank position under the Collections.sort-parity
+        read (best scores at the end) — so an unscreened backend's NaN
+        would silently outrank every real score. Real scores sort
+        ascending as before (bit-identical when no NaN is present); NaNs
+        land at the head, counted in nan_scores_merged."""
+        if merged.dtype.kind == "f":
+            nan = np.isnan(merged)
+            if nan.any():
+                k = int(nan.sum())
+                self.counters.nan_scores_merged += k
+                return np.concatenate([
+                    np.full(k, np.nan, merged.dtype),
+                    np.sort(merged[~nan]),
+                ])
+        return np.sort(merged)
 
     @staticmethod
     def _screen_shard_failures(results: list) -> list[int]:
@@ -1421,7 +1570,7 @@ class ShardedPredictClient:
                 merged = np.empty((n,) + vals.shape[1:], vals.dtype)
                 merged[idx] = vals
             if sort_scores:
-                merged = np.sort(merged)
+                merged = self._rank_sort(merged)
         if not failed:
             if self.partial_results:
                 return PredictResult(scores=merged)
@@ -1706,6 +1855,7 @@ def client_from_config(cfg) -> ShardedPredictClient:
         criticality=cfg.criticality,
         max_attempts_total=cfg.max_attempts_total,
         placement=cfg.placement,
+        integrity_checksums=getattr(cfg, "integrity_checksums", False),
     )
 
 

@@ -86,6 +86,19 @@ class ScoreboardConfig:
     # replacement process. Never consumes the ejection budget and never
     # cycles the rebuilding_busy_s retry window.
     draining_probe_s: float = 3.0
+    # How long a corrupt-response verdict (kind="corrupt" — the
+    # integrity plane's CRC verify caught a response whose score bytes
+    # mismatch their stamped checksum, ISSUE 20) biases steering away.
+    # Sized to the server's own shadow-verification / recovery reaction
+    # window: long enough for the replica's self-check to run, short
+    # enough that one cosmic-ray flip does not exile a healthy host.
+    corrupt_busy_s: float = 2.0
+    # CONSECUTIVE corrupt verdicts (no intervening clean success) before
+    # further ones count as ordinary failures: a single flipped bit is
+    # noise, a host that keeps serving mismatched bytes has a sick data
+    # path and must walk the eject-with-doubling machinery — but never
+    # on the first hit (the ISSUE 20 contract).
+    corrupt_streak_limit: int = 3
 
 
 @dataclasses.dataclass
@@ -114,6 +127,12 @@ class _HostState:
     # down. State flips to DRAINING — skipped by steering outright —
     # with no ejection budget spent and no rebuilding streak cycled.
     drains: int = 0
+    # Corrupt-response verdicts (ISSUE 20): the host ANSWERED but its
+    # score bytes failed the integrity CRC verify. Busy-biased steering
+    # like pushback; the consecutive streak (reset by any clean
+    # success) bounds how long before ordinary ejection takes over.
+    corruptions: int = 0
+    consecutive_corruptions: int = 0
 
 
 class BackendScoreboard:
@@ -152,6 +171,10 @@ class BackendScoreboard:
         # storm-suppression evidence next to the ejection counters it
         # guards against amplifying.
         self.retry_budget_exhausted = 0
+        # Corrupt-response verdicts (ISSUE 20): integrity CRC verify
+        # failures recorded as kind="corrupt" — busy-biased steering,
+        # never ejection on the first hit.
+        self.corruptions = 0
 
     # ------------------------------------------------------------ recording
 
@@ -161,6 +184,7 @@ class BackendScoreboard:
             st.successes += 1
             st.consecutive_failures = 0
             st.consecutive_rebuilds = 0
+            st.consecutive_corruptions = 0
             if latency_s is not None:
                 ms = latency_s * 1e3
                 a = self.config.ewma_alpha
@@ -205,7 +229,15 @@ class BackendScoreboard:
         an alternative exists), the ejection budget is untouched, and
         the rebuilding busy window is never cycled. After
         draining_probe_s, half-open probing checks whether a restarted
-        process took over the address."""
+        process took over the address.
+        kind="corrupt": the backend ANSWERED but its response failed the
+        integrity plane's CRC verify (ISSUE 20) — alive with a suspect
+        data path. Busy-biased steering for corrupt_busy_s (the
+        pushback pattern: NEVER ejection on the first hit — one flipped
+        bit must not exile a healthy host), while the consecutive
+        streak (reset by any clean success) hands a host that KEEPS
+        serving mismatched bytes to the ordinary eject-with-doubling
+        machinery past corrupt_streak_limit."""
         with self._lock:
             st = self._states[idx]
             if kind == "draining":
@@ -251,6 +283,36 @@ class BackendScoreboard:
                     st.current_ejection_s = 0.0
                     self.recoveries += 1
                 return
+            if kind == "corrupt":
+                if st.consecutive_corruptions >= \
+                        self.config.corrupt_streak_limit:
+                    # The host keeps serving bytes that fail the CRC
+                    # verify with no clean answer in between: a sick
+                    # data path, not a cosmic ray. Fall through to the
+                    # ordinary failure path so eject-with-doubling
+                    # bounds further exposure.
+                    kind = "failure"
+                else:
+                    st.corruptions += 1
+                    st.consecutive_corruptions += 1
+                    self.corruptions += 1
+                    busy = (
+                        retry_after_s if retry_after_s is not None
+                        else self.config.corrupt_busy_s
+                    )
+                    st.busy_until = max(st.busy_until, self._clock() + busy)
+                    # The mismatched answer still PROVES the host
+                    # answers: the failure streak is over, and an
+                    # ejected/half-open host recovers to HEALTHY (busy)
+                    # — the integrity verdict steers, the ejection
+                    # machinery only takes over past the streak limit.
+                    st.consecutive_failures = 0
+                    if st.state != HEALTHY:
+                        st.state = HEALTHY
+                        st.probe_inflight = False
+                        st.current_ejection_s = 0.0
+                        self.recoveries += 1
+                    return
             if kind == "pushback":
                 st.pushbacks += 1
                 self.pushbacks += 1
@@ -408,6 +470,7 @@ class BackendScoreboard:
                 "pushbacks": self.pushbacks,
                 "rebuilds": self.rebuilds,
                 "drains": self.drains,
+                "corruptions": self.corruptions,
                 "retry_budget_exhausted": self.retry_budget_exhausted,
                 "backends": {
                     host: {
@@ -419,6 +482,7 @@ class BackendScoreboard:
                         "pushbacks": st.pushbacks,
                         "rebuilds": st.rebuilds,
                         "drains": st.drains,
+                        "corruptions": st.corruptions,
                         "busy": st.busy_until > now,
                     }
                     for host, st in zip(self.hosts, self._states)

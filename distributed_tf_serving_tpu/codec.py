@@ -220,6 +220,93 @@ def dequantize_response_output(outputs_map, key: str) -> np.ndarray:
     return to_ndarray(tp)
 
 
+# ---------------------------------------------------- wire integrity (CRC)
+#
+# ISSUE 20: CRC32C (Castagnoli — the polynomial every storage/RPC stack
+# uses for exactly this job) sidecars over tensor bytes, stamped into
+# gRPC metadata on both directions so silent wire corruption is DETECTED
+# instead of served. Both ends checksum the same canonical form — the
+# DECODED ndarray's dtype/shape header + contiguous payload bytes — so
+# the check is encoding-independent (tensor_content, repeated fields,
+# and the int8 score wire all verify identically). Lives here because
+# this module is the one tensor-bytes authority both the client package
+# (jax-free) and the server share.
+
+try:  # C-speed when the wheel is present; the table fallback keeps the
+    # client package dependency-free (same rationale as staying jax-free).
+    import google_crc32c as _crc32c_native
+except ImportError:  # pragma: no cover - exercised only without the wheel
+    _crc32c_native = None
+
+_CRC32C_POLY = 0x82F63B78
+_CRC32C_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (_CRC32C_POLY if _c & 1 else 0)
+    _CRC32C_TABLE.append(_c)
+del _i, _c
+
+CRC_INPUT_MD = "x-dts-input-crc"
+CRC_SCORE_MD = "x-dts-score-crc"
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C over a bytes-like; pass a prior value to chain."""
+    if _crc32c_native is not None:
+        return _crc32c_native.extend(crc, bytes(data))
+    crc ^= 0xFFFFFFFF
+    table = _CRC32C_TABLE
+    for b in bytes(data):
+        crc = (crc >> 8) ^ table[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def ndarray_crc(arr: np.ndarray) -> int:
+    """Canonical tensor checksum: dtype/shape header chained with the
+    contiguous payload bytes, so a flipped shape dim is as detectable as
+    a flipped payload bit."""
+    a = np.ascontiguousarray(arr)
+    head = f"{a.dtype.str}:{a.shape}".encode()
+    return crc32c(a.tobytes(), crc32c(head))
+
+
+def crc_sidecar(arrays: dict) -> str:
+    """Encode per-tensor checksums as one metadata value:
+    ``name=%08x`` pairs joined by commas, name order sorted so the
+    sidecar is deterministic regardless of map iteration order."""
+    return ",".join(
+        f"{name}={ndarray_crc(arrays[name]):08x}" for name in sorted(arrays)
+    )
+
+
+def parse_crc_sidecar(value: str) -> dict[str, int]:
+    """Inverse of crc_sidecar. Malformed entries raise CodecError — a
+    corrupted SIDECAR must fail the integrity check, not pass it."""
+    out: dict[str, int] = {}
+    for pair in filter(None, (p.strip() for p in value.split(","))):
+        name, sep, hexcrc = pair.rpartition("=")
+        if not sep or not name:
+            raise CodecError(f"malformed crc sidecar entry {pair!r}")
+        try:
+            out[name] = int(hexcrc, 16)
+        except ValueError as e:
+            raise CodecError(f"malformed crc sidecar entry {pair!r}") from e
+    return out
+
+
+def verify_crc_sidecar(arrays: dict, sidecar: str) -> list[str]:
+    """Names whose decoded bytes mismatch their stamped checksum.
+    Names stamped but absent from `arrays` are reported too (a dropped
+    tensor is corruption); names present but unstamped are NOT (the
+    sidecar may cover a subset, e.g. score-only response stamping)."""
+    stamped = parse_crc_sidecar(sidecar)
+    return sorted(
+        name for name, want in stamped.items()
+        if name not in arrays or ndarray_crc(arrays[name]) != want
+    )
+
+
 class EncodeArena:
     """Preallocated encode scratch (ISSUE 9 transport satellite).
 

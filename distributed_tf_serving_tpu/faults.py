@@ -40,6 +40,25 @@ Named sites (the instrumented hooks):
                         next to ``readback``): the executor aborted after
                         dispatch — the recovery plane classifies it
                         device-fatal exactly like device_lost
+- ``wire_corrupt``      request-tensor bytes flipped in flight (client
+                        _one_rpc, after CRC stamping — the checksum
+                        describes the ORIGINAL bytes, so the server-side
+                        verify must catch it). ``error`` kind; ``key`` is
+                        the input tensor name, so a rule can corrupt one
+                        input of a multi-tensor request. Content-keyed
+                        determinism rides the per-rule seeded RNG
+- ``readback_bitflip``  one bit flipped in the completer's host score
+                        tensor AFTER D2H (batcher._complete, post-widen),
+                        fired once per member request with ``key`` = that
+                        request's poison digest like ``device_lost`` — the
+                        silent-corruption scenario the shadow re-execute
+                        and the client's response-CRC verify both catch.
+                        ``error`` kind used as a marker: the site catches
+                        the raise and applies the flip instead of failing
+- ``score_nan``         a row of the completer's host score tensor set to
+                        NaN after D2H (same keying as readback_bitflip) —
+                        the scenario the readback sanity screen catches
+                        row-granularly (batchmates deliver)
 
 Rule kinds:
 
@@ -78,6 +97,7 @@ from .utils import tracing
 SITES = (
     "decode", "batcher.dispatch", "readback", "client.rpc",
     "device_lost", "executor_abort",
+    "wire_corrupt", "readback_bitflip", "score_nan",
 )
 KINDS = ("delay", "error", "wedge")
 

@@ -74,6 +74,12 @@ class HealthRecord:
     # numbers when a member's /monitoring scrape fails, and learns where
     # (and whether) to pull the member's span-tree export.
     obs: dict | None = None
+    # Data-integrity verdict (ISSUE 20): True while the member's
+    # integrity plane holds itself suspect (shadow mismatch / screen-trip
+    # escalation not yet rehabilitated). Routers steer around suspect
+    # replicas; older peers' from_dict drops the key harmlessly
+    # (wire-compatible, the obs-field precedent).
+    suspect: bool = False
     wall_ts: float = 0.0
 
     def to_dict(self) -> dict:

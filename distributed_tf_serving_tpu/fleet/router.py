@@ -56,8 +56,15 @@ import time
 import grpc
 import grpc.aio
 
+import numpy as np
+
 from .. import codec
-from ..client.client import PredictClientError, PredictResult, client_from_config
+from ..client.client import (
+    PredictClientError,
+    PredictResult,
+    build_predict_request,
+    client_from_config,
+)
 from ..client.health import HEALTHY
 from ..proto import health as health_proto
 from ..proto import serving_apis_pb2 as apis
@@ -182,6 +189,20 @@ class Router:
         self.gossip_steers = 0
         self.gossip_rejoins = 0
         self.watch_updates = 0
+        # Router-side integrity audit (ISSUE 20): a sampled fraction of
+        # forwards ALSO fans the same tensors to two replicas and
+        # compares the score bytes bit-identically — the only corruption
+        # detector that works when a replica's own plane is lying (or
+        # off). Armed by [integrity] router_audit_fraction in the
+        # router's config; the deterministic accumulator mirrors the
+        # replica-side shadow sampler (no RNG).
+        self.integrity_cfg = cfgs.get("integrity")
+        self._audit_acc = 0.0
+        self.audits = 0
+        self.audit_disagreements = 0
+        self.audit_suspects_marked = 0
+        self.suspect_steers = 0
+        self._audit_tasks: set[asyncio.Task] = set()
         self._started_t = clock()
         self._watch_tasks: list[asyncio.Task] = []
 
@@ -248,6 +269,16 @@ class Router:
             if sb.state(idx) == HEALTHY:
                 self.gossip_steers += 1
                 sb.record_failure(idx, kind="rebuilding")
+        elif getattr(rec, "suspect", False):
+            # SERVING but integrity-suspect (ISSUE 20): the replica's own
+            # plane caught its data path miscomputing (shadow mismatch /
+            # screen burst) and gossiped the verdict. Busy-bias steer
+            # (kind="corrupt" — the pushback shape, never ejection on a
+            # verdict alone): traffic prefers other replicas while the
+            # suspect rehabilitates, and the next clean gossip record
+            # rejoins it below.
+            self.suspect_steers += 1
+            sb.record_failure(idx, kind="corrupt")
         elif rec.state == gossip_mod.SERVING and sb.state(idx) != HEALTHY:
             self.gossip_rejoins += 1
             sb.record_success(idx)
@@ -303,6 +334,103 @@ class Router:
         for t in self._watch_tasks:
             t.cancel()
         self._watch_tasks = []
+        for t in list(self._audit_tasks):
+            t.cancel()
+        self._audit_tasks.clear()
+
+    # ----------------------------------------- integrity audit (ISSUE 20)
+
+    def _want_audit(self) -> bool:
+        cfg = self.integrity_cfg
+        if (
+            cfg is None
+            or not cfg.enabled
+            or cfg.router_audit_fraction <= 0.0
+            or len(self.client.hosts) < 2
+        ):
+            return False
+        self._audit_acc += cfg.router_audit_fraction
+        if self._audit_acc >= 1.0:
+            self._audit_acc -= 1.0
+            return True
+        return False
+
+    async def audit(self, arrays: dict) -> bool | None:
+        """Two-replica bit-identity audit of one sampled request: the
+        SAME tensors scored independently by two healthy replicas must
+        produce byte-identical score vectors (same model version, same
+        deterministic executable). Disagreement means one of them is
+        corrupting silently; a third replica (when the fleet has one)
+        breaks the tie and the MINORITY is marked in the scoreboard
+        (kind="corrupt" — busy-bias steer, the gossip-suspect shape).
+        Returns True (agreed), False (disagreed), None (not enough
+        answers to judge)."""
+        sb = self.client.scoreboard
+        healthy = [
+            i for i in range(len(self.client.hosts))
+            if sb is None or sb.state(i) == HEALTHY
+        ]
+        if len(healthy) < 2:
+            return None
+        self.audits += 1
+        a, b = healthy[0], healthy[1]
+        ra = await self._audit_call(a, arrays)
+        rb = await self._audit_call(b, arrays)
+        if ra is None or rb is None:
+            return None
+        if self._bits_eq(ra, rb):
+            return True
+        self.audit_disagreements += 1
+        minority = None
+        if len(healthy) >= 3:
+            rc = await self._audit_call(healthy[2], arrays)
+            if rc is not None:
+                if self._bits_eq(rc, ra):
+                    minority = b
+                elif self._bits_eq(rc, rb):
+                    minority = a
+                # Three distinct answers: nobody is a majority — mark
+                # no one (a wrong conviction steers traffic away from a
+                # healthy replica).
+        if minority is not None and sb is not None:
+            self.audit_suspects_marked += 1
+            sb.record_failure(minority, kind="corrupt")
+            log.warning(
+                "integrity audit: replica %s disagreed with the majority "
+                "score bytes — marked suspect (busy-bias steer)",
+                self.client.hosts[minority],
+            )
+        return False
+
+    @staticmethod
+    def _bits_eq(a: np.ndarray, b: np.ndarray) -> bool:
+        return (
+            a.dtype == b.dtype
+            and a.shape == b.shape
+            and a.tobytes() == b.tobytes()
+        )
+
+    async def _audit_call(self, idx: int, arrays: dict):
+        """One audit probe straight at one backend: no failover, no
+        hedging, no scoreboard recording — a probe that fails is simply
+        an inconclusive audit, never a health signal (the RPC path
+        already owns that)."""
+        try:
+            req = build_predict_request(
+                arrays,
+                self.client.model_name,
+                self.client.signature_name,
+                output_filter=(self.client.output_key,),
+                version_label=self.client.version_label,
+                use_tensor_content=self.client.use_tensor_content,
+            )
+            stub = self.client._stubs[idx][0]
+            resp = await stub.Predict(req, timeout=self.client.timeout_s)
+            return np.ascontiguousarray(
+                codec.to_ndarray(resp.outputs[self.client.output_key])
+            )
+        except Exception:  # noqa: BLE001 — an unanswerable probe is inconclusive
+            return None
 
     # ------------------------------------------------------------ forward
 
@@ -405,6 +533,13 @@ class Router:
                         )
         finally:
             self.window.record(time.perf_counter() - t0)
+        if self._want_audit():
+            # Fire-and-forget: the audit must never add latency to the
+            # forwarded answer it samples. Task refs held so the loop
+            # cannot GC a running audit mid-flight.
+            task = asyncio.ensure_future(self.audit(arrays))
+            self._audit_tasks.add(task)
+            task.add_done_callback(self._audit_tasks.discard)
         if isinstance(result, PredictResult):
             if result.degraded:
                 self.degraded += 1
@@ -449,6 +584,10 @@ class Router:
                 "gossip_steers": self.gossip_steers,
                 "gossip_rejoins": self.gossip_rejoins,
                 "watch_updates": self.watch_updates,
+                "suspect_steers": self.suspect_steers,
+                "integrity_audits": self.audits,
+                "audit_disagreements": self.audit_disagreements,
+                "audit_suspects_marked": self.audit_suspects_marked,
             },
             "resilience": self.client.resilience_counters(),
         }
@@ -476,6 +615,10 @@ class Router:
                 "gossip_steers": self.gossip_steers,
                 "gossip_rejoins": self.gossip_rejoins,
                 "watch_updates": self.watch_updates,
+                "suspect_steers": self.suspect_steers,
+                "integrity_audits": self.audits,
+                "audit_disagreements": self.audit_disagreements,
+                "audit_suspects_marked": self.audit_suspects_marked,
             },
             "healthy_backends": self.healthy_backends(),
             "scoreboard": resilience.get("scoreboard"),
@@ -505,6 +648,10 @@ class Router:
                 "gossip_steers": self.gossip_steers,
                 "gossip_rejoins": self.gossip_rejoins,
                 "watch_updates": self.watch_updates,
+                "suspect_steers": self.suspect_steers,
+                "integrity_audits": self.audits,
+                "audit_disagreements": self.audit_disagreements,
+                "audit_suspects_marked": self.audit_suspects_marked,
                 "healthy_backends": self.healthy_backends(),
                 "backends": len(self.client.hosts),
             },

@@ -83,6 +83,7 @@ from ..ops.transfer import (
 from ..utils.compat import enable_x64
 from ..utils import tracing
 from ..utils.tracing import request_trace
+from .integrity import IntegrityScreenError
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
 
@@ -170,6 +171,56 @@ def poison_fault_key(arrays: dict) -> str:
         # directly (the DeviceInputCache._key precedent).
         h.update(arr.view(np.uint8).data)
     return h.hexdigest()
+
+
+def _inject_readback_corruption(host: dict, group: list) -> dict:
+    """Named fault sites (faults.py): readback_bitflip / score_nan — the
+    silent-corruption chaos the integrity plane (ISSUE 20) exists to
+    catch. Fired once per member request with the same content digest
+    device_lost uses, AFTER the D2H asarray so the corrupted bytes are
+    exactly what readback handed the completer: a keyed rule
+    deterministically flips one payload bit (shadow compare's prey) or
+    NaN-poisons the member's score rows (the screen's prey). The error
+    kinds are markers — the raise is caught HERE and applied as the
+    corruption, never surfaced. Returns `host` with the score array
+    replaced by a corrupted writable copy (np.asarray views of device
+    buffers are read-only)."""
+    fi = faults.get()
+    score_key = group[0].servable.model.score_output
+    scores = host.get(score_key)
+    if scores is None:
+        return host
+    corrupted = None
+    off = 0
+    for it in group:
+        n = it.n
+        sl = slice(off, off + n)
+        off += n
+        key = poison_fault_key(it.arrays)
+        for site in ("readback_bitflip", "score_nan"):
+            if not fi.has_site(site):
+                continue
+            try:
+                fi.fire(site, key=key)
+            except faults.InjectedFaultError:
+                if corrupted is None:
+                    corrupted = np.ascontiguousarray(scores).copy()
+                if site == "score_nan" and corrupted.dtype.kind == "f":
+                    corrupted[sl] = np.nan
+                else:
+                    # One bit, lowest-order, first element of the row
+                    # range — below any plausible-range screen's radar,
+                    # exactly the divergence only a bit-identity compare
+                    # detects.
+                    flat = corrupted.reshape(-1).view(
+                        np.dtype(f"u{corrupted.dtype.itemsize}")
+                    )
+                    stride = max(corrupted.size // max(len(scores), 1), 1)
+                    flat[sl.start * stride] ^= 1
+    if corrupted is not None:
+        host = dict(host)
+        host[score_key] = corrupted
+    return host
 
 
 class RequestDeadlineError(TimeoutError):
@@ -748,6 +799,14 @@ class DynamicBatcher:
         # (default) costs one attribute read per hook — the
         # tracing/cache/overload precedent.
         self.recovery = None
+        # Data-integrity plane (serving/integrity.py, ISSUE 20): an
+        # IntegrityPlane attached post-construction. When set, sampled
+        # batches re-execute for a bit-identity shadow compare, delivered
+        # score rows pass a post-readback NaN/Inf screen (failing rows
+        # fail their OWN request; batchmates deliver), and screen-trip
+        # bursts escalate to the recovery cycle. None (default) costs one
+        # attribute read per hook — the recovery/quality precedent.
+        self.integrity = None
         # Thread-death watchdog (recovery satellite): set to the
         # BatcherThreadDead the moment any batcher-owned thread dies from
         # an unhandled exception; submit() fails fast on it instead of
@@ -2939,6 +2998,63 @@ class DynamicBatcher:
                     # without them (restore_outputs_host strips them).
                     if wanted is None or k in wanted or is_wire_sidecar(k)
                 }
+            shadow_fetch = None
+            integ = self.integrity
+            if (
+                integ is not None
+                and run_fn_cap is None
+                and not all_warm
+                and integ.want_shadow()
+            ):
+                # Shadow verification (ISSUE 20): re-execute the SAME
+                # jitted entry over the same inputs — donation-safe
+                # because the shadow arrays are host buffers device_put
+                # fresh per _execute call — and hand both device results
+                # to the completer for a host-side bit-identity compare.
+                # Any divergence is hardware miscomputation (same
+                # program, same input, one device): OutputCorruptError
+                # there captures the group for replay via the recovery
+                # cycle. Custom run_fn paths are ineligible (their
+                # entries may legitimately not be bit-stable); all-warmup
+                # groups carry no scores worth verifying.
+                if batched is not None:
+                    shadow_in = batched
+                else:
+                    # Fused-assembler batch: rebuild the generic padded
+                    # equivalent from the same host parts the native
+                    # packer consumed. The generic entry shares the
+                    # fused path's compiled executable over a
+                    # bit-identical combined buffer (pinned by
+                    # tests/test_batcher.py), so the compare stays
+                    # apples to apples — and cross-checks the native
+                    # assembler against the reference pad+pack besides.
+                    # Plain np.empty, not the buffer ring: this buffer
+                    # dies with the dispatch frame.
+                    shadow_in = {}
+                    for k, parts in (
+                        ("feat_ids", fused["ids_parts"]),
+                        ("feat_wts", fused["wts_parts"]),
+                    ):
+                        dt = parts[0].dtype
+                        if any(p.dtype != dt for p in parts):
+                            dt = np.result_type(*(p.dtype for p in parts))
+                        buf = np.empty(
+                            (bucket,) + parts[0].shape[1:], dt
+                        )
+                        off = 0
+                        for p in parts:
+                            buf[off : off + p.shape[0]] = p
+                            off += p.shape[0]
+                        buf[off:] = 0  # padding rows
+                        shadow_in[k] = buf
+                with sink_ctx():
+                    with request_trace.span("batch.shadow_dispatch"):
+                        shadow_outputs = self._execute(
+                            servable, shadow_in,
+                            out_keys=wanted_key, topk=topk, n_valid=n_valid,
+                            prune=prune,
+                        )
+                shadow_fetch = {k: shadow_outputs[k] for k in fetch}
             # What a full-fp32 all-outputs readback of this batch would
             # have moved: the baseline the compaction win is charged
             # against. Traced row bytes when the default jit entry served
@@ -2962,6 +3078,10 @@ class DynamicBatcher:
                 for v in fetch.values():
                     if hasattr(v, "copy_to_host_async"):
                         v.copy_to_host_async()
+                if shadow_fetch is not None:
+                    for v in shadow_fetch.values():
+                        if hasattr(v, "copy_to_host_async"):
+                            v.copy_to_host_async()
                 with sink_ctx():
                     request_trace.add(
                         "readback.issue", time.perf_counter() - issue_t0
@@ -3027,6 +3147,7 @@ class DynamicBatcher:
                 stage_t0, util=util, bucket=bucket, ring_bufs=ring_bufs,
                 row_ctx=row_ctx, run_token=run_token,
                 run_fn=run_fn_cap if run_token is not None else None,
+                shadow=shadow_fetch,
             ).add_done_callback(
                 lambda f, g=group: self._guard_worker_future(f, g, "completer")
             )
@@ -3088,6 +3209,7 @@ class DynamicBatcher:
         ring_bufs: list | None = None,
         row_ctx: "_RowContext | None" = None,
         run_token=None, run_fn=None,
+        shadow: dict | None = None,
     ) -> None:
         phases: list | None = (
             [] if tracing.enabled() and any(it.span is not None for it in group)
@@ -3116,6 +3238,31 @@ class DynamicBatcher:
                 request_trace.add(
                     "readback.wait" if self.async_readback else "batch.readback",
                     waited,
+                )
+            integ = self.integrity  # capture: detachable mid-flight
+            if (
+                integ is not None
+                and faults.active()
+                and (
+                    faults.get().has_site("readback_bitflip")
+                    or faults.get().has_site("score_nan")
+                )
+            ):
+                # Chaos injection BEFORE the shadow compare and screen:
+                # the corrupted bytes must be exactly what those layers
+                # would have received from a sick readback path.
+                host = _inject_readback_corruption(host, group)
+            if integ is not None and shadow is not None:
+                # Shadow verification: bit-identity compare of the two
+                # executions' raw host bytes, BEFORE widen/scatter (any
+                # post-processing is deterministic host numpy — comparing
+                # the rawest form localizes blame to the device/readback
+                # path). Raises OutputCorruptError on divergence: the
+                # except below hands the group to recovery for replay.
+                keys = sorted(host)
+                integ.shadow_compare(
+                    [host[k] for k in keys],
+                    [np.asarray(shadow[k]) for k in keys],
                 )
             downloaded = sum(v.nbytes for v in host.values())
             total_n = sum(it.n for it in group)
@@ -3218,7 +3365,32 @@ class DynamicBatcher:
                     # the same way).
                     self._finish_row_batch(group, row_ctx, host)
                     return
+            screened: dict[int, str] = {}
+            if integ is not None and integ.config.screen:
+                # Readback sanity screen (ISSUE 20 layer 2): per-request
+                # slices of the score output, post-widen/post-scatter —
+                # the exact bytes delivery hands each waiter. A failing
+                # ROW fails only its own request (the poisoned-input
+                # per-item precedent); batchmates deliver normally.
+                skey = group[0].servable.model.score_output
+                sarr = host.get(skey)
+                if sarr is not None:
+                    soff = 0
+                    for idx, it in enumerate(group):
+                        row = sarr[soff : soff + it.n]
+                        soff += it.n
+                        if it.warmup:
+                            continue
+                        reason = integ.screen_reason(row)
+                        if reason is not None:
+                            screened[idx] = reason
+                            integ.note_screen_trip(reason)
             q = self.quality  # capture: detachable mid-flight (bench A/B)
+            if screened:
+                # A batch with ANY screened row never feeds the quality
+                # plane — the readback is suspect wholesale, and sketching
+                # corrupt scores would poison the drift baselines.
+                q = None
             if q is not None and meta is None:
                 # Quality-plane feed, BEFORE the waiters unblock so a
                 # drift exemplar's `quality.drift` annotation is already
@@ -3235,17 +3407,30 @@ class DynamicBatcher:
                 except Exception:  # noqa: BLE001 — the observability
                     pass           # plane must never fail a batch
             off = 0
-            for it in group:
+            for idx, it in enumerate(group):
                 sliced = {k: v[off : off + it.n] for k, v in host.items()}
                 off += it.n
                 try:
-                    if not it.future.cancelled():
+                    if it.future.cancelled():
+                        continue
+                    if idx in screened:
+                        it.future.set_exception(IntegrityScreenError(
+                            f"readback screen failed this request's rows: "
+                            f"{screened[idx]}"
+                        ))
+                    else:
                         it.future.set_result(sliced)
                 except InvalidStateError:
                     # A service-deadline cancel can land between the check
                     # and set_result; that waiter is gone, but its race must
                     # not poison co-batched requests via the except below.
                     pass
+            if integ is not None:
+                # Screen-trip burst -> recovery escalation, AFTER delivery:
+                # the tripped rows already failed individually; the cycle
+                # (trigger "output_corrupt") reinits the executor before
+                # the next batch inherits the sick output path.
+                integ.maybe_escalate_screen(self.recovery)
         except Exception as exc:
             if phases is not None:
                 _replay_group_phases(group, phases)

@@ -101,6 +101,12 @@ def device_fatal(exc: BaseException) -> bool:
 
     if isinstance(exc, faults_mod.InjectedFaultError):
         return exc.site in _FATAL_SITES
+    if getattr(exc, "integrity_corrupt", False):
+        # Integrity-plane verdicts (shadow mismatch, screen-trip
+        # escalation) opt in explicitly: the executor's outputs can no
+        # longer be trusted, so the same quarantine->reinit->replay
+        # cycle applies even though the device did not report dead.
+        return True
     # Marker match only — deliberately narrow: a deterministic per-shape
     # XlaRuntimeError("INTERNAL: ...") compile/runtime bug is NOT a dead
     # device, and classifying it fatal would loop quarantine cycles (and
@@ -232,7 +238,12 @@ class RecoveryController:
                 fails.append((it, exc))
         self._apply_fails(fails)
         self._absorb([it for it in group if not it.warmup], exc)
-        self._request_cycle("device_fatal")
+        trigger = (
+            "output_corrupt"
+            if getattr(exc, "integrity_corrupt", False)
+            else "device_fatal"
+        )
+        self._request_cycle(trigger)
         return True
 
     def note_thread_death(self, err: BaseException) -> bool:

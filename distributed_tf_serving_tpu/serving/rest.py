@@ -216,6 +216,12 @@ class RestGateway:
             # stage-2 counters, row dispositions, observed survivor and
             # rank fractions, and the survivor-bucket histogram.
             web.get("/cascadez", self.cascadez),
+            # Data-integrity plane (ISSUE 20): wire-checksum / readback-
+            # screen / shadow-verification counters + suspect state and
+            # the detection-event history, and the operator lever that
+            # forces the NEXT batches through shadow verification.
+            web.get("/integrityz", self.integrityz),
+            web.post("/integrityz/audit", self.integrityz_audit),
         ])
 
     # ------------------------------------------------------------- helpers
@@ -594,6 +600,7 @@ class RestGateway:
                 elastic=self.impl.elastic_stats(mesh=mesh),
                 fleet=self.impl.fleet_stats(),
                 cascade=self.impl.cascade_stats(),
+                integrity=self.impl.integrity_stats(),
             ).encode("utf-8"),
             headers={
                 "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
@@ -631,6 +638,7 @@ class RestGateway:
             "elastic": self.impl.elastic_stats,
             "fleet": self.impl.fleet_stats,
             "cascade": self.impl.cascade_stats,
+            "integrity": self.impl.integrity_stats,
             "versions": self.impl.versions_stats,
             "pipeline": self.impl.pipeline_stats,
             "request_log": request_log,
@@ -665,7 +673,8 @@ class RestGateway:
         # waterfall merge).
         for name in ("cache", "row_cache", "overload", "utilization",
                      "quality", "lifecycle", "recovery", "kernels", "mesh",
-                     "elastic", "fleet", "cascade", "versions", "pipeline"):
+                     "elastic", "fleet", "cascade", "integrity", "versions",
+                     "pipeline"):
             if name == "mesh":
                 block = self.impl.mesh_stats(
                     utilization=snap.get("utilization")
@@ -888,6 +897,40 @@ class RestGateway:
         stats = self.impl.cascade_stats()
         return web.json_response(
             stats if stats is not None else {"enabled": False}
+        )
+
+    async def integrityz(self, request: web.Request) -> web.Response:
+        """GET /integrityz: the data-integrity surface — wire-checksum
+        verify/reject counters, readback-screen trips, shadow-
+        verification batch/mismatch counters, the replica's suspect
+        verdict (what the fleet record gossips), escalations into the
+        recovery plane, and the detection-event history. `{"enabled":
+        false}` when the plane is not armed ([integrity] enabled=false),
+        so probes need no config knowledge."""
+        stats = self.impl.integrity_stats()
+        return web.json_response(
+            stats if stats is not None else {"enabled": False}
+        )
+
+    async def integrityz_audit(self, request: web.Request) -> web.Response:
+        """POST /integrityz/audit[?batches=N]: operator-forced shadow
+        verification — the NEXT N batches (default 1) re-execute through
+        the same jitted entry and compare bit-identically, regardless of
+        shadow_fraction. The on-demand lever for "is this replica
+        corrupting right now?". 404 + `{"enabled": false}` when the
+        plane is not armed."""
+        integ = getattr(self.impl, "integrity", None)
+        if integ is None:
+            return web.json_response({"enabled": False}, status=404)
+        try:
+            batches = int(request.query.get("batches", "1"))
+        except ValueError:
+            return _json_error("INVALID_ARGUMENT", "batches must be an integer")
+        if batches < 1:
+            return _json_error("INVALID_ARGUMENT", "batches must be >= 1")
+        pending = integ.request_audit(batches)
+        return web.json_response(
+            {"requested": batches, "pending_audits": pending}
         )
 
     async def recoveryz(self, request: web.Request) -> web.Response:

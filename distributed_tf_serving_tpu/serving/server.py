@@ -38,6 +38,7 @@ from ..proto import (
     add_PredictionServiceServicer_to_server,
 )
 from ..proto import health as health_proto
+from .. import codec
 from ..utils.config import ServerConfig, load_config
 from ..utils.metrics import ServerMetrics
 from ..utils import tracing
@@ -119,6 +120,37 @@ def _stream_chunk_of(context) -> int | None:
     except Exception:  # noqa: BLE001 — a malformed hint must not fail the RPC
         return None
     return None
+
+
+def _input_crc_of(context, impl) -> str | None:
+    """The client's x-dts-input-crc wire-integrity stamp (ISSUE 20), or
+    None. Only scanned while the impl's integrity plane (wire layer) is
+    armed — two attribute reads per RPC otherwise."""
+    integ = impl.integrity
+    if integ is None or not integ.config.wire_checksums:
+        return None
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == codec.CRC_INPUT_MD:
+                return str(value)
+    except Exception:  # noqa: BLE001 — a metadata quirk must not fail the RPC
+        return None
+    return None
+
+
+def _stamp_response_crc(impl, context, resp) -> None:
+    """x-dts-score-crc trailing-metadata stamp over the encoded response
+    tensors (ISSUE 20), shared by both transports. Advisory: a stamping
+    failure must never fail a good response, and an armed overload
+    plane's degraded/pushback trailing metadata (set later on the same
+    context) wins the slot — the client treats an absent stamp as
+    "server didn't verify", exactly like a plane-less server."""
+    try:
+        sidecar = impl.response_crc_sidecar(resp)
+        if sidecar:
+            context.set_trailing_metadata(((codec.CRC_SCORE_MD, sidecar),))
+    except Exception:  # noqa: BLE001 — advisory, never fatal
+        pass
 
 
 def _push_overload_metadata(context, exc: ServiceError | None) -> None:
@@ -276,14 +308,18 @@ class GrpcPredictionService(_SyncServicerBase):
         deadline_s = _deadline_of(context)
         crit = _criticality_of(context)
         int8_wire = _score_wire_of(context)
-        return self._call(
-            "Predict",
-            lambda req: self.impl.predict(
+        input_crc = _input_crc_of(context, self.impl)
+
+        def handler(req):
+            resp = self.impl.predict(
                 req, deadline_s=deadline_s, criticality=crit,
-                int8_wire=int8_wire,
-            ),
-            request, context,
-        )
+                int8_wire=int8_wire, input_crc=input_crc,
+            )
+            if self.impl.integrity is not None:
+                _stamp_response_crc(self.impl, context, resp)
+            return resp
+
+        return self._call("Predict", handler, request, context)
 
     def Classify(self, request, context):
         deadline_s = _deadline_of(context)
@@ -665,14 +701,18 @@ class AioGrpcPredictionService(_AioServicerBase):
         deadline_s = _deadline_of(context)
         crit = _criticality_of(context)
         int8_wire = _score_wire_of(context)
-        return await self._call(
-            "Predict",
-            lambda req: self.impl.predict_async(
+        input_crc = _input_crc_of(context, self.impl)
+
+        async def handler(req):
+            resp = await self.impl.predict_async(
                 req, deadline_s=deadline_s, criticality=crit,
-                int8_wire=int8_wire,
-            ),
-            request, context,
-        )
+                int8_wire=int8_wire, input_crc=input_crc,
+            )
+            if self.impl.integrity is not None:
+                _stamp_response_crc(self.impl, context, resp)
+            return resp
+
+        return await self._call("Predict", handler, request, context)
 
     async def Classify(self, request, context):
         deadline_s = _deadline_of(context)
@@ -1330,6 +1370,7 @@ def build_stack(
     mesh_config=None,
     elastic_config=None,
     cascade_config=None,
+    integrity_config=None,
 ):
     """Registry + batcher (+ mesh executor) + impl from a ServerConfig.
     model_config (the TOML [model] section) pins the architecture for the
@@ -1448,6 +1489,33 @@ def build_stack(
             "[mesh] section's split is where serving starts (and the "
             "ladder's rungs must factorize its device count). Arm both, "
             "or drop [elastic]"
+        )
+    integrity_armed = integrity_config is not None and integrity_config.enabled
+    if (
+        integrity_armed
+        and integrity_config.shadow_fraction > 0
+        and cache_config is not None
+        and cache_config.enabled
+    ):
+        # Shadow verification's headline guarantee is "every delivered
+        # score was (sampled-)verified bit-identical against a second
+        # execution". Exact-match cache hits bypass the batcher entirely
+        # — bytes inserted BEFORE the plane armed (or before a sick
+        # period was detected) would be re-served for their whole TTL
+        # with no detection layer ever touching them again. Refuse the
+        # combination instead of silently weakening the guarantee; the
+        # row cache and [kernels] COMPOSE (cold rows execute through the
+        # shadow-eligible path, and both shadow executions route through
+        # the same kernel-variant decision, so the compare stays within
+        # the enabled variant).
+        raise ValueError(
+            "[integrity] shadow_fraction > 0 conflicts with [cache] "
+            "enabled: exact-match cache hits re-serve cached score bytes "
+            "without re-execution, so sampled shadow verification can "
+            "never re-check them — the zero-corrupt-delivery guarantee "
+            "would silently exclude every cache hit. Disable the score "
+            "cache or set shadow_fraction = 0 (wire checksums and "
+            "readback screens still compose with the cache)"
         )
     cascade_armed = cascade_config is not None and cascade_config.enabled
     if cascade_armed:
@@ -1782,6 +1850,24 @@ def build_stack(
             "REST surface",
             recovery_config.wedge_quarantine_s,
             recovery_config.replay_budget, recovery_config.poison_kills,
+        )
+    if integrity_armed:
+        # Data-integrity plane (serving/integrity.py, ISSUE 20): ONE
+        # plane object shared by every hook site — the batcher (shadow
+        # sampling + readback screens + escalation), the impl (input CRC
+        # verify, response stamping, /integrityz), and the transports
+        # (metadata read/write) all reach the same counters.
+        integrity_plane = integrity_config.build()
+        batcher.integrity = integrity_plane
+        impl.integrity = integrity_plane
+        log.info(
+            "data-integrity plane on: wire_checksums=%s screen=%s "
+            "shadow_fraction=%.3f trips/window=%d/%.1fs — GET /integrityz "
+            "on the REST surface",
+            integrity_config.wire_checksums, integrity_config.screen,
+            integrity_config.shadow_fraction,
+            integrity_config.screen_trips_per_window,
+            integrity_config.screen_window_s,
         )
     # Health gating: the grpc.health.v1 servicer reports the overall server
     # NOT_SERVING until the load+warmup phase below completes (standard
@@ -2169,6 +2255,22 @@ def serve(argv=None) -> None:
         "dts_tpu_fleet_* Prometheus series)",
     )
     parser.add_argument(
+        "--integrity", action="store_true", default=None,
+        help="end-to-end data-integrity plane (serving/integrity.py): "
+        "CRC32C wire checksums over tensor bytes both directions "
+        "(x-dts-input-crc verified at decode — a corrupted request fails "
+        "alone, never its batch; x-dts-score-crc stamped on responses "
+        "for opted-in clients), post-readback NaN/Inf sanity screens "
+        "that fail only the corrupted row, and sampled bit-identity "
+        "shadow re-execution whose mismatches escalate into the "
+        "[recovery] quarantine->reinit->replay cycle and gossip a "
+        "`suspect` verdict fleet-wide. Equivalent to [integrity] "
+        "enabled=true; the [integrity] section carries the "
+        "screen/shadow knobs (GET /integrityz, POST /integrityz/audit, "
+        "`integrity` block in /monitoring, dts_tpu_integrity_* "
+        "Prometheus series)",
+    )
+    parser.add_argument(
         "--router", action="store_true", default=None,
         help="run as the FLEET ROUTER instead of a serving replica "
         "(fleet/router.py): a jax-free tier speaking the PredictionService "
@@ -2261,6 +2363,7 @@ def serve(argv=None) -> None:
         CascadeConfig,
         ElasticConfig,
         FleetConfig,
+        IntegrityConfig,
         KernelsConfig,
         LifecycleConfig,
         MeshConfig,
@@ -2329,6 +2432,9 @@ def serve(argv=None) -> None:
     cascade_config = cfgs.get("cascade") or CascadeConfig()
     if args.cascade:
         cascade_config = dataclasses.replace(cascade_config, enabled=True)
+    integrity_config = cfgs.get("integrity") or IntegrityConfig()
+    if args.integrity:
+        integrity_config = dataclasses.replace(integrity_config, enabled=True)
     if mesh_config.enabled:
         # With the mesh MODE armed, the CLI mesh-geometry flags configure
         # the [mesh] section (and are withheld from the legacy [server]
@@ -2415,6 +2521,7 @@ def serve(argv=None) -> None:
         mesh_config=mesh_config,
         elastic_config=elastic_config,
         cascade_config=cascade_config,
+        integrity_config=integrity_config,
     )
     if impl.lifecycle is not None:
         # The CLI server drives the controller with its background thread
@@ -2494,6 +2601,13 @@ def serve(argv=None) -> None:
             ov = impl.overload_stats()
             if ov:
                 rec["pressure"] = str(ov.get("state") or "")
+            if impl.integrity is not None:
+                # Integrity verdict (ISSUE 20): suspect rides every
+                # gossip record so routers steer around a replica whose
+                # shadow verification caught its device miscomputing —
+                # cleared (and re-gossiped False) after the configured
+                # number of clean shadow passes.
+                rec["suspect"] = bool(impl.integrity.suspect)
             if impl.lifecycle is not None:
                 rec.update(impl.lifecycle.fleet_record())
             # Observability digest (ISSUE 18): qps/latency summary +

@@ -44,6 +44,7 @@ from .batcher import (
     RequestDeadlineError,
 )
 from .example_codec import ExampleDecodeError, decode_input
+from .integrity import IntegrityScreenError
 
 SIGNATURE_DEF_FIELD = "signature_def"
 
@@ -148,6 +149,14 @@ class PredictionServiceImpl:
         # Prometheus series read through it; None (default) costs one
         # attribute read where consulted.
         self.fleet = None
+        # Data-integrity plane (serving/integrity.py, ISSUE 20): when an
+        # IntegrityPlane is set (build_stack attaches the same object to
+        # the batcher), x-dts-input-crc request stamps are verified at
+        # decode (mismatch fails ONLY that request, INVALID_ARGUMENT with
+        # a corrupt-wire detail), responses are stamped with
+        # x-dts-score-crc trailing metadata, and GET /integrityz serves
+        # its snapshot. None (default) costs one attribute read per hook.
+        self.integrity = None
         # Streamed sub-batch results (ISSUE 9): default server-side split
         # size (candidates per sub-batch) for PredictStream. 0 = no split
         # (one chunk per request — streaming stays wire-available but the
@@ -326,6 +335,26 @@ class PredictionServiceImpl:
         series. None when the plane is off ([fleet] enabled=false)."""
         fl = self.fleet
         return fl.fleet_stats() if fl is not None else None
+
+    def integrity_stats(self) -> dict | None:
+        """Integrity-plane snapshot (wire verify/reject counters, screen
+        trips + window state, shadow batches/mismatches/audits, suspect
+        verdict, escalations, bounded event history) — the body of
+        GET /integrityz, the `integrity` block in /monitoring, and the
+        dts_tpu_integrity_* Prometheus series. None when the plane is
+        off ([integrity] enabled=false)."""
+        integ = self.integrity
+        return integ.snapshot() if integ is not None else None
+
+    def response_crc_sidecar(self, resp) -> str | None:
+        """The x-dts-score-crc trailing-metadata value for one encoded
+        PredictResponse, or None when the plane (or its wire layer) is
+        off. Called by the transport adapters after the handler returns —
+        the stamp covers the exact tensors that ride the wire."""
+        integ = self.integrity
+        if integ is None or not integ.config.wire_checksums:
+            return None
+        return integ.response_sidecar(resp.outputs)
 
     def kernels_stats(self) -> dict | None:
         """Kernel-plane snapshot (per-bucket decision table, measured
@@ -626,6 +655,12 @@ class PredictionServiceImpl:
             )
         if isinstance(exc, DeviceWedgedError):
             return ServiceError("UNAVAILABLE", str(exc))
+        if isinstance(exc, IntegrityScreenError):
+            # Readback screen verdict (ISSUE 20): this request's score
+            # rows came back NaN/Inf/implausible — retryable elsewhere
+            # (a resilient client fails over), and per-row by design:
+            # its batchmates delivered normally.
+            return ServiceError("UNAVAILABLE", str(exc))
         if isinstance(exc, RequestDeadlineError):
             # The batcher shed the queued item itself (propagated client
             # deadline): the future already failed, nothing to withdraw.
@@ -757,12 +792,17 @@ class PredictionServiceImpl:
             overload_mod.mark_degraded(degraded)
 
     def _predict_prepare(
-        self, request: apis.PredictRequest, criticality: str | None = None
+        self, request: apis.PredictRequest, criticality: str | None = None,
+        input_crc: str | None = None,
     ):
         """Shared front half of Predict: resolution, decode/validation,
         output_filter handling. Returns (servable, arrays, out_names).
         `criticality` reaches resolution so the lifecycle plane can route
-        probe-lane (then a ramp of default-lane) traffic to a canary."""
+        probe-lane (then a ramp of default-lane) traffic to a canary.
+        `input_crc` is the client's x-dts-input-crc stamp (transport
+        metadata): verified here — BEFORE the batcher ever sees the
+        request — so a corrupted request fails alone, never the
+        coalesced batch it would have joined."""
         servable, signature = self._resolve(request.model_spec, criticality)
         if signature.method_name != "tensorflow/serving/predict":
             raise ServiceError(
@@ -778,6 +818,20 @@ class PredictionServiceImpl:
             except faults.InjectedFaultError as e:
                 raise ServiceError(e.code_name, str(e)) from e
             arrays = self._decode_and_validate(servable, signature, request.inputs)
+        integ = self.integrity
+        if (
+            input_crc is not None
+            and integ is not None
+            and integ.config.wire_checksums
+        ):
+            bad = integ.verify_inputs(arrays, input_crc)
+            if bad:
+                raise ServiceError(
+                    "INVALID_ARGUMENT",
+                    "corrupt-wire: input tensor bytes do not match the "
+                    f"request's x-dts-input-crc stamp on {bad} — the "
+                    "payload was damaged in transit; resend",
+                )
 
         sig_outputs = signature.output_names
         if request.output_filter:
@@ -806,11 +860,12 @@ class PredictionServiceImpl:
     def predict(
         self, request: apis.PredictRequest, deadline_s: float | None = None,
         criticality: str | None = None, int8_wire: bool = False,
+        input_crc: str | None = None,
     ) -> apis.PredictResponse:
         self._refuse_if_draining()
         deadline_t = self._clock_deadline(deadline_s)
         servable, arrays, out_names, fetch_keys = self._predict_prepare(
-            request, criticality
+            request, criticality, input_crc=input_crc
         )
         casc = self.cascade
         if casc is not None and casc.eligible(
@@ -844,13 +899,14 @@ class PredictionServiceImpl:
     async def predict_async(
         self, request: apis.PredictRequest, deadline_s: float | None = None,
         criticality: str | None = None, int8_wire: bool = False,
+        input_crc: str | None = None,
     ) -> apis.PredictResponse:
         """Predict for coroutine servers: identical semantics, awaits the
         batch instead of blocking a handler thread on it."""
         self._refuse_if_draining()
         deadline_t = self._clock_deadline(deadline_s)
         servable, arrays, out_names, fetch_keys = self._predict_prepare(
-            request, criticality
+            request, criticality, input_crc=input_crc
         )
         casc = self.cascade
         if casc is not None and casc.eligible(

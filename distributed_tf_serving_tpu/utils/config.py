@@ -180,6 +180,13 @@ class ClientConfig:
     tls_root_certs_file: str = ""
     tls_client_key_file: str = ""
     tls_client_cert_file: str = ""
+    # Integrity wire checksums (ISSUE 20): stamp x-dts-input-crc CRC32C
+    # sidecars on requests and verify the server's x-dts-score-crc
+    # response stamps before merging — a mismatch steers (scoreboard
+    # kind="corrupt") and fails the shard over to another backend.
+    # Advisory both ways: servers without [integrity] ignore/omit the
+    # metadata.
+    integrity_checksums: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1113,6 +1120,101 @@ class CascadeConfig:
             )
 
 
+@dataclasses.dataclass(frozen=True)
+class IntegrityConfig:
+    """Data-integrity plane knobs (serving/integrity.py, ISSUE 20): wire
+    CRC32C sidecars, the post-D2H readback sanity screen, and sampled
+    bit-identity shadow verification — three detection ladders against
+    SILENT corruption (flipped D2H bits, decaying host buffers, plausible
+    wrong scores) that every other robustness plane is blind to because
+    nothing errors. Verdicts escalate into the EXISTING recovery (PR 11)
+    and gossip/router (PR 17) machinery instead of new quarantine logic.
+    Off by default; when off every hook is one attribute read and served
+    bytes are bit-identical to the pre-plane stack."""
+
+    # Master switch: build an IntegrityPlane and attach it to the impl +
+    # batcher; arms the server-side wire verify and response stamping.
+    enabled: bool = False
+    # Layer 1 — wire integrity. Verify x-dts-input-crc request sidecars
+    # at decode (the corrupted request alone fails INVALID_ARGUMENT with
+    # a corrupt-wire detail) and stamp x-dts-score-crc over the response
+    # score tensor for opted-in clients to verify before merge.
+    wire_checksums: bool = True
+    # Layer 2 — readback sanity screen. Post-D2H NaN/Inf check over the
+    # score tensor in the batcher completer; a failing ROW fails its own
+    # request while batchmates deliver (the PR-11 per-item machinery).
+    screen: bool = True
+    # Optional plausible-score interval [screen_min, screen_max] the
+    # screen also enforces; (0, 0) disables the range check (NaN/Inf
+    # only). CTR scores are probabilities, so (0, 1) is the natural
+    # production setting — but the default must not reject imported
+    # graphs with logit-scale outputs.
+    screen_min: float = 0.0
+    screen_max: float = 0.0
+    # Screen trips past this count inside screen_window_s escalate to
+    # RecoveryController.take_group (output_corrupt): one cosmic-ray row
+    # is row-failed and forgotten, a persistently-corrupting executor
+    # walks the QUARANTINED->REINIT->REPLAY cycle.
+    screen_trips_per_window: int = 3
+    screen_window_s: float = 10.0
+    # Layer 3 — shadow verification. Fraction of batches re-executed
+    # through the SAME jitted entry and compared bit-identically on
+    # host; any mismatch is nondeterminism or silent corruption ->
+    # recovery escalation + the suspect verdict gossiped fleet-wide.
+    # 0.0 = sampled shadowing off (POST /integrityz/audit still works).
+    shadow_fraction: float = 0.0
+    # Router tier: fraction of forwarded requests additionally fanned to
+    # TWO replicas with bit-identical compare; disagreement marks the
+    # minority replica suspect in gossip. 0.0 = off.
+    router_audit_fraction: float = 0.0
+    # Consecutive clean shadow passes that clear a replica's suspect
+    # verdict (self-check rehabilitation).
+    suspect_clear_passes: int = 3
+    # Retained detection-event history (/integrityz `events`).
+    history_events: int = 64
+
+    def __post_init__(self):
+        for name in ("screen_trips_per_window", "suspect_clear_passes",
+                     "history_events"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"[integrity] {name} must be a positive integer, "
+                    f"got {v!r}"
+                )
+        for name in ("shadow_fraction", "router_audit_fraction"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not 0.0 <= v <= 1.0:
+                raise ValueError(
+                    f"[integrity] {name} must be in [0, 1], got {v!r}"
+                )
+        if not isinstance(self.screen_window_s, (int, float)) or isinstance(
+            self.screen_window_s, bool
+        ) or self.screen_window_s <= 0:
+            raise ValueError(
+                f"[integrity] screen_window_s must be a positive number, "
+                f"got {self.screen_window_s!r}"
+            )
+        for name in ("screen_min", "screen_max"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise ValueError(
+                    f"[integrity] {name} must be a number, got {v!r}"
+                )
+        if self.screen_max < self.screen_min:
+            raise ValueError(
+                f"[integrity] screen_max ({self.screen_max!r}) must be >= "
+                f"screen_min ({self.screen_min!r}); use (0, 0) to disable "
+                "the range check"
+            )
+
+    def build(self):
+        from ..serving.integrity import IntegrityPlane
+
+        return IntegrityPlane(self)
+
+
 def _model_config_cls():
     from ..models.base import ModelConfig
 
@@ -1137,6 +1239,7 @@ _SECTIONS = {
     "fleet": FleetConfig,
     "slo": SloConfig,
     "cascade": CascadeConfig,
+    "integrity": IntegrityConfig,
 }
 
 

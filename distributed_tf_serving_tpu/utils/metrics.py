@@ -524,6 +524,54 @@ _HELP = {
         "Stage-2 submits by the padded batch rung the survivors packed "
         "into (the cascade's win shows as survivor traffic landing in "
         "smaller rungs than the candidate batches)",
+    "dts_tpu_fleet_router_integrity_audits_total":
+        "Router-side two-replica bit-identity audits by outcome: run = "
+        "sampled forwards fanned to two replicas, disagreed = the score "
+        "bytes differed, suspect_marked = a third replica broke the tie "
+        "and the minority was busy-biased in the scoreboard",
+    "dts_tpu_integrity_wire_inputs_verified_total":
+        "Requests whose input tensors carried an x-dts-input-crc stamp "
+        "and matched it at decode (CRC32C over dtype/shape + payload "
+        "bytes)",
+    "dts_tpu_integrity_wire_inputs_rejected_total":
+        "Requests failed INVALID_ARGUMENT at decode because the input "
+        "bytes did not match the client's checksum stamp — corruption "
+        "in transit, caught before the batch formed (only the damaged "
+        "request fails)",
+    "dts_tpu_integrity_wire_responses_stamped_total":
+        "Responses stamped with an x-dts-score-crc trailing-metadata "
+        "sidecar for opted-in clients to verify before merging scores",
+    "dts_tpu_integrity_screen_trips_total":
+        "Score rows the post-readback sanity screen rejected (NaN/Inf, "
+        "or outside the configured plausible range); each trip fails "
+        "only its own request while batchmates deliver",
+    "dts_tpu_integrity_screen_window_trips":
+        "Screen trips inside the current escalation window — crossing "
+        "screen_trips_per_window hands the group to the recovery "
+        "plane's output_corrupt cycle",
+    "dts_tpu_integrity_shadow_batches_total":
+        "Batches re-executed through the same jitted entry and "
+        "compared bit-identically on host (sampled by shadow_fraction "
+        "plus operator-forced audits)",
+    "dts_tpu_integrity_shadow_mismatches_total":
+        "Shadow re-executions whose bytes differed from the primary "
+        "pass — same program, same inputs, different bits: the silent-"
+        "corruption signature (escalates to recovery + gossips "
+        "suspect)",
+    "dts_tpu_integrity_audits_requested_total":
+        "Operator-forced shadow verifications requested via POST "
+        "/integrityz/audit",
+    "dts_tpu_integrity_audits_run_total":
+        "Operator-forced shadow verifications actually consumed by a "
+        "dispatched batch",
+    "dts_tpu_integrity_escalations_total":
+        "Detections the plane escalated into the recovery controller's "
+        "output_corrupt cycle (screen-trip threshold or shadow "
+        "mismatch)",
+    "dts_tpu_integrity_suspect":
+        "1 while this replica's own shadow verification has it marked "
+        "suspect (also gossiped in the fleet record so routers steer "
+        "around it); clears after suspect_clear_passes clean compares",
     "dts_tpu_fleet_agg_qps":
         "Fleet-aggregated rolling request rate: the sum of member-"
         "reported windowed qps (scraped /monitoring wires; gossip-"
@@ -803,7 +851,7 @@ class ServerMetrics:
         self, batcher_stats=None, cache=None, row_cache=None, overload=None,
         utilization=None, quality=None, lifecycle=None, pipeline=None,
         recovery=None, kernels=None, mesh=None, elastic=None, fleet=None,
-        cascade=None,
+        cascade=None, integrity=None,
     ) -> str:
         """Prometheus exposition (text format 0.0.4) of the same data
         snapshot() serves as JSON. Metric names mirror tensorflow_model_
@@ -1135,6 +1183,8 @@ class ServerMetrics:
             lines.extend(_fleet_prometheus_lines(fleet))
         if cascade is not None:
             lines.extend(_cascade_prometheus_lines(cascade))
+        if integrity is not None:
+            lines.extend(_integrity_prometheus_lines(integrity))
         return "\n".join(lines) + "\n"
 
 
@@ -1583,6 +1633,46 @@ def _cascade_prometheus_lines(cascade: dict) -> list[str]:
     return lines
 
 
+def _integrity_prometheus_lines(integrity: dict) -> list[str]:
+    """dts_tpu_integrity_* exposition from an integrity_stats() snapshot
+    (ISSUE 20): wire verify/reject/stamp counters, readback-screen
+    trips (lifetime + current escalation window), shadow-verification
+    batches/mismatches + forced-audit counters, recovery escalations,
+    and the replica's live suspect verdict. Families grouped via
+    _family_lines so the one-lint-covers-all invariant holds."""
+    wire = integrity.get("wire") or {}
+    screen = integrity.get("screen") or {}
+    shadow = integrity.get("shadow") or {}
+    lines: list[str] = []
+    for metric, kind, value in (
+        ("dts_tpu_integrity_wire_inputs_verified_total", "counter",
+         wire.get("inputs_verified", 0)),
+        ("dts_tpu_integrity_wire_inputs_rejected_total", "counter",
+         wire.get("inputs_rejected", 0)),
+        ("dts_tpu_integrity_wire_responses_stamped_total", "counter",
+         wire.get("responses_stamped", 0)),
+        ("dts_tpu_integrity_screen_trips_total", "counter",
+         screen.get("trips", 0)),
+        ("dts_tpu_integrity_screen_window_trips", "gauge",
+         screen.get("window_trips", 0)),
+        ("dts_tpu_integrity_shadow_batches_total", "counter",
+         shadow.get("batches", 0)),
+        ("dts_tpu_integrity_shadow_mismatches_total", "counter",
+         shadow.get("mismatches", 0)),
+        ("dts_tpu_integrity_audits_requested_total", "counter",
+         shadow.get("audits_requested", 0)),
+        ("dts_tpu_integrity_audits_run_total", "counter",
+         shadow.get("audits_run", 0)),
+        ("dts_tpu_integrity_escalations_total", "counter",
+         integrity.get("escalations", 0)),
+        ("dts_tpu_integrity_suspect", "gauge",
+         int(bool(integrity.get("suspect")))),
+    ):
+        _family_lines(lines, metric, kind)
+        lines.append(f"{metric} {value}")
+    return lines
+
+
 def _fleet_prometheus_lines(fleet: dict) -> list[str]:
     """dts_tpu_fleet_* exposition from a fleet_stats() snapshot (ISSUE
     17): gossip membership (member count + members-by-state), exchange /
@@ -1676,6 +1766,19 @@ def _fleet_prometheus_lines(fleet: dict) -> list[str]:
         lines.append(
             f'{st}{{source="watch"}} {router.get("watch_updates", 0)}'
         )
+        lines.append(
+            f'{st}{{source="suspect"}} {router.get("suspect_steers", 0)}'
+        )
+        au = "dts_tpu_fleet_router_integrity_audits_total"
+        _family_lines(lines, au, "counter")
+        for outcome, key in (
+            ("run", "integrity_audits"),
+            ("disagreed", "audit_disagreements"),
+            ("suspect_marked", "audit_suspects_marked"),
+        ):
+            lines.append(
+                f'{au}{{outcome="{esc(outcome)}"}} {router.get(key, 0)}'
+            )
         rj = "dts_tpu_fleet_router_rejoins_total"
         _family_lines(lines, rj, "counter")
         lines.append(f"{rj} {router.get('gossip_rejoins', 0)}")

@@ -1,0 +1,671 @@
+"""Data-integrity plane (serving/integrity.py, ISSUE 20): CRC32C wire
+sidecars round-tripping both directions over real gRPC, request-scoped
+corrupt-wire rejection with batchmates delivering, the post-readback NaN
+screen failing exactly the corrupted row, bit-identity shadow
+verification catching an injected bitflip and escalating into the
+recovery cycle, the router's two-replica audit marking the minority
+replica suspect, disabled-plane bit-identity + inertness, [integrity]
+parsing/validation + the shadow-vs-cache refusal, and the /integrityz +
+?section=integrity REST surfaces."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_tf_serving_tpu import codec, faults
+from distributed_tf_serving_tpu.client import (
+    PredictClientError,
+    ShardedPredictClient,
+)
+from distributed_tf_serving_tpu.models import (
+    ModelConfig,
+    Servable,
+    ServableRegistry,
+    build_model,
+    ctr_signatures,
+)
+from distributed_tf_serving_tpu.serving import (
+    DynamicBatcher,
+    PredictionServiceImpl,
+)
+from distributed_tf_serving_tpu.serving.batcher import (
+    fold_ids_host,
+    poison_fault_key,
+    prepare_inputs,
+)
+from distributed_tf_serving_tpu.serving.integrity import (
+    IntegrityPlane,
+    IntegrityScreenError,
+    OutputCorruptError,
+)
+from distributed_tf_serving_tpu.serving.recovery import (
+    SERVING,
+    RecoveryController,
+)
+from distributed_tf_serving_tpu.utils.config import (
+    IntegrityConfig,
+    RecoveryConfig,
+    load_config,
+)
+
+CFG = ModelConfig(
+    num_fields=8, vocab_size=1009, embed_dim=4, mlp_dims=(16,),
+    num_cross_layers=1, compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def servable():
+    model = build_model("dcn", CFG)
+    return Servable(
+        name="DCN", version=1, model=model,
+        params=model.init(jax.random.PRNGKey(0)),
+        signatures=ctr_signatures(CFG.num_fields),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset(seed=0)
+    yield
+    faults.reset(seed=0)
+
+
+def make_arrays(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(
+            0, 1 << 40, size=(n, CFG.num_fields)
+        ).astype(np.int64),
+        "feat_wts": rng.rand(n, CFG.num_fields).astype(np.float32),
+    }
+
+
+def reference_scores(servable, arrays):
+    batch = {
+        "feat_ids": fold_ids_host(arrays["feat_ids"], CFG.vocab_size),
+        "feat_wts": arrays["feat_wts"],
+    }
+    return np.asarray(
+        servable.model.apply(servable.params, batch)["prediction_node"]
+    )
+
+
+def _plane(**kw) -> IntegrityPlane:
+    return IntegrityConfig(enabled=True, **kw).build()
+
+
+def _stack(servable, *, plane=None, recovery=False, **bkw):
+    registry = ServableRegistry()
+    registry.load(servable)
+    defaults = dict(buckets=(32, 64), max_wait_us=0)
+    defaults.update(bkw)
+    batcher = DynamicBatcher(**defaults).start()
+    impl = PredictionServiceImpl(registry, batcher)
+    rec = None
+    if recovery:
+        rec = RecoveryController(
+            RecoveryConfig(
+                enabled=True, reinit_warmup=False, replay_drain_s=10.0
+            ),
+            batcher, registry=registry, impl=impl,
+        )
+        impl.recovery = rec
+    if plane is not None:
+        batcher.integrity = plane
+        impl.integrity = plane
+    return batcher, impl, rec
+
+
+# ------------------------------------------------ wire layer, over real gRPC
+
+
+@pytest.fixture()
+def wired_backend(servable):
+    """A real gRPC server with the integrity plane armed; yields
+    (address, plane, batcher)."""
+    from distributed_tf_serving_tpu.serving.server import create_server
+
+    plane = _plane()
+    batcher, impl, _ = _stack(servable, plane=plane)
+    server, port = create_server(impl, "127.0.0.1:0")
+    server.start()
+    yield f"127.0.0.1:{port}", plane, batcher
+    server.stop(0)
+    batcher.stop()
+
+
+def test_wire_crc_roundtrip_both_directions(wired_backend, servable):
+    """Clean traffic with checksums on both ends: the server verifies the
+    request stamp, the client verifies the response stamp, and the
+    scores are untouched by either."""
+    addr, plane, _ = wired_backend
+    arrays = make_arrays(9, seed=3)
+
+    async def go():
+        async with ShardedPredictClient(
+            [addr], "DCN", integrity_checksums=True,
+        ) as client:
+            got = await client.predict(arrays)
+            return got, client.resilience_counters()
+
+    got, counters = asyncio.run(go())
+    np.testing.assert_allclose(
+        got, reference_scores(servable, arrays), rtol=1e-6
+    )
+    snap = plane.snapshot()
+    assert snap["wire"]["inputs_verified"] >= 1
+    assert snap["wire"]["inputs_rejected"] == 0
+    assert snap["wire"]["responses_stamped"] >= 1
+    assert counters["corrupt_responses"] == 0
+
+
+def test_corrupt_request_fails_alone_batchmates_deliver(
+    wired_backend, servable
+):
+    """One request's feat_ids bytes flipped in flight (client-side
+    injection after stamping): the server must reject exactly that
+    request with a corrupt-wire INVALID_ARGUMENT while its two
+    companions score correctly."""
+    addr, plane, _ = wired_backend
+    payloads = [make_arrays(5, seed=s) for s in (20, 21, 22)]
+    faults.get().add("wire_corrupt", "error", key="feat_ids", count=1)
+
+    async def go():
+        async with ShardedPredictClient(
+            [addr], "DCN", integrity_checksums=True,
+        ) as client:
+            return await asyncio.gather(
+                *(client.predict(p) for p in payloads),
+                return_exceptions=True,
+            )
+
+    results = asyncio.run(go())
+    errs = [r for r in results if isinstance(r, Exception)]
+    assert len(errs) == 1
+    assert isinstance(errs[0], PredictClientError)
+    assert "corrupt-wire" in str(errs[0])
+    for p, r in zip(payloads, results):
+        if not isinstance(r, Exception):
+            np.testing.assert_allclose(
+                r, reference_scores(servable, p), rtol=1e-6
+            )
+    assert plane.snapshot()["wire"]["inputs_rejected"] == 1
+
+
+def test_corrupt_response_caught_before_merge(wired_backend, servable):
+    """A response-side wire flip (key="response"): the verifying client
+    must catch the checksum mismatch before merge, record the corrupt
+    verdict, and retry to a CLEAN answer — corrupt bytes never become
+    scores."""
+    addr, plane, _ = wired_backend
+    arrays = make_arrays(7, seed=31)
+    faults.get().add("wire_corrupt", "error", key="response", count=1)
+
+    async def go():
+        async with ShardedPredictClient(
+            [addr], "DCN", integrity_checksums=True, scoreboard=True,
+            failover_attempts=3, backoff_initial_s=0.0,
+        ) as client:
+            got = await client.predict(arrays)
+            return got, client.resilience_counters()
+
+    got, counters = asyncio.run(go())
+    np.testing.assert_allclose(
+        got, reference_scores(servable, arrays), rtol=1e-6
+    )
+    assert counters["corrupt_responses"] == 1
+    assert counters["scoreboard"]["corruptions"] == 1
+
+
+# --------------------------------------------------------- readback screen
+
+
+def test_screen_fails_exactly_the_nan_row(servable):
+    """A content-keyed score_nan rule poisons one request's score rows
+    after readback: that request alone fails IntegrityScreenError while
+    its coalesced batchmates deliver correct scores."""
+    plane = _plane()
+    batcher, _, _ = _stack(servable, plane=plane, max_wait_us=100_000)
+    try:
+        payloads = [make_arrays(5, seed=s) for s in (40, 41, 42)]
+        key = poison_fault_key(
+            prepare_inputs(servable.model, payloads[1], fold_ids=False)
+        )
+        faults.get().add("score_nan", "error", key=key)
+        futs = [batcher.submit(servable, p) for p in payloads]
+        with pytest.raises(IntegrityScreenError):
+            futs[1].result(timeout=60)
+        for i in (0, 2):
+            got = futs[i].result(timeout=60)["prediction_node"]
+            np.testing.assert_allclose(
+                got, reference_scores(servable, payloads[i]), rtol=1e-6
+            )
+        snap = plane.snapshot()
+        assert snap["screen"]["trips"] == 1
+        # One trip under the default 3/window threshold: row-scoped, no
+        # escalation, not suspect.
+        assert snap["escalations"] == 0 and snap["suspect"] is False
+    finally:
+        batcher.stop()
+
+
+def test_screen_trip_window_escalates_once():
+    """Trips past the threshold inside the window escalate exactly once
+    (the window is consumed), and the plane marks itself suspect."""
+    t = [0.0]
+    plane = IntegrityPlane(
+        IntegrityConfig(
+            enabled=True, screen_trips_per_window=2, screen_window_s=5.0
+        ),
+        clock=lambda: t[0],
+    )
+    plane.note_screen_trip("test")
+    assert plane.maybe_escalate_screen(None) is False
+    t[0] = 1.0
+    plane.note_screen_trip("test")
+    assert plane.maybe_escalate_screen(None) is True
+    assert plane.suspect is True and plane.escalations == 1
+    # Window consumed: the same burst does not escalate twice.
+    assert plane.maybe_escalate_screen(None) is False
+    # Stale trips age out of the window.
+    t[0] = 100.0
+    plane.note_screen_trip("test")
+    assert plane.maybe_escalate_screen(None) is False
+
+
+# ----------------------------------------------------- shadow verification
+
+
+def test_shadow_catches_bitflip_and_escalates_to_recovery(servable):
+    """An injected readback bitflip under shadow_fraction=1.0: the
+    bit-identity compare must catch it BEFORE delivery, escalate through
+    the recovery cycle with trigger output_corrupt, and the replayed
+    batch must deliver correct scores."""
+    plane = _plane(shadow_fraction=1.0)
+    batcher, _, rec = _stack(servable, plane=plane, recovery=True)
+    try:
+        faults.get().add("readback_bitflip", "error", count=1)
+        arrays = make_arrays(9, seed=50)
+        got = batcher.submit(servable, arrays).result(timeout=90)
+        np.testing.assert_allclose(
+            got["prediction_node"], reference_scores(servable, arrays),
+            rtol=1e-6,
+        )
+        deadline = time.perf_counter() + 10
+        while rec.cycle_active() and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        snap = plane.snapshot()
+        assert snap["shadow"]["mismatches"] == 1
+        assert snap["escalations"] >= 1
+        assert snap["suspect"] is True
+        assert "shadow mismatch" in snap["suspect_reason"]
+        rsnap = rec.snapshot()
+        assert rsnap["counters"]["quarantines"] >= 1
+        assert rsnap["last_cycle"]["trigger"] == "output_corrupt"
+        assert rsnap["state"] == SERVING
+    finally:
+        rec.stop()
+        batcher.stop()
+
+
+def test_shadow_sampler_and_on_demand_audit(servable):
+    """The deterministic accumulator realizes the fraction exactly, and
+    request_audit() forces the next batch regardless of fraction."""
+    plane = _plane(shadow_fraction=0.5)
+    assert [plane.want_shadow() for _ in range(4)] == [
+        False, True, False, True
+    ]
+    off = _plane(shadow_fraction=0.0)
+    assert not any(off.want_shadow() for _ in range(8))
+    assert off.request_audit(2) == 2
+    assert [off.want_shadow() for _ in range(3)] == [True, True, False]
+    assert off.snapshot()["shadow"]["audits_run"] == 2
+
+    batcher, _, _ = _stack(servable, plane=off)
+    try:
+        off.request_audit()
+        arrays = make_arrays(6, seed=60)
+        got = batcher.submit(servable, arrays).result(timeout=60)
+        np.testing.assert_allclose(
+            got["prediction_node"], reference_scores(servable, arrays),
+            rtol=1e-6,
+        )
+        snap = off.snapshot()
+        assert snap["shadow"]["batches"] >= 1
+        assert snap["shadow"]["mismatches"] == 0
+    finally:
+        batcher.stop()
+
+
+def test_suspect_clears_after_consecutive_clean_passes():
+    plane = _plane(suspect_clear_passes=2)
+    plane._escalate("test")
+    assert plane.suspect is True
+    ok = [np.ones(4, np.float32)]
+    plane.shadow_compare(ok, ok)
+    assert plane.suspect is True  # 1 of 2
+    plane.shadow_compare(ok, ok)
+    assert plane.suspect is False
+    with pytest.raises(OutputCorruptError):
+        plane.shadow_compare(ok, [np.zeros(4, np.float32)])
+    assert plane.suspect is True
+
+
+# ------------------------------------------------------- router audit tier
+
+
+def _router_cfgs(hosts, integrity=None):
+    from distributed_tf_serving_tpu.utils.config import (
+        ClientConfig,
+        ServerConfig,
+    )
+
+    return {
+        "server": ServerConfig(host="127.0.0.1", port=0),
+        "client": ClientConfig(
+            hosts=tuple(hosts), model_name="DCN",
+            num_fields=CFG.num_fields, timeout_s=5.0,
+            health_scoreboard=True, failover_attempts=1,
+            backoff_initial_ms=0, placement="affinity",
+        ),
+        "fleet": None,
+        "integrity": integrity,
+    }
+
+
+def test_router_audit_marks_minority_suspect():
+    """Two healthy replicas disagree on the audited score bytes; a third
+    tiebreaks and the MINORITY is marked corrupt in the scoreboard.
+    Three distinct answers mark nobody; probe failures are inconclusive."""
+    from distributed_tf_serving_tpu.fleet.router import Router
+
+    hosts = ["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"]
+    x = np.arange(4, dtype=np.float32)
+    y = x + 1.0
+
+    async def go():
+        router = Router(_router_cfgs(
+            hosts,
+            integrity=IntegrityConfig(
+                enabled=True, router_audit_fraction=1.0
+            ),
+        ))
+        sb = router.client.scoreboard
+        answers = {0: x, 1: y, 2: x}  # replica 1 is the minority
+
+        async def fake_call(idx, arrays):
+            return answers[idx]
+
+        router._audit_call = fake_call
+        assert router._want_audit() is True
+        assert await router.audit(make_arrays(4)) is False
+        assert router.audits == 1
+        assert router.audit_disagreements == 1
+        assert router.audit_suspects_marked == 1
+        assert sb.corruptions == 1  # exactly the minority, exactly once
+        # Three distinct answers: no majority, nobody convicted.
+        answers.update({0: x, 1: y, 2: x + 2.0})
+        assert await router.audit(make_arrays(4)) is False
+        assert router.audit_suspects_marked == 1
+        # An unanswerable probe is inconclusive, never a health signal.
+        answers[1] = None
+
+        async def flaky_call(idx, arrays):
+            return answers[idx]
+
+        router._audit_call = flaky_call
+        assert await router.audit(make_arrays(4)) is None
+        assert sb.corruptions == 1
+        await router.client.close()
+
+    asyncio.run(go())
+
+
+def test_router_audit_sampler_and_gating():
+    from distributed_tf_serving_tpu.fleet.router import Router
+
+    async def go():
+        # No [integrity] section: never audits.
+        r = Router(_router_cfgs(["127.0.0.1:1", "127.0.0.1:2"]))
+        assert not any(r._want_audit() for _ in range(4))
+        await r.client.close()
+        # Armed at 0.5: every second forward samples.
+        r = Router(_router_cfgs(
+            ["127.0.0.1:1", "127.0.0.1:2"],
+            integrity=IntegrityConfig(
+                enabled=True, router_audit_fraction=0.5
+            ),
+        ))
+        assert [r._want_audit() for _ in range(4)] == [
+            False, True, False, True
+        ]
+        counters = r.fleetz()["counters"]
+        assert counters["integrity_audits"] == 0
+        assert counters["audit_disagreements"] == 0
+        assert counters["audit_suspects_marked"] == 0
+        await r.client.close()
+        # One backend: a two-replica compare is impossible.
+        r = Router(_router_cfgs(
+            ["127.0.0.1:1"],
+            integrity=IntegrityConfig(
+                enabled=True, router_audit_fraction=1.0
+            ),
+        ))
+        assert r._want_audit() is False
+        await r.client.close()
+
+    asyncio.run(go())
+
+
+def test_gossip_suspect_record_steers():
+    """A replica gossiping suspect=True (its own shadow verification
+    escalated) is busy-steered by the router without any failed RPC —
+    and rehabilitates on the next clean record."""
+    from distributed_tf_serving_tpu.fleet.gossip import HealthRecord
+    from distributed_tf_serving_tpu.fleet.router import Router
+
+    async def go():
+        router = Router(_router_cfgs(["127.0.0.1:1", "127.0.0.1:2"]))
+        sb = router.client.scoreboard
+        router.fold_gossip(
+            HealthRecord(
+                id="127.0.0.1:2", seq=1, state="serving", suspect=True
+            )
+        )
+        assert router.suspect_steers == 1
+        assert sb.corruptions == 1
+        router.fold_gossip(
+            HealthRecord(id="127.0.0.1:2", seq=2, state="serving")
+        )
+        assert router.suspect_steers == 1
+        await router.client.close()
+
+    asyncio.run(go())
+
+
+# --------------------------------------- disabled plane: bit-identity, inert
+
+
+def test_disabled_plane_is_inert_and_bit_identical(servable):
+    arrays = make_arrays(11, seed=70)
+    batcher, impl, _ = _stack(servable)
+    try:
+        assert batcher.integrity is None and impl.integrity is None
+        assert impl.integrity_stats() is None
+        ref = batcher.submit(servable, arrays).result(timeout=60)[
+            "prediction_node"
+        ]
+    finally:
+        batcher.stop()
+    # Armed but passive (shadow off, screen on, wire on): the plane must
+    # not change a single byte of the answer.
+    plane = _plane()
+    batcher, impl, _ = _stack(servable, plane=plane)
+    try:
+        got = batcher.submit(servable, arrays).result(timeout=60)[
+            "prediction_node"
+        ]
+        assert np.array_equal(ref, got)
+        snap = plane.snapshot()
+        assert snap["screen"]["trips"] == 0
+        assert snap["shadow"]["batches"] == 0  # sampled shadowing off
+        assert impl.integrity_stats()["enabled"] is True
+    finally:
+        batcher.stop()
+
+
+# ----------------------------------------------------- config + build_stack
+
+
+def test_integrity_config_parsing(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text(
+        "[integrity]\nenabled = true\nshadow_fraction = 0.25\n"
+        "screen_trips_per_window = 5\nscreen_min = 0.0\n"
+        "screen_max = 1.0\nrouter_audit_fraction = 0.01\n"
+    )
+    ic = load_config(p)["integrity"]
+    assert ic.enabled and ic.shadow_fraction == 0.25
+    assert ic.screen_trips_per_window == 5
+    assert (ic.screen_min, ic.screen_max) == (0.0, 1.0)
+    assert ic.router_audit_fraction == 0.01
+    # Absent section: defaults, disabled.
+    p2 = tmp_path / "empty.toml"
+    p2.write_text("")
+    assert load_config(p2)["integrity"].enabled is False
+    with pytest.raises(ValueError, match="shadow_fraction"):
+        IntegrityConfig(shadow_fraction=1.5)
+    with pytest.raises(ValueError, match="screen_trips_per_window"):
+        IntegrityConfig(screen_trips_per_window=0)
+    with pytest.raises(ValueError, match="screen_max"):
+        IntegrityConfig(screen_min=0.5, screen_max=0.1)
+    with pytest.raises(ValueError, match="unknown IntegrityConfig"):
+        p3 = tmp_path / "bad.toml"
+        p3.write_text("[integrity]\nnot_a_knob = 1\n")
+        load_config(p3)
+
+
+def test_shadow_refuses_score_cache():
+    """Shadow verification + exact-match score cache: refused at build
+    time (cache hits re-serve bytes no detection layer can re-check).
+    Wire checksums + screens alone still compose with the cache."""
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import (
+        CacheConfig,
+        ServerConfig,
+    )
+
+    cfg = ServerConfig(model_kind="dcn", buckets=(16,), warmup=False)
+    model_config = ModelConfig(
+        name="DCN", num_fields=CFG.num_fields, vocab_size=CFG.vocab_size,
+        embed_dim=4, mlp_dims=(16,), num_cross_layers=1,
+        compute_dtype="float32",
+    )
+    with pytest.raises(ValueError, match="conflicts with .cache."):
+        build_stack(
+            cfg, model_config=model_config,
+            integrity_config=IntegrityConfig(
+                enabled=True, shadow_fraction=0.1
+            ),
+            cache_config=CacheConfig(enabled=True),
+        )
+    # shadow_fraction=0: composes — plane armed next to the cache.
+    _, batcher, impl, _, _, _ = build_stack(
+        cfg, model_config=model_config,
+        integrity_config=IntegrityConfig(enabled=True),
+        cache_config=CacheConfig(enabled=True),
+    )
+    try:
+        assert impl.integrity is not None
+        assert batcher.integrity is impl.integrity
+    finally:
+        batcher.stop()
+
+
+def test_build_stack_disabled_by_default():
+    from distributed_tf_serving_tpu.serving.server import build_stack
+    from distributed_tf_serving_tpu.utils.config import ServerConfig
+
+    cfg = ServerConfig(model_kind="dcn", buckets=(16,), warmup=False)
+    model_config = ModelConfig(
+        name="DCN", num_fields=CFG.num_fields, vocab_size=CFG.vocab_size,
+        embed_dim=4, mlp_dims=(16,), num_cross_layers=1,
+        compute_dtype="float32",
+    )
+    _, batcher, impl, _, _, _ = build_stack(
+        cfg, model_config=model_config,
+        integrity_config=IntegrityConfig(),
+    )
+    try:
+        assert impl.integrity is None and batcher.integrity is None
+    finally:
+        batcher.stop()
+
+
+# -------------------------------------------------------------- REST plane
+
+
+def test_integrityz_monitoring_and_audit_routes(servable):
+    import aiohttp
+
+    from distributed_tf_serving_tpu.serving.rest import start_rest_gateway
+
+    plane = _plane(shadow_fraction=0.5)
+    batcher, impl, _ = _stack(servable, plane=plane)
+
+    async def go():
+        runner, port = await start_rest_gateway(impl, port=0)
+        try:
+            async with aiohttp.ClientSession(
+                f"http://127.0.0.1:{port}"
+            ) as s:
+                async with s.get("/integrityz") as r:
+                    body = await r.json()
+                    assert r.status == 200 and body["enabled"] is True
+                    assert body["shadow"]["fraction"] == 0.5
+                # ?section=integrity serves ONLY this block — the
+                # builders-dict contract: no other plane is built.
+                async with s.get("/monitoring?section=integrity") as r:
+                    sec = await r.json()
+                    assert r.status == 200
+                    assert set(sec) == {"integrity"}
+                    assert sec["integrity"]["enabled"] is True
+                async with s.get("/monitoring?section=nope") as r:
+                    assert r.status == 400
+                async with s.get("/monitoring") as r:
+                    assert "integrity" in await r.json()
+                async with s.post("/integrityz/audit?batches=3") as r:
+                    body = await r.json()
+                    assert r.status == 200
+                    assert body == {"requested": 3, "pending_audits": 3}
+                async with s.post("/integrityz/audit?batches=zero") as r:
+                    assert r.status == 400
+                async with s.post("/integrityz/audit?batches=0") as r:
+                    assert r.status == 400
+                async with s.get("/monitoring/prometheus/metrics") as r:
+                    text = await r.text()
+                assert "dts_tpu_integrity_shadow_batches_total 0" in text
+                assert "dts_tpu_integrity_suspect 0" in text
+                assert (
+                    "dts_tpu_integrity_audits_requested_total 3" in text
+                )
+                # Detached: routes degrade, the block disappears.
+                impl.integrity = None
+                async with s.get("/integrityz") as r:
+                    assert (await r.json()) == {"enabled": False}
+                async with s.post("/integrityz/audit") as r:
+                    assert r.status == 404
+                async with s.get("/monitoring") as r:
+                    assert "integrity" not in await r.json()
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(go())
+    finally:
+        batcher.stop()

@@ -259,6 +259,31 @@ def _fully_armed_text() -> str:
         "stage2_seconds_total": 1.4,
         "survivor_buckets": {"256": 50, "1024": 5},
     }
+    # Integrity plane (ISSUE 20, the sixteenth plane): the shape
+    # impl.integrity_stats() emits mid-incident — wire counters live,
+    # a screen window partially filled, one shadow mismatch escalated,
+    # the replica currently suspect.
+    integrity = {
+        "enabled": True,
+        "wire": {
+            "inputs_verified": 300, "inputs_rejected": 2,
+            "responses_stamped": 298,
+        },
+        "screen": {"trips": 4, "window_trips": 1},
+        "shadow": {
+            "fraction": 0.02, "batches": 9, "mismatches": 1,
+            "audits_requested": 3, "audits_run": 3,
+        },
+        "escalations": 1,
+        "suspect": True,
+        "suspect_reason": "shadow mismatch",
+    }
+    # The router side of the plane rides the fleet block: two-replica
+    # audit counters + suspect-gossip steers.
+    fleet["router"].update({
+        "suspect_steers": 2, "integrity_audits": 12,
+        "audit_disagreements": 1, "audit_suspects_marked": 1,
+    })
     return m.prometheus_text(
         stats,
         cache=cache.snapshot(),
@@ -274,6 +299,7 @@ def _fully_armed_text() -> str:
         elastic=elastic,
         fleet=fleet,
         cascade=cascade,
+        integrity=integrity,
     )
 
 
@@ -307,6 +333,11 @@ def test_fully_armed_snapshot_passes_lint():
         "dts_tpu_cascade_stage_seconds_total",
         "dts_tpu_cascade_survivor_bucket_total",
         "dts_tpu_cascade_rank_fraction",
+        "dts_tpu_integrity_", "dts_tpu_integrity_wire_inputs_rejected_total",
+        "dts_tpu_integrity_screen_trips_total",
+        "dts_tpu_integrity_shadow_mismatches_total",
+        "dts_tpu_integrity_suspect",
+        "dts_tpu_fleet_router_integrity_audits_total",
     ):
         assert marker in text
 

@@ -280,4 +280,26 @@ if [ "$rc" -eq 0 ] && [ "${TIER1_CASCADE_SMOKE:-0}" = "1" ]; then
         python tools/soak.py | tee "$CASCADE_LINE" || rc=1
     python tools/check_cascade_smoke.py "$CASCADE_LINE" || rc=1
 fi
+
+# Integrity smoke (TIER1_INTEGRITY_SMOKE=1, ISSUE 20): a SOAK_INTEGRITY=1
+# chaos soak — wire flips both directions, readback bitflips, NaN score
+# rows injected mid-run against the armed data-integrity plane
+# (shadow_fraction=1.0, recovery controller live, verifying client) —
+# must report detections on EVERY layer (server wire rejects, client
+# corrupt-response catches, readback screen trips, shadow mismatches),
+# zero NaN scores merged, every client-visible error an integrity
+# rejection/retry, escalations landing in completed recovery cycles,
+# bounded detection->success MTTR, clean traffic bit-identical plane-on
+# vs off both before and after chaos, and the /integrityz +
+# ?section=integrity + dts_tpu_integrity_* surfaces live
+# (tools/check_integrity_smoke.py). Longer budget: shadow verification
+# doubles the forward work and each escalation re-warms the ladder.
+if [ "$rc" -eq 0 ] && [ "${TIER1_INTEGRITY_SMOKE:-0}" = "1" ]; then
+    INTEGRITY_LINE="${TIER1_INTEGRITY_LINE:-/tmp/tier1_integrity_soak.json}"
+    echo "tier1: integrity smoke (SOAK_INTEGRITY=1, line $INTEGRITY_LINE)"
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        SOAK_SECONDS="${TIER1_INTEGRITY_SECONDS:-25}" SOAK_INTEGRITY=1 \
+        python tools/soak.py | tee "$INTEGRITY_LINE" || rc=1
+    python tools/check_integrity_smoke.py "$INTEGRITY_LINE" || rc=1
+fi
 exit $rc

@@ -154,6 +154,32 @@ surfaces. The JSON line gains a `recovery` block gated in CI by
 tools/check_recovery_smoke.py (quarantine + replay observed, MTTR
 bounded, zero non-poison failures, bisection isolating the poison).
 
+Integrity mode (SOAK_INTEGRITY=1): the data-integrity plane (ISSUE 20,
+serving/integrity.py) under live traffic with all three silent-corruption
+fault sites armed mid-run. The plane runs with shadow_fraction=1.0
+(SOAK_INTEGRITY_SHADOW) and the recovery controller armed; the gRPC
+client verifies response checksums (integrity_checksums=True) with
+scoreboard + deep failover. The scenario: pre-traffic CLEAN bit-identity
+probe (plane detached vs attached with a forced shadow audit — the plane
+must never change answers); phase 1 arms `score_nan` with shadow stood
+down (the readback screen must catch NaN rows row-granularly and
+escalate past screen_trips_per_window into an output_corrupt recovery
+cycle); phase 2 re-arms shadow and injects `readback_bitflip` (the
+bit-identical shadow compare must catch every flipped batch before
+delivery and escalate) plus `wire_corrupt` both directions (request-side
+keyed on feat_ids — the server must fail exactly the damaged request
+with a corrupt-wire INVALID_ARGUMENT; response-side keyed "response" —
+the client verify must catch the flip and retry, never merging corrupt
+scores); detection-to-success MTTR is measured from the first shadow
+mismatch; a closing clean bit-identity probe runs after faults clear.
+The JSON line gains an `integrity` block — the plane snapshot, both
+bit-identity verdicts, per-phase screen counters, MTTR, client
+corrupt_responses / nan_scores_merged, recovery escalation counters, and
+live /integrityz + ?section=integrity + Prometheus probes — gated in CI
+(TIER1_INTEGRITY_SMOKE=1) by tools/check_integrity_smoke.py (detections
+on every layer, zero NaN merges, zero corrupt deliveries, bit-identity
+both ends, escalation observed).
+
 Fleet mode (SOAK_FLEET=1): the fleet robustness plane (ISSUE 17,
 fleet/) as REAL PROCESSES — SOAK_FLEET_REPLICAS (default 3) serving
 replicas, each a full `serving.server` subprocess with a version watcher
@@ -980,6 +1006,11 @@ def main() -> None:
     # bisection must fail exactly the poison with its distinct status
     # while the companions replay to success).
     recovery_mode = os.environ.get("SOAK_RECOVERY", "0") == "1"
+    # Integrity mode (SOAK_INTEGRITY=1): the data-integrity plane's
+    # chaos scenario — wire flips, readback bitflips, NaN rows — with
+    # the recovery controller armed for the escalation path and the
+    # client verifying response checksums; see module docstring.
+    integrity_mode = os.environ.get("SOAK_INTEGRITY", "0") == "1"
     # Cascade mode (SOAK_CASCADE=1): multi-stage retrieval->rank through
     # serving/cascade.py on every score-filtered gRPC request — stage-1
     # two_tower over the full candidate batch, on-device prune to 25%
@@ -992,10 +1023,12 @@ def main() -> None:
         candidates = int(os.environ.get("SOAK_CANDIDATES", "16"))
         grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "4"))
         rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "0"))
-    elif recovery_mode:
+    elif recovery_mode or integrity_mode:
         # Small bucket + modest load: each reinit round re-warms the
         # ladder, so the cycle time (and with it the client retry
         # horizon) must stay in low seconds on a CPU-only CI host.
+        # (Integrity mode escalates into the same reinit cycles, and
+        # its shadow_fraction=1.0 doubles the forward work besides.)
         candidates = int(os.environ.get("SOAK_CANDIDATES", "200"))
         grpc_workers = int(os.environ.get("SOAK_GRPC_WORKERS", "4"))
         rest_workers = int(os.environ.get("SOAK_REST_WORKERS", "0"))
@@ -1185,7 +1218,7 @@ def main() -> None:
         # One small bucket: three versions each warm the ladder through
         # the queue mid-soak, and the candidates are 16-row requests.
         buckets = (64,)
-    elif recovery_mode:
+    elif recovery_mode or integrity_mode:
         # One small bucket: every reinit round re-warms the whole ladder
         # through the queue, and the recovery cycle must finish inside
         # the client retry horizon.
@@ -1301,12 +1334,15 @@ def main() -> None:
 
     recovery_block: dict = {}
     recovery_ctrl = None
-    if recovery_mode:
+    if recovery_mode or integrity_mode:
         from distributed_tf_serving_tpu.serving.recovery import (
             RecoveryController,
         )
         from distributed_tf_serving_tpu.utils.config import RecoveryConfig
 
+        # Integrity mode arms the SAME controller: the plane's screen
+        # threshold and shadow mismatches escalate through take_group
+        # into the output_corrupt quarantine->reinit->replay cycle.
         recovery_ctrl = RecoveryController(
             RecoveryConfig(
                 enabled=True,
@@ -1498,6 +1534,47 @@ def main() -> None:
             for k in ("requests", "rows_requested", "rows_ranked",
                       "pruned_rows")
         }
+
+    integrity_block: dict = {}
+    integrity_plane = None
+    integrity_ref: dict = {}
+    if integrity_mode:
+        from distributed_tf_serving_tpu.utils.config import IntegrityConfig
+
+        integrity_plane = IntegrityConfig(
+            enabled=True,
+            shadow_fraction=float(
+                os.environ.get("SOAK_INTEGRITY_SHADOW", "1.0")
+            ),
+            screen_trips_per_window=int(
+                os.environ.get("SOAK_INTEGRITY_TRIPS", "3")
+            ),
+            screen_window_s=5.0,
+        ).build()
+        batcher.integrity = integrity_plane
+        impl.integrity = integrity_plane
+        # Pre-flight CLEAN bit-identity probe (the gate's correctness
+        # bar): the plane must never change answers. Reference with the
+        # plane detached; then the same payload armed — with a FORCED
+        # shadow audit so the compare path itself runs — must answer
+        # byte-identically.
+        probe = unique_pool[0]
+        batcher.integrity = None
+        ref = batcher.submit(
+            servable, probe, output_keys=("prediction_node",)
+        ).result(timeout=600)["prediction_node"]
+        batcher.integrity = integrity_plane
+        integrity_plane.request_audit()
+        armed = batcher.submit(
+            servable, probe, output_keys=("prediction_node",)
+        ).result(timeout=600)["prediction_node"]
+        integrity_ref["scores"] = ref
+        integrity_block["clean_bit_identical"] = bool(
+            np.array_equal(ref, armed)
+        )
+        integrity_block["probe_audits_run"] = (
+            integrity_plane.snapshot()["shadow"]["audits_run"]
+        )
 
     rest_cols = {
         "feat_ids": wide["feat_ids"][:64].tolist(),
@@ -1957,6 +2034,148 @@ def main() -> None:
             if ln.startswith("dts_tpu_recovery_")
         )
 
+    async def integrity_driver(client):
+        """Integrity chaos scenario: NaN rows against the readback screen
+        (shadow stood down so the row-granular path is the one proving
+        itself), then readback bitflips against shadow verification plus
+        wire corruption both directions, then a clean closing window with
+        a post-chaos bit-identity probe. Detection latencies and the
+        detection->success MTTR land in integrity_block for the gate."""
+        import dataclasses as _dc
+
+        from distributed_tf_serving_tpu import faults as faults_mod
+
+        loop_ = asyncio.get_running_loop()
+        shadow_cfg = integrity_plane.config
+        # --- phase 1: NaN rows -> the readback screen -------------------
+        await asyncio.sleep(seconds * 0.15)
+        # Shadow stands down for this phase: the compare runs pre-widen
+        # and would catch the NaN first, masking the screen under test.
+        integrity_plane.config = _dc.replace(
+            shadow_cfg, shadow_fraction=0.0
+        )
+        faults_mod.get().add(
+            "score_nan", "error",
+            rate=float(os.environ.get("SOAK_INTEGRITY_NAN_RATE", "0.08")),
+        )
+        integrity_block["nan_injected"] = True
+        t_nan = time.perf_counter()
+        while time.perf_counter() < deadline:
+            snap = integrity_plane.snapshot()
+            if snap["screen"]["trips"] >= 1 and snap["escalations"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        integrity_block["screen_detect_s"] = round(
+            time.perf_counter() - t_nan, 3
+        )
+        faults_mod.get().clear("score_nan")
+        integrity_block["screen_after_nan"] = (
+            integrity_plane.snapshot()["screen"]
+        )
+        # --- phase 2: bitflips + wire corruption, shadow re-armed -------
+        integrity_plane.config = shadow_cfg
+        faults_mod.get().add(
+            "readback_bitflip", "error",
+            rate=float(os.environ.get("SOAK_INTEGRITY_FLIP_RATE", "0.02")),
+        )
+        # Request-side wire flip, keyed on the tensor name the client
+        # stamps: the server must reject EXACTLY the damaged request
+        # (corrupt-wire INVALID_ARGUMENT) while batchmates deliver.
+        faults_mod.get().add(
+            "wire_corrupt", "error",
+            rate=float(os.environ.get("SOAK_INTEGRITY_WIRE_RATE", "0.03")),
+            key="feat_ids",
+        )
+        # Response-side wire flip: the verifying client must catch the
+        # checksum mismatch (scoreboard kind="corrupt"), never merge the
+        # corrupt scores, and retry the shard.
+        faults_mod.get().add(
+            "wire_corrupt", "error",
+            rate=float(os.environ.get("SOAK_INTEGRITY_RESP_RATE", "0.05")),
+            key="response",
+        )
+        integrity_block["chaos_injected"] = True
+        t_flip = time.perf_counter()
+        while time.perf_counter() < deadline:
+            if integrity_plane.snapshot()["shadow"]["mismatches"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        integrity_block["shadow_detect_s"] = round(
+            time.perf_counter() - t_flip, 3
+        )
+        # Detection -> next clean answer is the MTTR the gate bounds:
+        # the mismatch escalated into a recovery cycle, so a fresh
+        # request succeeding means the replica came back serving.
+        probe = make_payload(candidates=64, num_fields=NUM_FIELDS, seed=911)
+        t_detect = time.perf_counter()
+        while time.perf_counter() < deadline:
+            try:
+                await client.predict(probe)
+                break
+            except Exception:  # noqa: BLE001 — still recovering
+                await asyncio.sleep(0.05)
+        integrity_block["detect_to_success_s"] = round(
+            time.perf_counter() - t_detect, 3
+        )
+        # Keep the wire sites firing under steady traffic, then clear
+        # everything so the run ends on a clean window.
+        await asyncio.sleep(
+            max(0.0, (deadline - time.perf_counter()) - seconds * 0.25)
+        )
+        faults_mod.get().clear("wire_corrupt")
+        faults_mod.get().clear("readback_bitflip")
+        integrity_block["faults_cleared"] = True
+        # --- closing clean bit-identity probe ---------------------------
+        # Wait out any in-flight recovery cycle first: the probe measures
+        # the steady state after chaos, not mid-reinit unavailability.
+        while time.perf_counter() < deadline:
+            if (recovery_ctrl.state() == "serving"
+                    and not recovery_ctrl.cycle_active()):
+                break
+            await asyncio.sleep(0.05)
+
+        def closing_probe():
+            integrity_plane.request_audit()
+            out = batcher.submit(
+                servable, unique_pool[0], output_keys=("prediction_node",)
+            ).result(timeout=600)["prediction_node"]
+            return bool(np.array_equal(integrity_ref["scores"], out))
+
+        try:
+            integrity_block["clean_bit_identical_post"] = (
+                await loop_.run_in_executor(None, closing_probe)
+            )
+        except Exception as e:  # noqa: BLE001 — report, keep the line
+            integrity_block["closing_probe_error"] = (
+                f"{type(e).__name__}: {e}"
+            )
+
+    async def probe_integrity(session) -> None:
+        """End-of-run probes against the LIVE surfaces: /integrityz, the
+        on-demand audit POST, the ?section= filter, and the
+        dts_tpu_integrity_* Prometheus series."""
+        async with session.get("/integrityz") as r:
+            iz = await r.json()
+        integrity_block["integrityz_enabled"] = bool(iz.get("enabled"))
+        async with session.post("/integrityz/audit?batches=2") as r:
+            body = await r.json()
+            integrity_block["audit_post_ok"] = (
+                r.status == 200 and body.get("pending_audits", 0) >= 1
+            )
+        async with session.get("/monitoring?section=integrity") as r:
+            sec = await r.json()
+            integrity_block["section_filter_ok"] = (
+                r.status == 200
+                and set(sec) == {"integrity"}
+                and bool(sec["integrity"].get("enabled"))
+            )
+        async with session.get("/monitoring/prometheus/metrics") as r:
+            prom_text = await r.text()
+        integrity_block["prom_integrity_series"] = sum(
+            1 for ln in prom_text.splitlines()
+            if ln.startswith("dts_tpu_integrity_")
+        )
+
     async def control_worker(gport: int):
         import grpc as grpc_mod
 
@@ -2063,13 +2282,20 @@ def main() -> None:
                 # (same single host — exercises the backoff path). Overload
                 # soaks run it too: sheds must land as PUSHBACK (busy) on
                 # the scoreboard and the one retry honors retry-after-ms.
-                scoreboard=chaos or overload_mode or recovery_mode,
+                scoreboard=(
+                    chaos or overload_mode or recovery_mode or integrity_mode
+                ),
                 failover_attempts=(
-                    8 if recovery_mode
+                    8 if (recovery_mode or integrity_mode)
                     else 1 if (chaos or overload_mode) else 0
                 ),
             )
-            if recovery_mode:
+            if integrity_mode:
+                # The client half of the wire layer: stamp request CRCs
+                # and verify the server's score CRC before merging —
+                # corrupt responses must surface as retries, never data.
+                client_kwargs["integrity_checksums"] = True
+            if recovery_mode or integrity_mode:
                 # Retries must OUTLAST the recovery cycles (quarantined
                 # submits answer UNAVAILABLE until REPLAY, and the wedge
                 # + poison phases can run 2-3 back-to-back cycles of a
@@ -2151,6 +2377,7 @@ def main() -> None:
                     await asyncio.gather(
                         *data_workers,
                         *([recovery_driver(client)] if recovery_mode else []),
+                        *([integrity_driver(client)] if integrity_mode else []),
                         *(burst_worker(client, w) for w in range(burst_workers)),
                         *(rest_worker(session, w) for w in range(rest_workers)),
                         *([] if lifecycle_mode else [control_worker(gport)]),
@@ -2192,6 +2419,11 @@ def main() -> None:
                             await probe_cascade(session)
                         except Exception as e:  # noqa: BLE001 — report, keep line
                             cascade_block["error"] = f"{type(e).__name__}: {e}"
+                    if integrity_mode:
+                        try:
+                            await probe_integrity(session)
+                        except Exception as e:  # noqa: BLE001 — report, keep line
+                            integrity_block["error"] = f"{type(e).__name__}: {e}"
                     if trace_out:
                         try:
                             await export_trace(session)
@@ -2404,6 +2636,30 @@ def main() -> None:
             }
             if cascade_mode else None
         ),
+        # Integrity plane (SOAK_INTEGRITY=1): the full plane snapshot,
+        # both clean bit-identity verdicts, per-layer detection evidence,
+        # the verifying client's corrupt/NaN counters, recovery
+        # escalation counters, and live-route probes — the CI gate
+        # (tools/check_integrity_smoke.py) reads this.
+        "integrity": (
+            {
+                **integrity_plane.snapshot(),
+                **integrity_block,
+                "client": {
+                    "corrupt_responses": resilience.get(
+                        "corrupt_responses", 0
+                    ),
+                    "nan_scores_merged": resilience.get(
+                        "nan_scores_merged", 0
+                    ),
+                },
+                "recovery_counters": (
+                    recovery_ctrl.snapshot()["counters"]
+                    if recovery_ctrl is not None else None
+                ),
+            }
+            if integrity_mode else None
+        ),
         "chaos": None,
         "input_cache": (
             {
@@ -2417,7 +2673,7 @@ def main() -> None:
             else None
         ),
     }
-    if chaos or overload_mode or recovery_mode:
+    if chaos or overload_mode or recovery_mode or integrity_mode:
         from distributed_tf_serving_tpu import faults
 
         if chaos:
